@@ -1,0 +1,62 @@
+"""josefine-tpu: a TPU-native distributed event-stream framework.
+
+A ground-up re-design of the capabilities of ``tychedelia/josefine`` (a toy
+Kafka speaking the real Kafka wire protocol, with cluster metadata replicated
+through an embedded Chained-Raft cluster) for TPU hardware:
+
+* The per-node Raft handlers (RequestVote / AppendEntries / quorum tally /
+  commit advancement) are **pure JAX kernels vmapped over a
+  (partitions x nodes) state tensor** — thousands of independent consensus
+  groups step in lockstep per device tick (see ``josefine_tpu.models``).
+* Block payloads, the chain DAG, dead-branch GC, the Kafka wire surface and
+  the partition logs stay host-side (``josefine_tpu.raft``,
+  ``josefine_tpu.broker``, ``josefine_tpu.kafka``).
+* Scale-out shards the partition axis across a ``jax.sharding.Mesh`` and can
+  additionally shard the node axis, with delivery as an ``all_to_all`` over
+  ICI (``josefine_tpu.parallel``).
+
+Reference parity map: ``/root/reference`` (``src/lib.rs:19-56`` bootstrap,
+``src/raft/`` consensus, ``src/broker/`` broker, ``src/kafka/`` protocol).
+This package is a new TPU-first design, not a translation.
+"""
+
+__version__ = "0.1.0"
+
+from josefine_tpu.config import JosefineConfig, load_config
+from josefine_tpu.utils.shutdown import Shutdown
+
+__all__ = [
+    "JosefineConfig",
+    "load_config",
+    "Shutdown",
+    "josefine",
+    "josefine_with_config",
+    "run",
+    "__version__",
+]
+
+
+async def josefine(config_path, shutdown):
+    """Run a node from a TOML config file path.
+
+    Parity: ``josefine()`` in reference ``src/lib.rs:19-28``.
+    """
+    return await josefine_with_config(load_config(config_path), shutdown)
+
+
+async def josefine_with_config(config, shutdown):
+    """Run a node from an in-memory config.
+
+    Parity: ``josefine_with_config()`` in reference ``src/lib.rs:24-28``.
+    """
+    return await run(config, shutdown)
+
+
+async def run(config, shutdown):
+    """Wire store -> broker task -> raft task and join both.
+
+    Parity: ``run()`` in reference ``src/lib.rs:31-56``.
+    """
+    from josefine_tpu.node import run_node
+
+    return await run_node(config, shutdown)

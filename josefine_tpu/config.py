@@ -1,0 +1,184 @@
+"""Layered configuration: defaults <- TOML file <- environment.
+
+Parity: reference ``src/config.rs:4-22`` (``JosefineConfig {raft, broker}``,
+``config()`` layering a file source and a ``JOSEFINE``-prefixed environment
+source), ``src/raft/config.rs:14-119`` (raft section, defaults + validation),
+``src/broker/config.rs:12-41`` (broker section).
+
+Deltas from the reference (deliberate):
+* The raft env prefix is ``JOSEFINE_RAFT`` (the reference's is literally
+  ``"crate::raft"`` — a bug, ``src/raft/config.rs:50``).
+* ``election_timeout`` is honored (the reference hardcodes a 500-1000 ms
+  window in ``src/raft/mod.rs:318-319`` and ignores the knob).
+* New ``[engine]`` section selecting the consensus execution backend:
+  ``backend = "jax"`` (vmapped device kernels) or ``"python"`` (host
+  reference engine used for cross-checking), plus device-tick sizing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+
+@dataclass
+class NodeAddr:
+    """A peer in the static full-mesh cluster (reference ``src/raft/config.rs:26``)."""
+
+    id: int
+    ip: str = "127.0.0.1"
+    port: int = 6669
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.ip, self.port)
+
+
+@dataclass
+class RaftConfig:
+    """Parity: reference ``src/raft/config.rs:14-119``."""
+
+    id: int = 1
+    ip: str = "127.0.0.1"
+    port: int = 6669  # reference default, src/raft/config.rs:101
+    nodes: list[NodeAddr] = field(default_factory=list)
+    run_for: float | None = None
+    # Timing (milliseconds), reference src/raft/config.rs:104-107
+    tick_ms: int = 100
+    heartbeat_timeout_ms: int = 100
+    election_timeout_min_ms: int = 500
+    election_timeout_max_ms: int = 1000
+    commit_timeout_ms: int = 50
+    max_append_entries: int = 64
+    # Vestigial in the reference (src/raft/config.rs:108-109); honored here
+    # by the host snapshotter.
+    snapshot_interval_s: int = 120
+    snapshot_threshold: int = 8192
+    data_directory: str = "/tmp/josefine-tpu"
+
+    def validate(self) -> None:
+        # Parity: validation rules in reference src/raft/config.rs:60-84.
+        if self.id == 0:
+            raise ValueError("raft.id must be non-zero")
+        if self.port <= 1023:
+            raise ValueError("raft.port must be > 1023")
+        if self.heartbeat_timeout_ms < 10:
+            raise ValueError("raft.heartbeat_timeout_ms must be >= 10ms")
+        if self.election_timeout_min_ms < self.heartbeat_timeout_ms:
+            raise ValueError("election timeout must be >= heartbeat timeout")
+        if self.election_timeout_max_ms < self.election_timeout_min_ms:
+            raise ValueError("election_timeout_max_ms < election_timeout_min_ms")
+        for n in self.nodes:
+            if n.id == self.id:
+                raise ValueError(f"raft.nodes must not contain self (id {n.id})")
+
+
+@dataclass
+class BrokerConfig:
+    """Parity: reference ``src/broker/config.rs:12-41``."""
+
+    id: int = 1
+    ip: str = "127.0.0.1"
+    port: int = 8844  # reference default, src/broker/config.rs:28
+    state_file: str = "/tmp/josefine-tpu/state"
+    data_directory: str = "/tmp/josefine-tpu/data"
+    peers: list[NodeAddr] = field(default_factory=list)
+
+    def validate(self) -> None:
+        if self.id == 0:
+            raise ValueError("broker.id must be non-zero")
+        if self.port <= 1023:
+            raise ValueError("broker.port must be > 1023")
+
+
+@dataclass
+class EngineConfig:
+    """TPU-build addition: consensus execution backend selection."""
+
+    backend: str = "jax"  # "jax" | "python"
+    # Device tensor sizing: number of consensus groups stepped in lockstep.
+    # The metadata group is group 0; topic partitions may claim further rows.
+    partitions: int = 1
+    max_nodes: int = 8
+
+    def validate(self) -> None:
+        if self.backend not in ("jax", "python"):
+            raise ValueError(f"engine.backend must be 'jax' or 'python', got {self.backend!r}")
+        if self.partitions < 1 or self.max_nodes < 1:
+            raise ValueError("engine.partitions and engine.max_nodes must be >= 1")
+
+
+@dataclass
+class JosefineConfig:
+    """Parity: reference ``src/config.rs:4-9``."""
+
+    raft: RaftConfig = field(default_factory=RaftConfig)
+    broker: BrokerConfig = field(default_factory=BrokerConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+
+    def validate(self) -> "JosefineConfig":
+        self.raft.validate()
+        self.broker.validate()
+        self.engine.validate()
+        return self
+
+
+# Casts keyed by the dataclass field *annotation* (the default value's type
+# is unreliable: run_for defaults to None, nodes/peers to lists).
+_ENV_CASTS = {
+    "int": int,
+    "float": float,
+    "str": str,
+    "bool": lambda v: str(v).lower() in ("1", "true", "yes"),
+    "float | None": float,
+}
+
+
+def _apply_section(cfg_obj, data: dict) -> None:
+    for f in dataclasses.fields(cfg_obj):
+        if f.name not in data:
+            continue
+        val = data[f.name]
+        if f.name in ("nodes", "peers"):
+            val = [NodeAddr(**n) if isinstance(n, dict) else n for n in val]
+        setattr(cfg_obj, f.name, val)
+
+
+def _apply_env(cfg_obj, prefix: str, environ) -> None:
+    """Env override: ``JOSEFINE_<SECTION>_<FIELD>`` (reference ``src/config.rs:15``).
+
+    Scalar fields only — structured fields (``nodes``, ``peers``) come from
+    the TOML file and reject env overrides loudly rather than mis-parsing.
+    """
+    for f in dataclasses.fields(cfg_obj):
+        key = f"{prefix}_{f.name.upper()}"
+        if key not in environ:
+            continue
+        cast = _ENV_CASTS.get(str(f.type))
+        if cast is None:
+            raise ValueError(
+                f"{key}: field {f.name!r} cannot be set from the environment; "
+                "set it in the TOML config file"
+            )
+        setattr(cfg_obj, f.name, cast(environ[key]))
+
+
+def load_config(path: str | os.PathLike | None = None, environ=None) -> JosefineConfig:
+    """Load defaults, layer a TOML file, then ``JOSEFINE``-prefixed env vars.
+
+    Parity: reference ``src/config.rs:11-22``.
+    """
+    environ = os.environ if environ is None else environ
+    cfg = JosefineConfig()
+    if path is not None:
+        with open(path, "rb") as fh:
+            data = tomllib.load(fh)
+        for section in ("raft", "broker", "engine"):
+            if section in data:
+                _apply_section(getattr(cfg, section), data[section])
+    _apply_env(cfg.raft, "JOSEFINE_RAFT", environ)
+    _apply_env(cfg.broker, "JOSEFINE_BROKER", environ)
+    _apply_env(cfg.engine, "JOSEFINE_ENGINE", environ)
+    return cfg.validate()

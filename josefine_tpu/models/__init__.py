@@ -1,0 +1,36 @@
+"""Consensus models.
+
+The flagship model is :mod:`josefine_tpu.models.chained_raft`: the reference's
+per-node Chained-Raft role machine (``src/raft/{follower,candidate,leader}.rs``)
+re-derived as a single pure, branchless step function over fixed-width state,
+vmapped over a (partitions x nodes) tensor.
+"""
+
+from josefine_tpu.models.types import (
+    FOLLOWER,
+    CANDIDATE,
+    LEADER,
+    MSG_NONE,
+    MSG_VOTE_REQ,
+    MSG_VOTE_RESP,
+    MSG_APPEND,
+    MSG_APPEND_RESP,
+    Msgs,
+    NodeState,
+    StepParams,
+    Metrics,
+)
+from josefine_tpu.models.chained_raft import (
+    node_step,
+    cluster_step,
+    init_state,
+    empty_inbox,
+    restart,
+)
+
+__all__ = [
+    "FOLLOWER", "CANDIDATE", "LEADER",
+    "MSG_NONE", "MSG_VOTE_REQ", "MSG_VOTE_RESP", "MSG_APPEND", "MSG_APPEND_RESP",
+    "Msgs", "NodeState", "StepParams", "Metrics",
+    "node_step", "cluster_step", "init_state", "empty_inbox", "restart",
+]

@@ -1,0 +1,411 @@
+"""Batched Chained-Raft: the consensus hot loop as a pure JAX kernel.
+
+One call to :func:`cluster_step` advances **every node of every partition's
+Raft group by one tick, in lockstep, on device**. Messages produced at tick t
+are delivered at tick t+1 (the inbox/outbox tensors are the network; delivery
+is a transpose of the (dst, src) axes). This replaces the reference's
+per-node Tokio event loop + TCP mesh (``src/raft/server.rs:103-165``,
+``src/raft/tcp.rs``) for everything that is fixed-width: elections, term
+bookkeeping, replication acks, quorum commit. Variable-length block payloads
+ride the host (``josefine_tpu.raft``).
+
+Semantics are the reference's role machine (``src/raft/follower.rs``,
+``candidate.rs``, ``leader.rs``) with the catalogued bugs fixed as deliberate
+decisions (SURVEY.md "quirks" 1-5):
+
+* terms only ever move forward (no heartbeat term regression),
+* vote grants check candidate log up-to-dateness (term-major id compare),
+* conflicting AppendEntries are *rejected* (with the follower's commit as the
+  probe hint), never assert-crashed,
+* fork recovery: a follower abandons a dead branch by accepting a span rooted
+  at its commit pointer (committed prefix is quorum-shared, so this is safe),
+* a fresh leader mints a no-op block so old-term entries can commit (the
+  classic Raft liveness fix; the reference lacks it).
+
+The quorum tally is a masked sum over the node axis and the commit index is
+the k-th largest of the leader's match row (k = quorum) — the same
+reductions as reference ``src/raft/election.rs:37-57`` and
+``src/raft/progress.rs:48-60``, computed via an O(N^2) compare matrix
+instead of a sort (N <= 8, so this is a handful of fused elementwise ops).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from josefine_tpu.ops import ids
+from josefine_tpu.models.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    MSG_APPEND,
+    MSG_APPEND_RESP,
+    MSG_NONE,
+    MSG_VOTE_REQ,
+    MSG_VOTE_RESP,
+    Metrics,
+    Msgs,
+    NodeState,
+    StepParams,
+    empty_msgs,
+)
+
+_I32 = jnp.int32
+
+
+def _draw_timeout(seed, term, params: StepParams):
+    """Randomized election timeout in ticks, decorrelated per (node, term)."""
+    h = ids.hash32(seed ^ (jnp.asarray(term, jnp.uint32) * jnp.uint32(0x9E3779B9)))
+    span = (params.timeout_max - params.timeout_min + 1).astype(jnp.uint32)
+    return (params.timeout_min + (h % span).astype(_I32)).astype(_I32)
+
+
+def _tree_select(pred, a, b):
+    """Per-leaf where(pred, a, b); pred broadcasts against trailing dims."""
+    return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int):
+    """Apply one inbox message (from node index ``src``) to scalar node state.
+
+    Returns (state', reply, accepted_span, accepted_msg). The reply is a
+    scalar Msgs addressed back to ``src`` (kind MSG_NONE if no reply).
+    Parity: the reference's ``Apply::apply(Command)`` dispatch
+    (``src/raft/mod.rs:471-489``) for the four wire commands.
+    """
+    src_i = jnp.asarray(src, _I32)
+    valid = (m.kind != MSG_NONE) & st.alive
+
+    # -- universal term catch-up: any message from a higher term demotes us.
+    # (Strictly-greater only: fixes the reference's unconditional heartbeat
+    # term adoption, src/raft/follower.rs:178-187 / mod.rs:360-365.)
+    higher = valid & (m.term > st.term)
+    new_term = jnp.where(higher, m.term, st.term)
+    st = st.replace(
+        term=new_term,
+        role=jnp.where(higher, FOLLOWER, st.role),
+        voted_for=jnp.where(higher, -1, st.voted_for),
+        leader=jnp.where(higher, -1, st.leader),
+        elapsed=jnp.where(higher, 0, st.elapsed),
+        timeout=jnp.where(higher, _draw_timeout(st.seed, new_term, params), st.timeout),
+        votes=jnp.where(higher, jnp.zeros_like(st.votes), st.votes),
+    )
+    cur = valid & (m.term == st.term)
+
+    # -- VoteRequest (reference follower.rs:219-246 + can_vote :97-101, with
+    # the up-to-dateness check the reference omits).
+    is_vr = valid & (m.kind == MSG_VOTE_REQ)
+    grant = (
+        cur
+        & (m.kind == MSG_VOTE_REQ)
+        & (st.role == FOLLOWER)
+        & ((st.voted_for == -1) | (st.voted_for == src_i))
+        & ids.ge(m.x, st.head)
+    )
+    st = st.replace(
+        voted_for=jnp.where(grant, src_i, st.voted_for),
+        elapsed=jnp.where(grant, 0, st.elapsed),
+    )
+
+    # -- VoteResponse (reference candidate.rs:91-98).
+    is_vresp = cur & (m.kind == MSG_VOTE_RESP) & (st.role == CANDIDATE)
+    st = st.replace(
+        votes=st.votes.at[src].set(st.votes[src] | (is_vresp & (m.ok == 1)))
+    )
+
+    # -- AppendEntries / heartbeat (reference follower.rs:130-217).
+    is_ae_kind = valid & (m.kind == MSG_APPEND)
+    is_ae = is_ae_kind & cur
+    st = st.replace(
+        role=jnp.where(is_ae, FOLLOWER, st.role),
+        leader=jnp.where(is_ae, src_i, st.leader),
+        elapsed=jnp.where(is_ae, 0, st.elapsed),
+    )
+    # Accept if the span is rooted at our head (normal append / empty
+    # heartbeat) or at our commit pointer (dead-branch abandonment).
+    accept = is_ae & (ids.eq(m.x, st.head) | ids.eq(m.x, st.commit))
+    old_head_s = st.head.s
+    new_head = ids.where(accept, m.y, st.head)
+    new_commit = ids.where(
+        accept, ids.max_(st.commit, ids.min_(m.z, new_head)), st.commit
+    )
+    # Net new blocks applied (duplicate/overlapping spans don't double-count).
+    span = jnp.where(accept, jnp.maximum(0, m.y.s - old_head_s), 0)
+    st = st.replace(head=new_head, commit=new_commit)
+
+    # -- AppendResponse (reference leader.rs:211-219 -> progress.advance).
+    # ok: confirm match (and keep the optimistic nxt at least there).
+    # reject: re-root the send pointer at the follower's probe hint.
+    is_ar = cur & (m.kind == MSG_APPEND_RESP) & (st.role == LEADER)
+    ok = m.ok == 1
+    mi = ids.index(st.match, src)
+    ni = ids.index(st.nxt, src)
+    st = st.replace(
+        match=ids.set_at(st.match, src, ids.where(is_ar & ok, ids.max_(mi, m.x), mi)),
+        nxt=ids.set_at(
+            st.nxt, src,
+            ids.where(is_ar, ids.where(ok, ids.max_(ni, m.x), m.x), ni),
+        ),
+    )
+
+    # -- reply (at most one per src per tick; responses only).
+    rep_kind = jnp.where(
+        is_vr, MSG_VOTE_RESP, jnp.where(is_ae_kind, MSG_APPEND_RESP, MSG_NONE)
+    )
+    zero = ids.full(())
+    rep = Msgs(
+        kind=rep_kind.astype(_I32),
+        term=st.term,
+        # ack on accept; our commit as the probe hint on reject (the leader
+        # re-roots its next span there — 2-tick fork recovery).
+        x=ids.where(accept, st.head, st.commit),
+        y=zero,
+        z=zero,
+        ok=(grant | accept).astype(_I32),
+    )
+    return st, rep, span, accept.astype(_I32)
+
+
+def node_step(
+    params: StepParams,
+    member: jnp.ndarray,  # bool[N]
+    me: jnp.ndarray,      # i32 node index
+    st: NodeState,        # scalar leaves (+ [N] votes/match)
+    inbox: Msgs,          # leaves [N] (message from each src; kind 0 = none)
+    proposals: jnp.ndarray,  # i32 client blocks offered to this node this tick
+):
+    """One tick of one node: inbox fold -> timers -> election tally ->
+    proposal minting -> quorum commit -> outbox. Pure; vmap over (P, N).
+
+    Parity: one iteration of the reference event loop select
+    (``src/raft/server.rs:120-161``) plus ``apply_tick`` of the current role.
+    """
+    N = member.shape[0]
+    st_in = st
+    commit_s0 = st.commit.s
+
+    # ---- 1. inbox fold (sequential over srcs; N is small and static) ----
+    reply = empty_msgs((N,))
+    acc_blocks = jnp.zeros((), _I32)
+    acc_msgs = jnp.zeros((), _I32)
+    for src in range(N):
+        m = jax.tree.map(lambda a: a[src], inbox)
+        st, rep, span, acc = _process_msg(params, st, m, src)
+        reply = jax.tree.map(lambda R, r: R.at[src].set(r), reply, rep)
+        acc_blocks = acc_blocks + span
+        acc_msgs = acc_msgs + acc
+
+    # ---- 2. timers: election timeout -> candidacy (follower.rs:103-128,
+    # :248-256 and candidate re-election) ----
+    is_leader = st.role == LEADER
+    elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
+    timed_out = st.alive & ~is_leader & (elapsed >= st.timeout)
+    new_term = jnp.where(timed_out, st.term + 1, st.term)
+    self_vote = jnp.arange(N) == me
+    st = st.replace(
+        term=new_term,
+        elapsed=jnp.where(timed_out, 0, elapsed),
+        role=jnp.where(timed_out, CANDIDATE, st.role),
+        voted_for=jnp.where(timed_out, me, st.voted_for),
+        leader=jnp.where(timed_out, -1, st.leader),
+        votes=jnp.where(timed_out, self_vote, st.votes),
+        timeout=jnp.where(timed_out, _draw_timeout(st.seed, new_term, params), st.timeout),
+    )
+    just_cand = timed_out
+
+    # ---- 3. election tally (election.rs:37-73; quorum = n//2 + 1; the
+    # single-node case needs no special 0-quorum hack — self vote suffices).
+    nvotes = jnp.sum(st.votes & member).astype(_I32)
+    quorum = (jnp.sum(member).astype(_I32) // 2) + 1
+    elected = st.alive & (st.role == CANDIDATE) & (nvotes >= quorum)
+    # Mint a no-op block at the new term (commit-liveness fix).
+    noop = ids.Bid(t=st.term, s=st.head.s + 1)
+    head_after = ids.where(elected, noop, st.head)
+    # Fresh progress rows: confirmed match = genesis (peers unconfirmed),
+    # optimistic nxt = our commit (first AE probes the shared prefix);
+    # self entries track our own head.
+    headN = ids.broadcast_to(head_after, (N,))
+    fresh_match = ids.where(self_vote, headN, ids.full((N,)))
+    fresh_nxt = ids.where(self_vote, headN, ids.broadcast_to(st.commit, (N,)))
+    st = st.replace(
+        role=jnp.where(elected, LEADER, st.role),
+        leader=jnp.where(elected, me, st.leader),
+        head=head_after,
+        match=ids.where(elected, fresh_match, st.match),
+        nxt=ids.where(elected, fresh_nxt, st.nxt),
+        hb_elapsed=jnp.where(elected, params.hb_ticks, st.hb_elapsed),
+    )
+
+    # ---- 4. proposal minting (leader.rs:177-197; k proposals = one head
+    # bump of k — payloads are host-side, keyed (p, term, seq)).
+    is_leader = st.role == LEADER
+    minted = jnp.where(is_leader & st.alive, proposals + params.auto_proposals, 0)
+    st = st.replace(
+        head=ids.Bid(
+            t=jnp.where(minted > 0, st.term, st.head.t),
+            s=st.head.s + minted,
+        )
+    )
+    st = st.replace(
+        match=ids.set_at(
+            st.match, me, ids.where(is_leader, st.head, ids.index(st.match, me))
+        ),
+        nxt=ids.set_at(
+            st.nxt, me, ids.where(is_leader, st.head, ids.index(st.nxt, me))
+        ),
+    )
+
+    # ---- 5. quorum commit: k-th largest match (k = quorum) via an O(N^2)
+    # compare matrix (progress.rs:48-60 median as a pure reduction), guarded
+    # by the current-term rule.
+    mt, ms = st.match.t, st.match.s
+    ge_mat = (mt[None, :] > mt[:, None]) | ((mt[None, :] == mt[:, None]) & (ms[None, :] >= ms[:, None]))
+    support = jnp.sum(ge_mat & member[None, :], axis=1).astype(_I32)
+    eligible = member & (support >= quorum)
+    best = ids.full((), -1, -1)
+    for i in range(N):
+        cand = ids.index(st.match, i)
+        take = eligible[i] & ids.gt(cand, best)
+        best = ids.where(take, cand, best)
+    advance = is_leader & st.alive & (best.t == st.term) & ids.gt(best, st.commit)
+    st = st.replace(commit=ids.where(advance, best, st.commit))
+
+    # ---- 6. outbox: broadcast VoteRequest on new candidacy; leader sends
+    # AE to lagging peers every tick and to all peers at heartbeat cadence
+    # (leader.rs:44-51,124-174 unified); else per-src replies.
+    dst = jnp.arange(N)
+    is_peer = member & (dst != me)
+    hb_due = st.hb_elapsed >= params.hb_ticks
+    send_ae = is_leader & st.alive & is_peer & (hb_due | ids.lt(st.nxt, st.head))
+    st = st.replace(
+        hb_elapsed=jnp.where(is_leader, jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
+    )
+    bc_vr = just_cand & st.alive & is_peer & ~is_leader
+
+    kind = jnp.where(
+        send_ae, MSG_APPEND, jnp.where(bc_vr, MSG_VOTE_REQ, reply.kind)
+    )
+    headN = ids.broadcast_to(st.head, (N,))
+    commitN = ids.broadcast_to(st.commit, (N,))
+    out = Msgs(
+        kind=jnp.where(st.alive, kind, MSG_NONE).astype(_I32),
+        term=jnp.where(send_ae | bc_vr, st.term, reply.term),
+        x=ids.where(send_ae, st.nxt, ids.where(bc_vr, headN, reply.x)),
+        y=ids.where(send_ae, headN, reply.y),
+        z=ids.where(send_ae, commitN, reply.z),
+        ok=reply.ok,
+    )
+    # Optimistically advance the send pointer to what we just shipped, so the
+    # pipeline stays full across the 2-tick RTT (a reject re-roots it).
+    st = st.replace(nxt=ids.where(send_ae, headN, st.nxt))
+
+    # ---- crashed nodes are frozen entirely ----
+    st = _tree_select(st_in.alive, st, st_in)
+    metrics = Metrics(
+        accepted_blocks=acc_blocks,
+        accepted_msgs=acc_msgs,
+        minted=minted,
+        commit_delta=st.commit.s - commit_s0,
+        became_leader=elected & st_in.alive,
+    )
+    return st, out, metrics
+
+
+# vmap over the node axis, then the partition axis.
+_over_nodes = jax.vmap(node_step, in_axes=(None, None, 0, 0, 0, 0))
+_over_parts = jax.vmap(_over_nodes, in_axes=(None, 0, None, 0, 0, 0))
+
+
+@functools.partial(jax.jit, donate_argnums=(2, 3))
+def cluster_step(
+    params: StepParams,
+    member: jnp.ndarray,   # bool (P, N)
+    state: NodeState,      # leaves (P, N) / (P, N, N)
+    inbox: Msgs,           # leaves (P, N_dst, N_src)
+    proposals: jnp.ndarray,  # i32 (P, N)
+):
+    """One lockstep tick of P independent Raft groups of N nodes.
+
+    Returns (state', next_inbox, metrics). Delivery of the produced outbox is
+    the (dst, src) transpose — messages sent at tick t arrive at tick t+1.
+    This *is* the cluster transport for the simulated/batched mode (the
+    reference's ``src/raft/tcp.rs`` full-mesh TCP, reduced to a permutation).
+    """
+    N = member.shape[-1]
+    me = jnp.arange(N, dtype=_I32)
+    st, out, met = _over_parts(params, member, me, state, inbox, proposals)
+    next_inbox = jax.tree.map(lambda a: jnp.swapaxes(a, 1, 2), out)
+    return st, next_inbox, met
+
+
+def init_state(P: int, N: int, member: jnp.ndarray | None = None, base_seed: int = 0,
+               params: StepParams | None = None) -> tuple[NodeState, jnp.ndarray]:
+    """Fresh (P, N) follower state + membership mask.
+
+    Parity: reference startup state (``src/raft/mod.rs:270-322`` defaults +
+    chain genesis init ``src/raft/chain.rs:139-153``).
+    """
+    from josefine_tpu.models.types import step_params
+
+    params = params or step_params()
+    if member is None:
+        member = jnp.ones((P, N), bool)
+    pp = jnp.arange(P, dtype=jnp.uint32)[:, None]
+    nn = jnp.arange(N, dtype=jnp.uint32)[None, :]
+    seed = ids.hash32(jnp.uint32(base_seed) ^ (pp * jnp.uint32(0x9E3779B1)) ^ (nn * jnp.uint32(0x85EBCA77)))
+    # Distinct buffers per field: cluster_step donates the state, and donating
+    # one buffer twice (or a buffer shared with the non-donated member mask)
+    # is an error.
+    st = NodeState(
+        term=jnp.zeros((P, N), _I32),
+        voted_for=jnp.full((P, N), -1, _I32),
+        role=jnp.zeros((P, N), _I32),
+        leader=jnp.full((P, N), -1, _I32),
+        head=ids.full((P, N)),
+        commit=ids.full((P, N)),
+        elapsed=jnp.zeros((P, N), _I32),
+        timeout=jax.vmap(jax.vmap(lambda s: _draw_timeout(s, 0, params)))(seed),
+        hb_elapsed=jnp.zeros((P, N), _I32),
+        alive=member.copy(),
+        seed=seed,
+        votes=jnp.zeros((P, N, N), bool),
+        match=ids.full((P, N, N)),
+        nxt=ids.full((P, N, N)),
+    )
+    return st, member
+
+
+def empty_inbox(P: int, N: int) -> Msgs:
+    return empty_msgs((P, N, N))
+
+
+def restart(state: NodeState, mask: jnp.ndarray, keep_term: bool = True) -> NodeState:
+    """Revive crashed nodes selected by ``mask`` (bool (P, N)).
+
+    Chain state (head/commit) survives — it is durably stored host-side
+    (reference ``src/raft/chain.rs:117-137`` restart path). ``keep_term``
+    persists the term across restart, fixing the reference's
+    rejoin-at-term-0 quirk (volatile term, SURVEY.md aux notes); pass False
+    for reference-faithful behavior.
+    """
+    sel = lambda new, old: jnp.where(mask, new, old)
+    return state.replace(
+        alive=state.alive | mask,
+        role=sel(jnp.zeros_like(state.role), state.role),
+        voted_for=sel(jnp.full_like(state.voted_for, -1), state.voted_for),
+        leader=sel(jnp.full_like(state.leader, -1), state.leader),
+        elapsed=sel(jnp.zeros_like(state.elapsed), state.elapsed),
+        hb_elapsed=sel(jnp.zeros_like(state.hb_elapsed), state.hb_elapsed),
+        term=state.term if keep_term else sel(jnp.zeros_like(state.term), state.term),
+        votes=jnp.where(mask[..., None], jnp.zeros_like(state.votes), state.votes),
+        match=ids.where(mask[..., None], ids.full(state.match.t.shape), state.match),
+        nxt=ids.where(mask[..., None], ids.full(state.nxt.t.shape), state.nxt),
+    )
+
+
+def crash(state: NodeState, mask: jnp.ndarray) -> NodeState:
+    """Kill nodes selected by ``mask`` (fault injection)."""
+    return state.replace(alive=state.alive & ~mask)

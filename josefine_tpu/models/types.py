@@ -1,0 +1,123 @@
+"""State and message tensors for the batched Chained-Raft model.
+
+Layout philosophy (the TPU-first redesign of reference ``src/raft/``):
+only fixed-width consensus *metadata* lives on device — per (partition p,
+node n): term, vote, role, leader, head/commit ids, timers, and the leader's
+per-peer replication heads. Block *payloads*, the chain DAG, GC and all wire
+I/O stay host-side (see ``josefine_tpu.raft.chain``). This is the split the
+north star prescribes: vote aggregation, term/index comparison and
+commit-index advancement in HBM; everything variable-length on the host.
+
+The reference's 12-variant ``Command`` enum (``src/raft/mod.rs:159-227``)
+collapses to 4 wire message kinds here because Heartbeat is unified with an
+empty AppendEntries (same fields, same handling — the reference itself
+treats heartbeat as "AppendEntries minus blocks", ``src/raft/leader.rs:44-51``
+vs ``:124-174``) and Tick/ClientRequest/etc. are step inputs, not messages.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from josefine_tpu.ops import ids
+
+# Message kinds on the (p, dst, src) message tensor.
+MSG_NONE = 0
+MSG_VOTE_REQ = 1    # x = candidate head        (reference Command::VoteRequest)
+MSG_VOTE_RESP = 2   # ok = granted              (reference Command::VoteResponse)
+MSG_APPEND = 3      # x = prev, y = leader head, z = leader commit
+                    #   (reference AppendEntries + Heartbeat, unified)
+MSG_APPEND_RESP = 4 # ok = success, x = acked head (or follower commit on reject)
+                    #   (reference AppendResponse + HeartbeatResponse, unified)
+
+# Roles (reference typestate Raft<Follower|Candidate|Leader>, src/raft/mod.rs:326-401).
+FOLLOWER = 0
+CANDIDATE = 1
+LEADER = 2
+
+
+@struct.dataclass
+class Msgs:
+    """A batch of messages. Leading shape is arbitrary; per-message fields:
+
+    kind: MSG_* ; term: sender term ; x, y, z: block ids (see MSG_* docs) ;
+    ok: boolean payload for responses.
+    """
+
+    kind: jnp.ndarray  # i32
+    term: jnp.ndarray  # i32
+    x: ids.Bid
+    y: ids.Bid
+    z: ids.Bid
+    ok: jnp.ndarray    # i32
+
+
+def empty_msgs(shape) -> Msgs:
+    # Distinct buffers per field: message tensors are donated by cluster_step,
+    # and a buffer may only be donated once.
+    z = lambda: jnp.zeros(shape, jnp.int32)
+    return Msgs(kind=z(), term=z(), x=ids.full(shape), y=ids.full(shape), z=ids.full(shape), ok=z())
+
+
+@struct.dataclass
+class NodeState:
+    """Per-(partition, node) consensus state. Written scalar-per-node; the
+    batched layout (P, N) [+ (P, N, N) for votes/match] is produced by vmap.
+
+    Parity map (reference): term/voted_for/role -> ``State``
+    ``src/raft/mod.rs:270-322``; head/commit -> ``Chain`` head & commit
+    pointers ``src/raft/chain.rs``; votes -> ``Election`` ``src/raft/
+    election.rs``; match -> ``ReplicationProgress`` ``src/raft/progress.rs``;
+    elapsed/timeout -> randomized election timer ``src/raft/mod.rs:318-319``.
+    """
+
+    term: jnp.ndarray        # i32 current term
+    voted_for: jnp.ndarray   # i32 node index, -1 = none
+    role: jnp.ndarray        # i32 FOLLOWER/CANDIDATE/LEADER
+    leader: jnp.ndarray      # i32 known leader index, -1 = unknown
+    head: ids.Bid            # chain head id
+    commit: ids.Bid          # commit pointer
+    elapsed: jnp.ndarray     # i32 ticks since last election-timer reset
+    timeout: jnp.ndarray     # i32 current randomized election timeout (ticks)
+    hb_elapsed: jnp.ndarray  # i32 leader ticks since last broadcast
+    alive: jnp.ndarray       # bool crash-injection mask
+    seed: jnp.ndarray        # u32 per-node hash seed for timeout draws
+    votes: jnp.ndarray       # bool[N] votes granted to me this election
+    match: ids.Bid           # Bid[N] acked replicated head per peer (confirmed)
+    nxt: ids.Bid             # Bid[N] optimistic send pointer per peer
+                             #   (the reference's Probe->Replicate pipeline,
+                             #   src/raft/progress.rs:76-94, as two id rows)
+
+
+@struct.dataclass
+class StepParams:
+    """Per-step scalars (traced, so one compiled step serves any config).
+
+    timeout_min/max: randomized election window in ticks (reference 500-1000 ms
+    at a 100 ms tick -> 5..10, ``src/raft/mod.rs:318-319``,
+    ``src/raft/server.rs:25``). hb_ticks: broadcast cadence (reference
+    heartbeat_timeout 100 ms = 1 tick). auto_proposals: blocks minted per
+    leader per tick (the bench's client-load lane).
+    """
+
+    timeout_min: jnp.ndarray  # i32
+    timeout_max: jnp.ndarray  # i32
+    hb_ticks: jnp.ndarray     # i32
+    auto_proposals: jnp.ndarray  # i32
+
+
+def step_params(timeout_min=5, timeout_max=10, hb_ticks=1, auto_proposals=0) -> StepParams:
+    a = lambda v: jnp.asarray(v, jnp.int32)
+    return StepParams(a(timeout_min), a(timeout_max), a(hb_ticks), a(auto_proposals))
+
+
+@struct.dataclass
+class Metrics:
+    """Per-(p, n) per-tick counters (bench + observability)."""
+
+    accepted_blocks: jnp.ndarray  # blocks applied via AppendEntries
+    accepted_msgs: jnp.ndarray    # AppendEntries messages accepted
+    minted: jnp.ndarray           # blocks minted by this node as leader
+    commit_delta: jnp.ndarray     # commit-pointer advance (in blocks)
+    became_leader: jnp.ndarray    # bool: won an election this tick

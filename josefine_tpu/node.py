@@ -1,0 +1,28 @@
+"""Node composition: wire store -> broker task -> raft task and join.
+
+Parity: reference ``run()`` in ``src/lib.rs:31-56`` (one sled DB, one broker
+task, one raft task, ``try_join!``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from josefine_tpu.config import JosefineConfig
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("node")
+
+
+async def run_node(config: JosefineConfig, shutdown: Shutdown):
+    """Run one full node (raft + broker) until shutdown.
+
+    The host runtime (raft server event loop, broker, Kafka surface) is under
+    construction; this composes whatever layers exist so far.
+    """
+    raise NotImplementedError(
+        "host runtime composition lands with josefine_tpu.raft.server and "
+        "josefine_tpu.broker; the device consensus engine "
+        "(josefine_tpu.models) is functional today"
+    )

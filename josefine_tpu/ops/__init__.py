@@ -1,0 +1,1 @@
+"""Device-level ops: block-id arithmetic, quorum reductions, pallas kernels."""

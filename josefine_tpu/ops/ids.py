@@ -1,0 +1,111 @@
+"""Chain block ids as batched (mint_term, seq) pairs.
+
+The reference identifies chain blocks with a monotone u64 ``BlockId`` minted
+by the leader (``src/raft/chain.rs:30-67,117-137``). Because its id generator
+is seeded from the commit pointer, two concurrent leaders can mint the *same*
+id for *different* blocks (reference quirk; SURVEY.md bug 3). The TPU build
+fixes this by construction: a block id is the pair
+
+    (t, s) = (term the block was minted in, chain length at the block)
+
+ordered term-major. This makes three classic Raft checks pure integer
+compares that vectorize over a (partitions, nodes) tensor:
+
+* log up-to-dateness for vote grants: ``candidate_head >= my_head``
+  (reference omits this — ``src/raft/follower.rs:97-101`` — bug 4),
+* fork choice between a dead branch and the leader's branch,
+* the "only commit blocks of the current term" safety rule.
+
+On device ids stay as two int32 planes (TPUs have no native int64); on host
+they pack into a single u64 ``(t << 32) | s``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class Bid:
+    """A batch of block ids; ``t`` and ``s`` are same-shaped int32 arrays."""
+
+    t: jnp.ndarray  # mint term
+    s: jnp.ndarray  # chain length (number of blocks from genesis); genesis = 0
+
+
+def bid(t, s) -> Bid:
+    return Bid(t=jnp.asarray(t, jnp.int32), s=jnp.asarray(s, jnp.int32))
+
+
+def full(shape, t: int = 0, s: int = 0) -> Bid:
+    return Bid(t=jnp.full(shape, t, jnp.int32), s=jnp.full(shape, s, jnp.int32))
+
+
+def genesis(shape=()) -> Bid:
+    return full(shape, 0, 0)
+
+
+def eq(a: Bid, b: Bid):
+    return (a.t == b.t) & (a.s == b.s)
+
+
+def lt(a: Bid, b: Bid):
+    return (a.t < b.t) | ((a.t == b.t) & (a.s < b.s))
+
+
+def le(a: Bid, b: Bid):
+    return (a.t < b.t) | ((a.t == b.t) & (a.s <= b.s))
+
+
+def gt(a: Bid, b: Bid):
+    return lt(b, a)
+
+
+def ge(a: Bid, b: Bid):
+    return le(b, a)
+
+
+def where(pred, a: Bid, b: Bid) -> Bid:
+    return Bid(t=jnp.where(pred, a.t, b.t), s=jnp.where(pred, a.s, b.s))
+
+
+def max_(a: Bid, b: Bid) -> Bid:
+    return where(ge(a, b), a, b)
+
+
+def min_(a: Bid, b: Bid) -> Bid:
+    return where(le(a, b), a, b)
+
+
+def index(b: Bid, i) -> Bid:
+    return Bid(t=b.t[i], s=b.s[i])
+
+
+def set_at(b: Bid, i, v: Bid) -> Bid:
+    return Bid(t=b.t.at[i].set(v.t), s=b.s.at[i].set(v.s))
+
+
+def broadcast_to(b: Bid, shape) -> Bid:
+    return Bid(t=jnp.broadcast_to(b.t, shape), s=jnp.broadcast_to(b.s, shape))
+
+
+def pack_host(t: int, s: int) -> int:
+    """Host-side single-integer form, ``(t << 32) | s``."""
+    return (int(t) << 32) | (int(s) & 0xFFFFFFFF)
+
+
+def unpack_host(v: int) -> tuple[int, int]:
+    return (int(v) >> 32, int(v) & 0xFFFFFFFF)
+
+
+def hash32(x):
+    """Cheap avalanche hash (lowrey/splitmix-style) for decorrelated timeouts."""
+    x = jnp.asarray(x, jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x

@@ -1,0 +1,4 @@
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import setup_tracing
+
+__all__ = ["Shutdown", "setup_tracing"]

@@ -1,0 +1,43 @@
+"""Structured tracing.
+
+Parity: reference tracing setup (``src/main.rs:41-52``: env-filtered DEBUG,
+compact stdout) and the command-class log levels of
+``src/raft/mod.rs:367-388`` (Tick/Heartbeat/Append at TRACE, the rest DEBUG).
+
+Python's logging has no TRACE level; we register one at 5 so the hot-path
+commands can be silenced independently of DEBUG, exactly as the reference
+separates per-tick noise from state transitions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+TRACE = 5
+logging.addLevelName(TRACE, "TRACE")
+
+
+def _trace(self, msg, *args, **kwargs):
+    if self.isEnabledFor(TRACE):
+        self._log(TRACE, msg, args, **kwargs)
+
+
+logging.Logger.trace = _trace  # type: ignore[attr-defined]
+
+
+def setup_tracing(level: str | None = None) -> None:
+    """Install a compact stdout handler, env-filtered via JOSEFINE_LOG."""
+    level_name = (level or os.environ.get("JOSEFINE_LOG", "INFO")).upper()
+    lvl = TRACE if level_name == "TRACE" else getattr(logging, level_name, logging.INFO)
+    root = logging.getLogger("josefine")
+    root.setLevel(lvl)
+    if not root.handlers:
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(logging.Formatter("%(asctime)s %(levelname)-5s %(name)s: %(message)s", "%H:%M:%S"))
+        root.addHandler(h)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"josefine.{name}")

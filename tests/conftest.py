@@ -1,0 +1,17 @@
+"""Test environment: force an 8-virtual-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding is validated on a
+virtual CPU mesh exactly as the driver's ``dryrun_multichip`` does. Note the
+sandbox's ``sitecustomize`` pins ``JAX_PLATFORMS=axon``, so the env var alone
+is not enough — the config update after import is what sticks.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,346 @@
+"""Unit tests for the batched Chained-Raft kernel.
+
+These mirror the reference's role tests (SURVEY.md §4: follower vote
+grant/deny + timeout->candidate ``src/raft/follower.rs:306-426``, candidate
+step-down ``src/raft/candidate.rs:240-268``, leader propose->commit
+``src/raft/leader.rs:286-329``, election tally ``src/raft/election.rs``,
+progress advance ``src/raft/progress.rs:237-275``) — driven through the pure
+step function exactly as the reference drives ``apply()`` through its
+channel seam.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    MSG_APPEND,
+    MSG_APPEND_RESP,
+    MSG_NONE,
+    MSG_VOTE_REQ,
+    MSG_VOTE_RESP,
+    NodeState,
+    empty_msgs,
+    step_params,
+)
+from josefine_tpu.ops import ids
+
+
+def make_node(N=3, me=0, **kw) -> NodeState:
+    """A scalar-per-node state for direct node_step driving (the reference's
+    ``raft::test::new_follower`` fixture, src/raft/test/mod.rs:21-41)."""
+    base = dict(
+        term=jnp.int32(0),
+        voted_for=jnp.int32(-1),
+        role=jnp.int32(FOLLOWER),
+        leader=jnp.int32(-1),
+        head=ids.bid(0, 0),
+        commit=ids.bid(0, 0),
+        elapsed=jnp.int32(0),
+        timeout=jnp.int32(100),  # effectively never fires unless test wants it
+        hb_elapsed=jnp.int32(0),
+        alive=jnp.bool_(True),
+        seed=jnp.uint32(7),
+        votes=jnp.zeros((N,), bool),
+        match=ids.full((N,)),
+        nxt=ids.full((N,)),
+    )
+    base.update(kw)
+    return NodeState(**base)
+
+
+def msg_at(N, src, kind, term=0, x=(0, 0), y=(0, 0), z=(0, 0), ok=0):
+    m = empty_msgs((N,))
+    return m.replace(
+        kind=m.kind.at[src].set(kind),
+        term=m.term.at[src].set(term),
+        x=ids.set_at(m.x, src, ids.bid(*x)),
+        y=ids.set_at(m.y, src, ids.bid(*y)),
+        z=ids.set_at(m.z, src, ids.bid(*z)),
+        ok=m.ok.at[src].set(ok),
+    )
+
+
+def step(st, inbox=None, N=3, me=0, proposals=0, member=None, **params_kw):
+    params = step_params(**params_kw) if params_kw else step_params()
+    member = jnp.ones((N,), bool) if member is None else member
+    inbox = empty_msgs((N,)) if inbox is None else inbox
+    return cr.node_step(params, member, jnp.int32(me), st, inbox, jnp.int32(proposals))
+
+
+# ---------------------------------------------------------------- vote logic
+
+def test_vote_granted_when_fresh():
+    st = make_node()
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=1, x=(0, 0))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.term) == 1
+    assert int(st2.voted_for) == 1
+    assert int(out.kind[1]) == MSG_VOTE_RESP and int(out.ok[1]) == 1
+
+
+def test_vote_denied_if_candidate_log_behind():
+    # Fix of reference bug 4 (can_vote ignores candidate head,
+    # src/raft/follower.rs:97-101): stale candidate must be denied.
+    st = make_node(head=ids.bid(1, 5))
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=2, x=(1, 3))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.voted_for) == -1
+    assert int(out.kind[1]) == MSG_VOTE_RESP and int(out.ok[1]) == 0
+
+
+def test_vote_denied_if_already_voted():
+    st = make_node(term=jnp.int32(2), voted_for=jnp.int32(2))
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=2)
+    st2, out, _ = step(st, inbox)
+    assert int(st2.voted_for) == 2
+    assert int(out.ok[1]) == 0
+
+
+def test_vote_idempotent_regrant_same_candidate():
+    st = make_node(term=jnp.int32(2), voted_for=jnp.int32(1))
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=2)
+    _, out, _ = step(st, inbox)
+    assert int(out.ok[1]) == 1
+
+
+# ------------------------------------------------------------ role machine
+
+def test_follower_times_out_to_candidate_and_broadcasts():
+    st = make_node(timeout=jnp.int32(1))
+    st2, out, _ = step(st)
+    assert int(st2.role) == CANDIDATE
+    assert int(st2.term) == 1
+    assert int(st2.voted_for) == 0
+    np.testing.assert_array_equal(np.array(out.kind), [MSG_NONE, MSG_VOTE_REQ, MSG_VOTE_REQ])
+
+
+def test_candidate_elected_on_quorum():
+    st = make_node(role=jnp.int32(CANDIDATE), term=jnp.int32(1),
+                   voted_for=jnp.int32(0),
+                   votes=jnp.array([True, False, False]))
+    inbox = msg_at(3, 1, MSG_VOTE_RESP, term=1, ok=1)
+    st2, out, met = step(st, inbox)
+    assert int(st2.role) == LEADER
+    assert bool(met.became_leader)
+    # No-op block minted at the new term (commit-liveness fix).
+    assert int(st2.head.t) == 1 and int(st2.head.s) == 1
+    # Immediate AE broadcast to both peers.
+    assert int(out.kind[1]) == MSG_APPEND and int(out.kind[2]) == MSG_APPEND
+
+
+def test_candidate_steps_down_on_current_term_append():
+    # Reference candidate.rs:116-157: candidate yields to an elected leader.
+    st = make_node(role=jnp.int32(CANDIDATE), term=jnp.int32(3),
+                   voted_for=jnp.int32(0), votes=jnp.array([True, False, False]))
+    inbox = msg_at(3, 2, MSG_APPEND, term=3, x=(0, 0), y=(3, 1), z=(0, 0))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.role) == FOLLOWER
+    assert int(st2.leader) == 2
+    assert int(st2.head.t) == 3 and int(st2.head.s) == 1
+
+
+def test_leader_steps_down_on_higher_term():
+    st = make_node(role=jnp.int32(LEADER), term=jnp.int32(2), leader=jnp.int32(0))
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=5, x=(2, 9))
+    st2, _, _ = step(st, inbox)
+    assert int(st2.role) == FOLLOWER
+    assert int(st2.term) == 5
+
+
+def test_no_term_regression_from_stale_leader():
+    # Fix of reference bug 1 (heartbeat adopts sender term unconditionally,
+    # src/raft/follower.rs:178-187).
+    st = make_node(term=jnp.int32(5))
+    inbox = msg_at(3, 1, MSG_APPEND, term=3, x=(0, 0), y=(3, 4))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.term) == 5
+    assert int(st2.head.s) == 0  # not accepted
+    assert int(out.kind[1]) == MSG_APPEND_RESP and int(out.ok[1]) == 0
+
+
+# ------------------------------------------------------- append / replication
+
+def test_append_accept_at_head():
+    st = make_node(term=jnp.int32(1), head=ids.bid(1, 3), commit=ids.bid(1, 2))
+    inbox = msg_at(3, 1, MSG_APPEND, term=1, x=(1, 3), y=(1, 6), z=(1, 4))
+    st2, out, met = step(st, inbox)
+    assert int(st2.head.s) == 6
+    assert int(st2.commit.s) == 4
+    assert int(met.accepted_blocks) == 3
+    assert int(out.ok[1]) == 1 and int(out.x.s[1]) == 6
+
+
+def test_append_reject_reports_commit_as_probe_hint():
+    # Fix of reference bug 2 (assert-crash on conflict,
+    # src/raft/follower.rs:147-154): reject + hint instead.
+    st = make_node(term=jnp.int32(2), head=ids.bid(1, 5), commit=ids.bid(1, 3))
+    inbox = msg_at(3, 1, MSG_APPEND, term=2, x=(2, 7), y=(2, 9), z=(1, 3))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.head.s) == 5  # unchanged
+    assert int(out.kind[1]) == MSG_APPEND_RESP and int(out.ok[1]) == 0
+    assert int(out.x.t[1]) == 1 and int(out.x.s[1]) == 3  # probe hint = commit
+
+
+def test_append_fork_recovery_from_commit():
+    # Dead-branch abandonment: span rooted at the follower's commit replaces
+    # a longer stale branch (Chained-Raft's "dead branches are GC'd" model,
+    # reference src/raft/mod.rs:8-23, done safely).
+    st = make_node(term=jnp.int32(2), head=ids.bid(1, 7), commit=ids.bid(1, 3))
+    inbox = msg_at(3, 1, MSG_APPEND, term=2, x=(1, 3), y=(2, 5), z=(2, 4))
+    st2, out, _ = step(st, inbox)
+    assert (int(st2.head.t), int(st2.head.s)) == (2, 5)
+    assert (int(st2.commit.t), int(st2.commit.s)) == (2, 4)
+    assert int(out.ok[1]) == 1
+
+
+def test_leader_commit_requires_quorum_and_current_term():
+    N = 5
+    member = jnp.ones((N,), bool)
+    # Leader at term 2, match row: self + 1 ack at head, others behind.
+    head = ids.bid(2, 10)
+    match = ids.full((N,))
+    match = ids.set_at(match, 0, head)
+    match = ids.set_at(match, 1, head)
+    st = make_node(N=N, role=jnp.int32(LEADER), term=jnp.int32(2), leader=jnp.int32(0),
+                   head=head, match=match, nxt=match)
+    st2, _, _ = step(st, N=N, member=member)
+    assert int(st2.commit.s) == 0  # 2 < quorum(3)
+    # Third ack arrives -> quorum -> commit.
+    inbox = msg_at(N, 2, MSG_APPEND_RESP, term=2, x=(2, 10), ok=1)
+    st3, _, met = step(st2, inbox, N=N, member=member)
+    assert (int(st3.commit.t), int(st3.commit.s)) == (2, 10)
+    assert int(met.commit_delta) == 10
+
+
+def test_leader_does_not_commit_old_term_blocks_directly():
+    # Raft §5.4.2 safety rule, applied via the term-major id.
+    N = 3
+    old = ids.bid(1, 10)
+    match = ids.Bid(t=jnp.full((N,), 1, jnp.int32), s=jnp.full((N,), 10, jnp.int32))
+    st = make_node(N=N, role=jnp.int32(LEADER), term=jnp.int32(2), leader=jnp.int32(0),
+                   head=old, match=match, nxt=match)
+    st2, _, _ = step(st)
+    assert int(st2.commit.s) == 0
+
+
+def test_append_response_advances_match_and_nxt():
+    st = make_node(role=jnp.int32(LEADER), term=jnp.int32(1), leader=jnp.int32(0),
+                   head=ids.bid(1, 5))
+    inbox = msg_at(3, 2, MSG_APPEND_RESP, term=1, x=(1, 4), ok=1)
+    st2, _, _ = step(st, inbox)
+    assert int(st2.match.s[2]) == 4
+    # Reject re-roots the send pointer: the same tick's outgoing AE probes
+    # from the hint (and the pointer then re-advances optimistically).
+    inbox = msg_at(3, 1, MSG_APPEND_RESP, term=1, x=(1, 2), ok=0)
+    st3, out, _ = step(st2, inbox)
+    assert int(out.kind[1]) == MSG_APPEND
+    assert (int(out.x.t[1]), int(out.x.s[1])) == (1, 2)
+    assert int(st3.nxt.s[1]) == 5  # re-advanced to head after sending
+
+
+# ------------------------------------------------------------ cluster-level
+
+def run_cluster(P, N, T, params=None, seed=0, props=None):
+    params = params or step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+    st, member = cr.init_state(P, N, base_seed=seed, params=params)
+    inbox = cr.empty_inbox(P, N)
+    props = jnp.zeros((P, N), jnp.int32) if props is None else props
+    mets = []
+    for _ in range(T):
+        st, inbox, met = cr.cluster_step(params, member, st, inbox, props)
+        mets.append(met)
+    return st, inbox, mets, member
+
+
+def test_cluster_elects_exactly_one_leader_per_partition():
+    st, _, _, _ = run_cluster(P=32, N=5, T=40)
+    roles = np.array(st.role)
+    assert (roles == LEADER).sum(axis=1).tolist() == [1] * 32
+    # Election safety: everyone agrees on the leader's identity.
+    leaders = np.array(st.leader)
+    for p in range(32):
+        lead = np.argmax(roles[p] == LEADER)
+        assert set(leaders[p]) == {lead}
+
+
+def test_single_node_partition_self_elects_and_commits():
+    # Reference election.rs:66-73 single-node special case (quorum hack not
+    # needed here: quorum(1) = 1 and the self-vote satisfies it).
+    params = step_params(timeout_min=2, timeout_max=4, hb_ticks=1, auto_proposals=3)
+    st, member = cr.init_state(1, 1, params=params)
+    inbox = cr.empty_inbox(1, 1)
+    props = jnp.zeros((1, 1), jnp.int32)
+    for _ in range(8):
+        st, inbox, _ = cr.cluster_step(params, member, st, inbox, props)
+    assert int(st.role[0, 0]) == LEADER
+    assert int(st.commit.s[0, 0]) >= 3
+
+
+def test_cluster_replicates_and_commits_proposals():
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=2)
+    st, _, mets, _ = run_cluster(P=8, N=3, T=50, params=params)
+    commit = np.array(st.commit.s)
+    head = np.array(st.head.s)
+    # Followers trail the leader by the pipeline latency only.
+    assert (commit.max(axis=1) > 30).all()
+    assert (head.max(axis=1) - head.min(axis=1) <= 6).all()
+    # Steady state: every follower accepts the mint rate per tick.
+    last = np.array(mets[-1].accepted_blocks).sum()
+    assert last == 8 * (3 - 1) * 2
+
+
+def test_crash_leader_triggers_reelection_and_recovery():
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=1)
+    st, member = cr.init_state(4, 5, base_seed=3, params=params)
+    inbox = cr.empty_inbox(4, 5)
+    props = jnp.zeros((4, 5), jnp.int32)
+    for _ in range(30):
+        st, inbox, _ = cr.cluster_step(params, member, st, inbox, props)
+    roles = np.array(st.role)
+    assert (roles == LEADER).sum(axis=1).tolist() == [1] * 4
+    leader_mask = jnp.asarray(roles == LEADER)
+    commit_before = np.array(st.commit.s).max(axis=1)
+    st = cr.crash(st, leader_mask)
+    for _ in range(40):
+        st, inbox, _ = cr.cluster_step(params, member, st, inbox, props)
+    roles2 = np.array(st.role)
+    alive = np.array(st.alive)
+    # A new leader among the 4 survivors, commit still advancing.
+    assert ((roles2 == LEADER) & alive).sum(axis=1).tolist() == [1] * 4
+    assert (np.array(st.commit.s).max(axis=1) > commit_before).all()
+    # Revive: old leader rejoins as follower and catches up.
+    st = cr.restart(st, leader_mask)
+    for _ in range(20):
+        st, inbox, _ = cr.cluster_step(params, member, st, inbox, props)
+    head = np.array(st.head.s)
+    assert (head.max(axis=1) - head.min(axis=1) <= 4).all()
+    assert ((np.array(st.role) == LEADER).sum(axis=1) == 1).all()
+
+
+def test_deterministic_given_seed():
+    a, _, _, _ = run_cluster(P=4, N=3, T=25, seed=11)
+    b, _, _, _ = run_cluster(P=4, N=3, T=25, seed=11)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.array(la), np.array(lb))
+
+
+def test_partial_membership_quorum():
+    # A 3-member group padded into an N=5 tensor row must use quorum 2.
+    P, N = 2, 5
+    member = jnp.zeros((P, N), bool).at[:, :3].set(True)
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=1)
+    st, member = cr.init_state(P, N, member=member, params=params)
+    inbox = cr.empty_inbox(P, N)
+    props = jnp.zeros((P, N), jnp.int32)
+    for _ in range(40):
+        st, inbox, _ = cr.cluster_step(params, member, st, inbox, props)
+    roles = np.array(st.role)
+    assert ((roles == LEADER) & np.array(member)).sum(axis=1).tolist() == [1] * P
+    assert (roles[~np.array(member)] == FOLLOWER).all()
+    assert (np.array(st.commit.s).max(axis=1) > 10).all()
