@@ -319,8 +319,7 @@ _over_nodes = jax.vmap(node_step, in_axes=(None, None, 0, 0, 0, 0))
 _over_parts = jax.vmap(_over_nodes, in_axes=(None, 0, None, 0, 0, 0))
 
 
-@functools.partial(jax.jit, donate_argnums=(2, 3))
-def cluster_step(
+def cluster_step_impl(
     params: StepParams,
     member: jnp.ndarray,   # bool (P, N)
     state: NodeState,      # leaves (P, N) / (P, N, N)
@@ -339,6 +338,44 @@ def cluster_step(
     st, out, met = _over_parts(params, member, me, state, inbox, proposals)
     next_inbox = jax.tree.map(lambda a: jnp.swapaxes(a, 1, 2), out)
     return st, next_inbox, met
+
+
+# Jitted entry: note state and inbox are DONATED — never reuse them after a
+# call (pass the returned ones forward).
+cluster_step = jax.jit(cluster_step_impl, donate_argnums=(2, 3))
+
+
+@functools.partial(
+    jax.jit, static_argnums=(5,), static_argnames=("ticks",), donate_argnums=(2, 3)
+)
+def run_ticks(
+    params: StepParams,
+    member: jnp.ndarray,
+    state: NodeState,
+    inbox: Msgs,
+    proposals: jnp.ndarray,
+    ticks: int,
+):
+    """Run ``ticks`` lockstep ticks under one ``lax.scan`` (one dispatch).
+
+    The same ``proposals`` array is re-offered EVERY tick (a sustained load
+    lane, like ``params.auto_proposals``) — this is a steady-state throughput
+    harness, not a one-shot submit; for a finite workload drive
+    :func:`cluster_step` tick by tick.
+
+    Returns (state', inbox', metrics) where each metrics leaf is a [ticks]
+    vector of per-tick cluster-wide sums (int32; sum on host in int64 for
+    long runs). This is the bench hot loop — no host round-trips between
+    ticks.
+    """
+
+    def body(carry, _):
+        st, ib = carry
+        st, ib, met = cluster_step_impl(params, member, st, ib, proposals)
+        return (st, ib), jax.tree.map(lambda a: jnp.sum(a, dtype=_I32), met)
+
+    (state, inbox), mets = jax.lax.scan(body, (state, inbox), None, length=ticks)
+    return state, inbox, mets
 
 
 def init_state(P: int, N: int, member: jnp.ndarray | None = None, base_seed: int = 0,

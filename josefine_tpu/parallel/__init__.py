@@ -1,0 +1,18 @@
+"""Scale-out: shard the (partitions, nodes) state tensor over a device mesh.
+
+The partition axis is embarrassingly parallel (independent Raft groups); the
+node axis is the interesting one — sharding it puts the members of one
+consensus group on *different chips*, and message delivery becomes an
+``all_to_all`` collective over ICI. This is the TPU-native replacement for
+the reference's full-mesh TCP transport (``src/raft/tcp.rs``) when groups
+are pod-sharded (BASELINE.md config 5).
+"""
+
+from josefine_tpu.parallel.sharded import (
+    make_mesh,
+    state_spec,
+    place,
+    make_sharded_cluster_step,
+)
+
+__all__ = ["make_mesh", "state_spec", "place", "make_sharded_cluster_step"]

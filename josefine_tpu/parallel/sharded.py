@@ -1,0 +1,130 @@
+"""shard_map'd cluster step over a ('p', 'n') mesh.
+
+Sharding layout:
+
+* ``'p'`` — partition axis: P independent Raft groups, no cross-shard
+  communication at all (pure data parallelism over consensus groups).
+* ``'n'`` — node axis: the N members of each group are split across chips.
+  Per-tick message delivery (``inbox[p, dst, src] = outbox[p, src, dst]``)
+  then requires moving each node's outgoing messages to the chip hosting the
+  destination node: exactly one ``lax.all_to_all`` over ``'n'`` per tick,
+  riding ICI. Vote tallies and quorum commit stay *local* to the chip that
+  hosts the candidate/leader (votes/acks were already delivered to it), so
+  no further collective is needed — the all_to_all is the entire
+  communication footprint of consensus.
+
+Parity note: this replaces the reference's cluster transport
+(``src/raft/tcp.rs`` JSON-over-TCP full mesh) for device-resident groups;
+host-side TCP remains for the Kafka surface and block payload transport
+(``josefine_tpu.raft.tcp``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import Msgs, NodeState, StepParams
+
+_I32 = jnp.int32
+
+
+def make_mesh(n_p: int, n_n: int = 1, devices=None) -> Mesh:
+    """A (n_p, n_n) mesh with axes ('p', 'n')."""
+    devices = jax.devices() if devices is None else devices
+    if len(devices) < n_p * n_n:
+        raise ValueError(f"need {n_p * n_n} devices, have {len(devices)}")
+    arr = np.array(devices[: n_p * n_n]).reshape(n_p, n_n)
+    return Mesh(arr, ("p", "n"))
+
+
+def _leaf_spec(a) -> P:
+    """(P, N) leaves shard over ('p','n'); (P, N, N) leaves shard the first
+    (dst) node axis only — the src axis indexes messages already delivered to
+    this chip."""
+    if a.ndim == 2:
+        return P("p", "n")
+    if a.ndim == 3:
+        return P("p", "n", None)
+    raise ValueError(f"unexpected leaf rank {a.ndim}")
+
+
+def state_spec(tree):
+    return jax.tree.map(_leaf_spec, tree)
+
+
+def place(tree, mesh: Mesh, spec=None):
+    """device_put each leaf with its NamedSharding."""
+    spec = state_spec(tree) if spec is None else spec
+    return jax.tree.map(
+        lambda a, s: jax.device_put(a, NamedSharding(mesh, s)), tree, spec
+    )
+
+
+def make_sharded_cluster_step(mesh: Mesh, N: int):
+    """Build a jitted step with cluster_step semantics over ``mesh``.
+
+    Signature matches :func:`josefine_tpu.models.chained_raft.cluster_step`:
+    ``(params, member, state, inbox, proposals) -> (state', inbox', metrics)``.
+    """
+    ns = mesh.shape["n"]
+    if N % ns:
+        raise ValueError(f"node count {N} not divisible by node shards {ns}")
+    nl = N // ns
+
+    def local_step(params, member, state, inbox, proposals):
+        # Local shapes: state leaves (pl, nl[, N]); member (pl, N);
+        # inbox (pl, nl_dst, N_src); proposals (pl, nl).
+        n_idx = jax.lax.axis_index("n")
+        me = (n_idx * nl + jnp.arange(nl)).astype(_I32)
+        over_nodes = jax.vmap(cr.node_step, in_axes=(None, None, 0, 0, 0, 0))
+        over_parts = jax.vmap(over_nodes, in_axes=(None, 0, None, 0, 0, 0))
+        st, out, met = over_parts(params, member, me, state, inbox, proposals)
+
+        # out leaves: (pl, nl_src, N_dst). Deliver: chunk the dst axis across
+        # node shards (all_to_all over ICI), then flip (src, dst) locally.
+        def deliver(a):
+            if ns > 1:
+                a = jax.lax.all_to_all(a, "n", split_axis=2, concat_axis=1, tiled=True)
+            # now (pl, N_src, nl_dst)
+            return jnp.swapaxes(a, 1, 2)
+
+        return st, jax.tree.map(deliver, out), met
+
+    # Build specs from abstract shapes.
+    pn = P("p", "n")
+    state_specs = NodeState(
+        term=pn, voted_for=pn, role=pn, leader=pn,
+        head=jax.tree.map(lambda _: pn, cr.ids.Bid(t=0, s=0)),
+        commit=jax.tree.map(lambda _: pn, cr.ids.Bid(t=0, s=0)),
+        elapsed=pn, timeout=pn, hb_elapsed=pn, alive=pn, seed=pn,
+        votes=P("p", "n", None),
+        match=cr.ids.Bid(t=P("p", "n", None), s=P("p", "n", None)),
+        nxt=cr.ids.Bid(t=P("p", "n", None), s=P("p", "n", None)),
+    )
+    msg_specs = Msgs(
+        kind=P("p", "n", None), term=P("p", "n", None),
+        x=cr.ids.Bid(t=P("p", "n", None), s=P("p", "n", None)),
+        y=cr.ids.Bid(t=P("p", "n", None), s=P("p", "n", None)),
+        z=cr.ids.Bid(t=P("p", "n", None), s=P("p", "n", None)),
+        ok=P("p", "n", None),
+    )
+    params_spec = StepParams(
+        timeout_min=P(), timeout_max=P(), hb_ticks=P(), auto_proposals=P()
+    )
+    met_specs = jax.tree.map(lambda _: pn, cr.Metrics(
+        accepted_blocks=0, accepted_msgs=0, minted=0, commit_delta=0, became_leader=0))
+
+    member_spec = P("p", None)
+    stepped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(params_spec, member_spec, state_specs, msg_specs, pn),
+        out_specs=(state_specs, msg_specs, met_specs),
+        check_vma=False,
+    )
+    return jax.jit(stepped, donate_argnums=(2, 3))

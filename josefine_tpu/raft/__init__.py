@@ -1,0 +1,27 @@
+"""Host-side Raft runtime.
+
+Division of labor (the north-star split): the device kernel
+(:mod:`josefine_tpu.models.chained_raft`) owns all fixed-width consensus
+metadata — terms, votes, roles, head/commit ids, quorum math. This package
+owns everything variable-length and durable around it:
+
+* :mod:`josefine_tpu.raft.chain` — the block DAG with payloads, commit
+  pointer, dead-branch GC (reference ``src/raft/chain.rs``).
+* :mod:`josefine_tpu.raft.fsm` — Fsm protocol + driver with the
+  Notify/Apply split (reference ``src/raft/fsm.rs``).
+* :mod:`josefine_tpu.raft.engine` — the per-node bridge: encodes received
+  wire messages into inbox tensors, steps the device kernel, decodes the
+  outbox into wire messages with payload spans attached, applies newly
+  committed blocks to the FSM (replaces the reference's role structs).
+* :mod:`josefine_tpu.raft.server` — the asyncio event loop: tick timer,
+  transport, client proposals (reference ``src/raft/server.rs``).
+* :mod:`josefine_tpu.raft.tcp` — full-mesh JSON-frame transport
+  (reference ``src/raft/tcp.rs``).
+* :mod:`josefine_tpu.raft.client` — in-process propose() handle
+  (reference ``src/raft/client.rs``).
+"""
+
+from josefine_tpu.raft.chain import Block, Chain
+from josefine_tpu.raft.fsm import Fsm, Driver
+
+__all__ = ["Block", "Chain", "Fsm", "Driver"]

@@ -1,0 +1,368 @@
+"""RaftEngine: the per-node bridge between host runtime and device kernel.
+
+One engine instance is **one node of every consensus group** in the cluster
+(the node axis row of the (partitions x nodes) tensor that lives on this
+host). Per tick it:
+
+1. encodes received wire messages into the (P, N_src) inbox tensor
+   (one slot per (group, src); extras carry over to the next tick),
+2. steps the jitted per-node kernel (vmapped over groups),
+3. mirrors device decisions into durable host state — minted blocks are
+   appended to the chain with their payloads, accepted spans extend it,
+   commit advancement applies blocks to the FSM driver and resolves
+   waiting client futures,
+4. decodes the outbox into wire messages, attaching payload spans to
+   AppendEntries from the chain.
+
+This replaces the reference's role structs + event-loop state
+(``src/raft/mod.rs:326-489``, ``src/raft/server.rs:103-165``): the role
+machine itself runs on device; the host only moves payloads and durability.
+
+Invariant: an AppendEntries only reaches the device if its payload span was
+validated against its (x, y] claim (rpc.span_is_valid), so "device accepted"
+always implies "host can extend the chain".
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import (
+    LEADER,
+    Msgs,
+    NodeState,
+    StepParams,
+    empty_msgs,
+    step_params,
+)
+from josefine_tpu.ops import ids
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.chain import Chain, pack_id, id_term, id_seq
+from josefine_tpu.raft.fsm import Driver, Fsm
+from josefine_tpu.utils.kv import KV
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.engine")
+
+_I32 = jnp.int32
+
+
+class NotLeader(Exception):
+    """Raised into proposal futures when this node cannot mint; carries the
+    current leader hint for the server to re-route (reference proxy path,
+    ``src/raft/follower.rs:258-269``)."""
+
+    def __init__(self, group: int, leader: int):
+        super().__init__(f"not leader of group {group}; leader hint {leader}")
+        self.group = group
+        self.leader = leader
+
+
+@dataclass
+class TickResult:
+    outbound: list[rpc.WireMsg] = field(default_factory=list)
+    committed: dict[int, int] = field(default_factory=dict)  # group -> new commit id
+    became_leader: list[int] = field(default_factory=list)
+    lost_leadership: list[int] = field(default_factory=list)
+
+
+def _node_view(state: NodeState, me: int) -> NodeState:
+    """Slice one node's row out of a (P, N) cluster state."""
+    return jax.tree.map(lambda a: a[:, me], state)
+
+
+# One-node step, vmapped over groups.
+_node_over_groups = jax.jit(
+    jax.vmap(cr.node_step, in_axes=(None, 0, None, 0, 0, 0)),
+    donate_argnums=(3, 4),
+)
+
+
+class RaftEngine:
+    """Device-backed consensus engine for one node across P groups."""
+
+    def __init__(
+        self,
+        kv: KV,
+        node_ids: list[int],
+        self_id: int,
+        groups: int = 1,
+        fsms: dict[int, Fsm] | None = None,
+        params: StepParams | None = None,
+        base_seed: int = 0,
+    ):
+        self.kv = kv
+        self.node_ids = sorted(node_ids)
+        if self_id not in self.node_ids:
+            raise ValueError(f"self id {self_id} not in node_ids {node_ids}")
+        self.me = self.node_ids.index(self_id)
+        self.self_id = self_id
+        self.P = groups
+        self.N = len(self.node_ids)
+        self.params = params or step_params()
+        if int(self.params.auto_proposals) != 0:
+            # The auto-proposal lane is a bench-only device feature; the
+            # engine mints exactly the payloads it holds, so the two must
+            # agree block-for-block.
+            raise ValueError("RaftEngine requires params.auto_proposals == 0")
+
+        self.chains = [Chain(kv, prefix=b"g%d:" % g) for g in range(groups)]
+        self.drivers = {g: Driver(fsm) for g, fsm in (fsms or {}).items()}
+
+        full, member = cr.init_state(groups, self.N, base_seed=base_seed, params=self.params)
+        self.member = member  # (P, N)
+        st = _node_view(full, self.me)
+        # Durable recovery: chain head/commit + persisted term/voted_for
+        # (fixing the reference's volatile-term restart, SURVEY.md aux notes).
+        heads_t, heads_s, commits_t, commits_s, terms, voted = [], [], [], [], [], []
+        for g, ch in enumerate(self.chains):
+            heads_t.append(id_term(ch.head)); heads_s.append(id_seq(ch.head))
+            commits_t.append(id_term(ch.committed)); commits_s.append(id_seq(ch.committed))
+            terms.append(max(self._load_meta(g, b"term"), id_term(ch.head)))
+            voted.append(self._load_meta(g, b"voted", -1))
+        self.state = st.replace(
+            head=ids.Bid(jnp.asarray(heads_t, _I32), jnp.asarray(heads_s, _I32)),
+            commit=ids.Bid(jnp.asarray(commits_t, _I32), jnp.asarray(commits_s, _I32)),
+            term=jnp.asarray(terms, _I32),
+            voted_for=jnp.asarray(voted, _I32),
+        )
+        # Host mirrors (numpy) for fast per-tick diffing.
+        self._h_term = np.asarray(terms, np.int64)
+        self._h_voted = np.asarray(voted, np.int64)
+        self._h_role = np.zeros(groups, np.int64)
+        self._h_leader = np.full(groups, -1, np.int64)
+
+        self._pending_msgs: list[rpc.WireMsg] = []
+        self._proposals: dict[int, list[tuple[bytes, asyncio.Future | None]]] = {}
+
+    # ------------------------------------------------------------ intake
+
+    def receive(self, msg: rpc.WireMsg) -> None:
+        """Queue a consensus wire message for the next tick. Malformed AE
+        spans are dropped here (see module invariant)."""
+        if msg.kind not in (rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND, rpc.MSG_APPEND_RESP):
+            raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
+        if not msg.span_is_valid():
+            log.warning("dropping AE with invalid span g=%d src=%d", msg.group, msg.src)
+            return
+        if not (0 <= msg.group < self.P) or not (0 <= msg.src < self.N):
+            log.warning("dropping message for unknown group/node g=%d src=%d", msg.group, msg.src)
+            return
+        self._pending_msgs.append(msg)
+
+    def propose(self, group: int, payload: bytes) -> asyncio.Future:
+        """Submit a client payload; resolves with the FSM result once the
+        block commits (reference ``RaftClient::propose`` semantics end to
+        end). Fails with NotLeader if this node cannot mint at tick time."""
+        fut = asyncio.get_running_loop().create_future()
+        self._proposals.setdefault(group, []).append((payload, fut))
+        return fut
+
+    # -------------------------------------------------------------- tick
+
+    def tick(self) -> TickResult:
+        inbox, staged, deferred = self._build_inbox()
+        prop_counts = np.zeros(self.P, np.int32)
+        for g, lst in self._proposals.items():
+            prop_counts[g] = len(lst)
+
+        old_head = {g: ch.head for g, ch in enumerate(self.chains)}
+
+        new_state, outbox, metrics = _node_over_groups(
+            self.params,
+            self.member,
+            jnp.asarray(self.me, _I32),
+            self.state,
+            inbox,
+            jnp.asarray(prop_counts),
+        )
+        self.state = new_state
+        self._pending_msgs = deferred
+
+        # Host-side mirror of device decisions.
+        h = lambda a: np.asarray(a)
+        n_term = h(new_state.term); n_voted = h(new_state.voted_for)
+        n_role = h(new_state.role); n_leader = h(new_state.leader)
+        n_head_t = h(new_state.head.t); n_head_s = h(new_state.head.s)
+        n_commit_t = h(new_state.commit.t); n_commit_s = h(new_state.commit.s)
+        minted = h(metrics.minted); became = h(metrics.became_leader)
+
+        res = TickResult()
+        for g in range(self.P):
+            ch = self.chains[g]
+            new_head = pack_id(int(n_head_t[g]), int(n_head_s[g]))
+
+            # Leadership transitions.
+            if became[g]:
+                res.became_leader.append(g)
+                ch.append(int(n_term[g]), b"")  # the no-op liveness block
+            was_leader = self._h_role[g] == LEADER
+            if was_leader and n_role[g] != LEADER:
+                res.lost_leadership.append(g)
+                drv = self.drivers.get(g)
+                if drv:
+                    drv.drop_waiters(NotLeader(g, int(n_leader[g])))
+
+            # Minted payload blocks (leader): mirror device ids exactly.
+            queue = self._proposals.get(g, [])
+            if minted[g]:
+                if minted[g] != len(queue):
+                    raise RuntimeError(
+                        f"device minted {minted[g]} blocks but host holds "
+                        f"{len(queue)} payloads (group {g})"
+                    )
+                for payload, fut in queue:
+                    blk = ch.append(int(n_term[g]), payload)
+                    drv = self.drivers.get(g)
+                    if fut is not None:
+                        if drv is not None:
+                            drv.notify(blk.id, fut)
+                        else:
+                            fut.set_result(b"")
+                self._proposals[g] = []
+            elif queue:
+                for _, fut in queue:
+                    if fut is not None and not fut.done():
+                        fut.set_exception(NotLeader(g, int(n_leader[g])))
+                self._proposals[g] = []
+
+            # Accepted spans (follower): reconcile the chain to the device's
+            # new head by walking parent pointers through the staged blocks.
+            # This is robust to several AEs landing in one tick: only the
+            # branch the device actually adopted is persisted.
+            if new_head != old_head[g] and not minted[g] and not became[g]:
+                by_id = {b.id: b for b in staged.get(g, [])}
+                path = []
+                cur = new_head
+                while not ch.has(cur):
+                    blk = by_id.get(cur)
+                    if blk is None:
+                        raise RuntimeError(
+                            f"chain/device divergence g={g}: no payload for {cur:#x}"
+                        )
+                    path.append(blk)
+                    cur = blk.parent
+                for blk in reversed(path):
+                    ch.extend(blk)
+                if ch.head != new_head:
+                    ch.force_head(new_head)
+
+            # Commit advancement -> FSM apply (half-open (old, new], every node).
+            new_commit = pack_id(int(n_commit_t[g]), int(n_commit_s[g]))
+            if new_commit != ch.committed:
+                blocks = ch.commit(new_commit)
+                res.committed[g] = new_commit
+                drv = self.drivers.get(g)
+                if drv:
+                    drv.apply(blocks)
+
+            # Durable volatile state (term / voted_for).
+            if n_term[g] != self._h_term[g]:
+                self._store_meta(g, b"term", int(n_term[g]))
+            if n_voted[g] != self._h_voted[g]:
+                self._store_meta(g, b"voted", int(n_voted[g]))
+
+        self._h_term = n_term.astype(np.int64)
+        self._h_voted = n_voted.astype(np.int64)
+        self._h_role = n_role.astype(np.int64)
+        self._h_leader = n_leader.astype(np.int64)
+
+        res.outbound = self._decode_outbox(outbox)
+        return res
+
+    # ------------------------------------------------------------ lookups
+
+    def is_leader(self, group: int = 0) -> bool:
+        return self._h_role[group] == LEADER
+
+    def leader_index(self, group: int = 0) -> int:
+        return int(self._h_leader[group])
+
+    def leader_id(self, group: int = 0) -> int | None:
+        idx = self.leader_index(group)
+        return self.node_ids[idx] if 0 <= idx < self.N else None
+
+    def term(self, group: int = 0) -> int:
+        return int(self._h_term[group])
+
+    # ------------------------------------------------------------ helpers
+
+    def _load_meta(self, g: int, key: bytes, default: int = 0) -> int:
+        raw = self.kv.get(b"g%d:vol:%s" % (g, key))
+        return default if raw is None else int.from_bytes(raw, "big", signed=True)
+
+    def _store_meta(self, g: int, key: bytes, value: int) -> None:
+        self.kv.put(b"g%d:vol:%s" % (g, key), value.to_bytes(8, "big", signed=True))
+
+    def _build_inbox(self) -> tuple[Msgs, dict[int, list], list[rpc.WireMsg]]:
+        """Pack queued wire messages into the (P, N_src) inbox; one message
+        per (group, src) slot per tick (the reference's bounded per-peer
+        queue with carry-over instead of silent drop, src/raft/tcp.rs:63)."""
+        kind = np.zeros((self.P, self.N), np.int32)
+        term = np.zeros((self.P, self.N), np.int32)
+        xt = np.zeros((self.P, self.N), np.int32); xs = np.zeros((self.P, self.N), np.int32)
+        yt = np.zeros((self.P, self.N), np.int32); ys = np.zeros((self.P, self.N), np.int32)
+        zt = np.zeros((self.P, self.N), np.int32); zs = np.zeros((self.P, self.N), np.int32)
+        ok = np.zeros((self.P, self.N), np.int32)
+        staged: dict[int, list] = {}
+        deferred: list[rpc.WireMsg] = []
+        for m in self._pending_msgs:
+            g, s = m.group, m.src
+            if kind[g, s] != rpc.MSG_NONE:
+                deferred.append(m)
+                continue
+            kind[g, s] = m.kind
+            term[g, s] = m.term
+            xt[g, s], xs[g, s] = id_term(m.x), id_seq(m.x)
+            yt[g, s], ys[g, s] = id_term(m.y), id_seq(m.y)
+            zt[g, s], zs[g, s] = id_term(m.z), id_seq(m.z)
+            ok[g, s] = m.ok
+            if m.kind == rpc.MSG_APPEND and m.blocks:
+                staged.setdefault(g, []).extend(m.blocks)
+        j = jnp.asarray
+        inbox = Msgs(
+            kind=j(kind), term=j(term),
+            x=ids.Bid(j(xt), j(xs)), y=ids.Bid(j(yt), j(ys)), z=ids.Bid(j(zt), j(zs)),
+            ok=j(ok),
+        )
+        return inbox, staged, deferred
+
+    def _decode_outbox(self, outbox: Msgs) -> list[rpc.WireMsg]:
+        h = lambda a: np.asarray(a)
+        kind = h(outbox.kind)
+        if not kind.any():
+            return []
+        term = h(outbox.term); okf = h(outbox.ok)
+        xt = h(outbox.x.t); xs = h(outbox.x.s)
+        yt = h(outbox.y.t); ys = h(outbox.y.s)
+        zt = h(outbox.z.t); zs = h(outbox.z.s)
+        out: list[rpc.WireMsg] = []
+        for g, dst in zip(*np.nonzero(kind)):
+            g, dst = int(g), int(dst)
+            m = rpc.WireMsg(
+                kind=int(kind[g, dst]), group=g, src=self.me, dst=dst,
+                term=int(term[g, dst]),
+                x=pack_id(int(xt[g, dst]), int(xs[g, dst])),
+                y=pack_id(int(yt[g, dst]), int(ys[g, dst])),
+                z=pack_id(int(zt[g, dst]), int(zs[g, dst])),
+                ok=int(okf[g, dst]),
+            )
+            if m.kind == rpc.MSG_APPEND and m.y != m.x:
+                try:
+                    m.blocks = self.chains[g].range(m.x, m.y)
+                except Exception:
+                    # Can't materialize the span (e.g. probe pointer on a
+                    # branch we no longer hold): send a pure heartbeat at the
+                    # probe point instead; the follower's reject hint will
+                    # re-root us.
+                    log.warning("span (%#x, %#x] unavailable g=%d; heartbeat only", m.x, m.y, g)
+                    m.y = m.x
+                    m.z = min(m.z, m.x)
+            out.append(m)
+        return out

@@ -1,0 +1,69 @@
+"""FSM protocol and driver: decouple commit from apply.
+
+Parity: reference ``src/raft/fsm.rs`` — the ``Fsm`` trait (:15-17), the
+``Instruction::{Apply, Notify}`` split (:20-29), skipping payload-less
+blocks (genesis/no-op, :61-63), and routing the FSM result back to the
+awaiting client through a notification map (:64-81).
+
+Delta (deliberate, SURVEY.md quirk 7b): the engine hands the driver the
+half-open committed range ``(old, new]`` on **every** node, so each block is
+applied exactly once everywhere — the reference's follower path re-applies
+the old commit block and skips the new one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Protocol
+
+from josefine_tpu.raft.chain import Block
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.fsm")
+
+
+class Fsm(Protocol):
+    """Apply one committed payload, return the response bytes.
+
+    Must be deterministic: every node applies the same committed sequence.
+    """
+
+    def transition(self, data: bytes) -> bytes: ...
+
+
+class Driver:
+    """Applies committed blocks to the FSM and resolves client futures.
+
+    ``notify(block_id, future)`` registers interest (leader side, at propose
+    time); ``apply(blocks)`` runs transitions and fulfills any registered
+    future with the FSM's result (the Notify/Apply correlation of reference
+    fsm.rs:64-81).
+    """
+
+    def __init__(self, fsm: Fsm):
+        self.fsm = fsm
+        self._waiters: dict[int, asyncio.Future] = {}
+
+    def notify(self, block_id: int, fut: asyncio.Future) -> None:
+        self._waiters[block_id] = fut
+
+    def drop_waiters(self, exc: Exception | None = None) -> None:
+        """On leadership loss: fail outstanding proposals so clients retry
+        (the reference leaks these — SURVEY.md quirk 6)."""
+        for fut in self._waiters.values():
+            if not fut.done():
+                if exc is None:
+                    fut.cancel()
+                else:
+                    fut.set_exception(exc)
+        self._waiters.clear()
+
+    def apply(self, blocks: list[Block]) -> None:
+        for blk in blocks:
+            if not blk.data:  # genesis / no-op blocks carry no payload
+                result = b""
+            else:
+                result = self.fsm.transition(blk.data)
+            fut = self._waiters.pop(blk.id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(result)

@@ -1,0 +1,95 @@
+"""Wire message types for the cluster transport.
+
+Parity: reference ``src/raft/rpc.rs`` (``Message{from,to,command}`` with
+logical ``Address``es) — here flattened to explicit (group, src, dst) node
+indices because one process hosts one node of *many* consensus groups (the
+(partitions x nodes) tensor), not one group.
+
+Consensus messages (VOTE_REQ/VOTE_RESP/APPEND/APPEND_RESP) mirror the device
+tensor fields exactly; AE additionally carries the variable-length payload
+span (the host-side half of the north-star split). CLIENT_REQ/CLIENT_RESP
+implement follower->leader proposal proxying (reference
+``src/raft/follower.rs:258-282``); they never touch the device.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+
+from josefine_tpu.raft.chain import Block
+
+# Device message kinds: single source of truth is the device model.
+from josefine_tpu.models.types import (  # noqa: E402
+    MSG_NONE,
+    MSG_VOTE_REQ,
+    MSG_VOTE_RESP,
+    MSG_APPEND,
+    MSG_APPEND_RESP,
+)
+
+# Host-only kinds (never enter the device inbox).
+MSG_CLIENT_REQ = 10
+MSG_CLIENT_RESP = 11
+
+
+@dataclass
+class WireMsg:
+    kind: int
+    group: int = 0
+    src: int = 0          # sender node index
+    dst: int = 0          # destination node index
+    term: int = 0
+    x: int = 0            # packed block id (see chain.pack_id)
+    y: int = 0
+    z: int = 0
+    ok: int = 0
+    blocks: list[Block] = field(default_factory=list)  # AE payload span (x, y]
+    req_id: str = ""      # CLIENT_* correlation
+    payload: bytes = b""  # CLIENT_* body
+
+    def encode(self) -> bytes:
+        d = {
+            "k": self.kind, "g": self.group, "s": self.src, "d": self.dst,
+            "t": self.term, "x": self.x, "y": self.y, "z": self.z, "o": self.ok,
+        }
+        if self.blocks:
+            d["b"] = [
+                [b.id, b.parent, base64.b64encode(b.data).decode()] for b in self.blocks
+            ]
+        if self.req_id:
+            d["r"] = self.req_id
+        if self.payload:
+            d["p"] = base64.b64encode(self.payload).decode()
+        return json.dumps(d, separators=(",", ":")).encode()
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "WireMsg":
+        d = json.loads(raw)
+        return cls(
+            kind=d["k"], group=d.get("g", 0), src=d.get("s", 0), dst=d.get("d", 0),
+            term=d.get("t", 0), x=d.get("x", 0), y=d.get("y", 0), z=d.get("z", 0),
+            ok=d.get("o", 0),
+            blocks=[
+                Block(id=i, parent=p, data=base64.b64decode(data))
+                for i, p, data in d.get("b", [])
+            ],
+            req_id=d.get("r", ""),
+            payload=base64.b64decode(d["p"]) if "p" in d else b"",
+        )
+
+    def span_is_valid(self) -> bool:
+        """An AE's payload must be a parent-linked chain from x to y; a
+        malformed span is dropped before it can reach the device (keeps the
+        device-accepts => host-can-extend invariant)."""
+        if self.kind != MSG_APPEND:
+            return True
+        if self.x == self.y:
+            return not self.blocks  # pure heartbeat
+        prev = self.x
+        for b in self.blocks:
+            if b.parent != prev:
+                return False
+            prev = b.id
+        return prev == self.y

@@ -1,0 +1,127 @@
+"""Embedded durable key-value store.
+
+The reference leans on ``sled`` (an embedded KV) for both the Raft chain and
+the broker metadata store (``src/raft/chain.rs``, ``src/broker/state/
+mod.rs``). Python has no sled; the equivalent embedded, durable,
+native-performance store in this image is sqlite3 (C library, WAL mode).
+The interface is deliberately sled-shaped: get/put/delete/scan-prefix.
+
+``MemKV`` backs unit tests (the reference uses tempdir sled instances;
+in-memory is the same seam with less I/O).
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Iterator
+
+
+class KV:
+    """Interface: bytes -> bytes with prefix scans."""
+
+    def get(self, key: bytes) -> bytes | None:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemKV(KV):
+    def __init__(self):
+        self._d: dict[bytes, bytes] = {}
+
+    def get(self, key):
+        return self._d.get(key)
+
+    def put(self, key, value):
+        self._d[key] = bytes(value)
+
+    def delete(self, key):
+        self._d.pop(key, None)
+
+    def scan_prefix(self, prefix):
+        for k in sorted(self._d):
+            if k.startswith(prefix):
+                yield k, self._d[k]
+
+
+class SqliteKV(KV):
+    """Durable store: one table, WAL journaling, safe for one writer thread
+    per connection (the engine's tick loop is single-threaded, like the
+    reference's actor-owned sled handles)."""
+
+    def __init__(self, path: str | os.PathLike):
+        path = os.fspath(path)
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute("CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)")
+        self._db.commit()
+
+    def get(self, key):
+        with self._lock:
+            row = self._db.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return None if row is None else row[0]
+
+    def put(self, key, value):
+        with self._lock:
+            self._db.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, bytes(value)),
+            )
+            self._db.commit()
+
+    def delete(self, key):
+        with self._lock:
+            self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._db.commit()
+
+    def scan_prefix(self, prefix):
+        # True prefix upper bound: increment the last non-0xff byte and
+        # truncate (an all-0xff prefix has no upper bound -> scan to end).
+        hi = None
+        for i in range(len(prefix) - 1, -1, -1):
+            if prefix[i] != 0xFF:
+                hi = prefix[:i] + bytes([prefix[i] + 1])
+                break
+        with self._lock:
+            if hi is None:
+                rows = self._db.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (prefix,)
+                ).fetchall()
+            else:
+                rows = self._db.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                    (prefix, hi),
+                ).fetchall()
+        yield from rows
+
+    def flush(self):
+        with self._lock:
+            self._db.commit()
+
+    def close(self):
+        with self._lock:
+            self._db.close()
+
+
+def open_kv(path: str | None) -> KV:
+    """None -> in-memory (tests); path -> durable sqlite."""
+    return MemKV() if path is None else SqliteKV(path)

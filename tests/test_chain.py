@@ -1,0 +1,92 @@
+"""Chain store tests (reference ``src/raft/chain.rs:256-351`` test parity)."""
+
+import pytest
+
+from josefine_tpu.raft.chain import Block, Chain, ChainError, GENESIS, pack_id
+from josefine_tpu.utils.kv import MemKV, SqliteKV
+
+
+def test_genesis_init_and_reopen(tmp_path):
+    kv = SqliteKV(tmp_path / "chain.db")
+    ch = Chain(kv)
+    assert ch.head == GENESIS and ch.committed == GENESIS
+    b1 = ch.append(1, b"a")
+    b2 = ch.append(1, b"b")
+    ch.commit(b1.id)
+    # Reopen: head/commit persisted (reference chain.rs:117-137).
+    ch2 = Chain(SqliteKV(tmp_path / "chain.db"))
+    assert ch2.head == b2.id
+    assert ch2.committed == b1.id
+    assert ch2.get(b2.id).data == b"b"
+
+
+def test_append_monotone_ids():
+    ch = Chain(MemKV())
+    b1 = ch.append(2, b"x")
+    assert b1.term == 2 and b1.seq == 1
+    b2 = ch.append(2, b"y")
+    assert b2.parent == b1.id
+
+
+def test_extend_requires_parent():
+    ch = Chain(MemKV())
+    orphan = Block(id=pack_id(1, 5), parent=pack_id(1, 4), data=b"")
+    with pytest.raises(ChainError):
+        ch.extend(orphan)
+
+
+def test_extend_fork_choice_term_major():
+    ch = Chain(MemKV())
+    b1 = ch.append(1, b"a")
+    dead = ch.append(1, b"dead")  # will become a dead branch
+    # New leader's branch from b1 at term 2: same seq as dead, higher term.
+    winner = Block(id=pack_id(2, 2), parent=b1.id, data=b"win")
+    ch.extend(winner)
+    assert ch.head == winner.id
+    # A late-arriving dead-branch block must not regress head.
+    late = Block(id=pack_id(1, 3), parent=dead.id, data=b"late")
+    ch.extend(late)
+    assert ch.head == winner.id
+
+
+def test_commit_returns_half_open_range():
+    ch = Chain(MemKV())
+    b1 = ch.append(1, b"a")
+    b2 = ch.append(1, b"b")
+    b3 = ch.append(1, b"c")
+    got = ch.commit(b2.id)
+    assert [b.data for b in got] == [b"a", b"b"]
+    # Second commit applies only the delta — no re-application (fixes the
+    # reference follower off-by-one, SURVEY.md quirk 7b).
+    got = ch.commit(b3.id)
+    assert [b.data for b in got] == [b"c"]
+    with pytest.raises(ChainError):
+        ch.commit(b1.id)  # regress
+    with pytest.raises(ChainError):
+        ch.commit(pack_id(9, 9))  # unknown -> error, not panic
+
+
+def test_range_walks_branch():
+    ch = Chain(MemKV())
+    b1 = ch.append(1, b"a")
+    ch.append(1, b"dead")
+    w2 = Block(id=pack_id(2, 2), parent=b1.id, data=b"w2")
+    w3 = Block(id=pack_id(2, 3), parent=w2.id, data=b"w3")
+    ch.extend(w2)
+    ch.extend(w3)
+    # Range follows parent pointers of the live branch, skipping the dead one.
+    assert [b.data for b in ch.range(b1.id, w3.id)] == [b"w2", b"w3"]
+
+
+def test_compact_gc_dead_branches():
+    # Reference chain.rs:328-343: forked DAG, dead branch GC'd.
+    ch = Chain(MemKV())
+    b1 = ch.append(1, b"a")
+    dead = ch.append(1, b"dead")
+    w2 = Block(id=pack_id(2, 2), parent=b1.id, data=b"w2")
+    ch.extend(w2)
+    ch.commit(w2.id)
+    removed = ch.compact()
+    assert removed == 1
+    assert not ch.has(dead.id)
+    assert ch.has(b1.id) and ch.has(w2.id)
