@@ -1,0 +1,18 @@
+"""In-process Raft client handle.
+
+Parity: reference ``src/raft/client.rs:26-38`` — ``propose(Vec<u8>) ->
+Vec<u8>`` over an mpsc + oneshot pair. Here the "channel" is a direct
+reference to the server's propose coroutine; the await IS the oneshot.
+"""
+
+from __future__ import annotations
+
+
+class RaftClient:
+    def __init__(self, server):
+        self._server = server
+
+    async def propose(self, payload: bytes, group: int = 0, timeout: float = 5.0) -> bytes:
+        """Submit a state-machine transition; resolves with the FSM result
+        once committed (routing through the current leader transparently)."""
+        return await self._server.propose(payload, group=group, timeout=timeout)

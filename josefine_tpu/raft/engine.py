@@ -220,7 +220,7 @@ class RaftEngine:
                 for payload, fut in queue:
                     blk = ch.append(int(n_term[g]), payload)
                     drv = self.drivers.get(g)
-                    if fut is not None:
+                    if fut is not None and not fut.done():
                         if drv is not None:
                             drv.notify(blk.id, fut)
                         else:

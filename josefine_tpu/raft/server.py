@@ -1,0 +1,229 @@
+"""The Raft server: tick loop + transport + proposal routing.
+
+Parity: reference ``src/raft/server.rs`` — bind listener, spawn tcp
+send/recv + fsm driver + event loop (:48-100), 100 ms tick (:25), select
+over {tick, peer messages, client proposals} (:120-161), client-request
+correlation map (:115-118).
+
+The big structural difference: there is no role state here at all. The
+event loop's only jobs are (a) calling ``engine.tick()`` on the cadence and
+moving wire messages between the transport and the engine, and (b) routing
+client proposals to whichever node currently leads (the reference's
+follower proxy path, ``follower.rs:258-282``, with an explicit correlation
+map instead of the reference's leaky dangling-oneshot scheme — SURVEY.md
+quirk 6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+
+from josefine_tpu.config import RaftConfig
+from josefine_tpu.models.types import StepParams, step_params
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.engine import NotLeader, RaftEngine
+from josefine_tpu.raft.fsm import Fsm
+from josefine_tpu.raft.tcp import Transport
+from josefine_tpu.utils.kv import KV
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.server")
+
+
+class ProposalTimeout(Exception):
+    pass
+
+
+class JosefineRaft:
+    """One node's Raft runtime (reference ``JosefineRaft::new + run``,
+    ``src/raft/mod.rs:78-133``)."""
+
+    def __init__(
+        self,
+        config: RaftConfig,
+        kv: KV,
+        fsms: dict[int, Fsm],
+        groups: int = 1,
+        params: StepParams | None = None,
+        shutdown: Shutdown | None = None,
+    ):
+        self.config = config
+        self.shutdown = shutdown or Shutdown()
+        node_ids = [config.id] + [n.id for n in config.nodes]
+        self.engine = RaftEngine(
+            kv,
+            node_ids,
+            config.id,
+            groups=groups,
+            fsms=fsms,
+            params=params
+            or step_params(
+                timeout_min=max(2, config.election_timeout_min_ms // config.tick_ms),
+                timeout_max=max(3, config.election_timeout_max_ms // config.tick_ms),
+                hb_ticks=max(1, config.heartbeat_timeout_ms // config.tick_ms),
+            ),
+            base_seed=config.id,
+        )
+        addr_by_id = {n.id: n.addr for n in config.nodes}
+        self.transport = Transport(
+            config.id,
+            (config.ip, config.port),
+            addr_by_id,
+            self._on_message,
+            self.shutdown,
+        )
+        self._inbound_client: list[rpc.WireMsg] = []
+        self._forwarded: dict[str, asyncio.Future] = {}
+        # Leader-side dedup of forwarded requests: req_id -> in-flight future
+        # or cached result, so a follower's re-forward of the same request
+        # (after a response was lost/slow) does not mint a second block.
+        self._served: dict[str, asyncio.Future] = {}
+        self._bg_tasks: set[asyncio.Task] = set()
+        self._tick_task: asyncio.Task | None = None
+        self.bound_addr: tuple[str, int] | None = None
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self.bound_addr = await self.transport.start()
+        self._tick_task = asyncio.create_task(self._tick_loop())
+
+    async def run(self) -> None:
+        """Start and block until shutdown (reference run() semantics)."""
+        await self.start()
+        await self.shutdown.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        self.shutdown.shutdown()
+        if self._tick_task:
+            self._tick_task.cancel()
+            await asyncio.gather(self._tick_task, return_exceptions=True)
+        await self.transport.stop()
+
+    # ------------------------------------------------------------ proposals
+
+    async def propose(self, payload: bytes, group: int = 0, timeout: float = 5.0) -> bytes:
+        """Propose with leader routing: try locally; on NotLeader forward to
+        the hinted leader and await its CLIENT_RESP; retry across leader
+        churn until ``timeout``.
+
+        Semantics are at-least-once across *leader failover* (as in the
+        reference); within one call, re-forwards reuse a stable request id
+        and the serving leader dedups on it, so a slow or lost response does
+        not mint a duplicate block.
+        """
+        deadline = asyncio.get_running_loop().time() + timeout
+        req_id = uuid.uuid4().hex  # stable across retries of this call
+        while True:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise ProposalTimeout(f"propose timed out after {timeout}s")
+            try:
+                fut = self.engine.propose(group, payload)
+                return await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                raise ProposalTimeout(f"propose timed out after {timeout}s")
+            except NotLeader:
+                leader_id = self.engine.leader_id(group)
+                if leader_id is None or leader_id == self.config.id:
+                    await asyncio.sleep(self.config.tick_ms / 1000)
+                    continue
+                try:
+                    return await self._forward(group, payload, leader_id, remaining, req_id)
+                except (ProposalTimeout, asyncio.TimeoutError):
+                    continue
+                except NotLeader:
+                    await asyncio.sleep(self.config.tick_ms / 1000)
+                    continue
+
+    async def _forward(
+        self, group: int, payload: bytes, leader_id: int, timeout: float, req_id: str
+    ) -> bytes:
+        fut = asyncio.get_running_loop().create_future()
+        self._forwarded[req_id] = fut
+        try:
+            self.transport.send(
+                leader_id,
+                rpc.WireMsg(
+                    kind=rpc.MSG_CLIENT_REQ,
+                    group=group,
+                    src=self.engine.me,
+                    dst=self.engine.node_ids.index(leader_id),
+                    req_id=req_id,
+                    payload=payload,
+                ),
+            )
+            return await asyncio.wait_for(fut, min(timeout, 2.0))
+        finally:
+            self._forwarded.pop(req_id, None)
+
+    # ------------------------------------------------------------ internals
+
+    def _on_message(self, msg: rpc.WireMsg) -> None:
+        if msg.kind == rpc.MSG_CLIENT_REQ:
+            t = asyncio.get_running_loop().create_task(self._serve_forwarded(msg))
+            self._bg_tasks.add(t)
+            t.add_done_callback(self._bg_tasks.discard)
+        elif msg.kind == rpc.MSG_CLIENT_RESP:
+            # Unknown correlation ids are ignored (the reference panics the
+            # event loop here — SURVEY.md quirk 6).
+            fut = self._forwarded.get(msg.req_id)
+            if fut is not None and not fut.done():
+                if msg.ok:
+                    fut.set_result(msg.payload)
+                else:
+                    fut.set_exception(NotLeader(msg.group, -1))
+        else:
+            self.engine.receive(msg)
+
+    async def _serve_forwarded(self, msg: rpc.WireMsg) -> None:
+        """Leader side of the proxy: mint, await commit, answer the origin.
+        Dedups on req_id so a re-forwarded request shares the original block
+        instead of minting a new one."""
+        try:
+            fut = self._served.get(msg.req_id)
+            if fut is None or (fut.done() and (fut.cancelled() or fut.exception())):
+                fut = self.engine.propose(msg.group, msg.payload)
+                self._served[msg.req_id] = fut
+                if len(self._served) > 4096:  # bounded dedup memory
+                    for k in list(self._served)[:2048]:
+                        if self._served[k].done():
+                            del self._served[k]
+            result = await asyncio.wait_for(asyncio.shield(fut), 5.0)
+            ok, payload = 1, result
+        except Exception:
+            ok, payload = 0, b""
+        origin_id = self.engine.node_ids[msg.src]
+        self.transport.send(
+            origin_id,
+            rpc.WireMsg(
+                kind=rpc.MSG_CLIENT_RESP,
+                group=msg.group,
+                src=self.engine.me,
+                dst=msg.src,
+                ok=ok,
+                req_id=msg.req_id,
+                payload=payload,
+            ),
+        )
+
+    async def _tick_loop(self) -> None:
+        """The event loop (reference server.rs:120-161): fixed cadence, each
+        iteration steps the engine once and flushes its outbox."""
+        interval = self.config.tick_ms / 1000
+        try:
+            while not self.shutdown.is_shutdown:
+                t0 = asyncio.get_running_loop().time()
+                res = self.engine.tick()
+                for m in res.outbound:
+                    self.transport.send(self.engine.node_ids[m.dst], m)
+                elapsed = asyncio.get_running_loop().time() - t0
+                await asyncio.sleep(max(0.0, interval - elapsed))
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            log.exception("tick loop crashed")
+            self.shutdown.shutdown()

@@ -1,0 +1,149 @@
+"""Full-mesh cluster transport: length-delimited JSON frames over TCP.
+
+Parity: reference ``src/raft/tcp.rs`` — inbound accept loop spawning a
+reader per connection (:16-38), one outbound connect-loop task per peer
+(:53-103) with exponential backoff reconnect (:110-137) and a bounded
+per-peer queue of 1000 messages with drop-on-full (:63, :90-96); frames are
+length-delimited serde-JSON (:40-51, :143-156) — here 4-byte big-endian
+length + the :mod:`josefine_tpu.raft.rpc` JSON encoding.
+
+Delta: broadcast expansion (reference ``Address::Peers``, tcp.rs:81-87)
+lives in the engine's outbox decode (one WireMsg per destination), so the
+transport only ever sees unicast messages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable
+
+from josefine_tpu.raft.rpc import WireMsg
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.tcp")
+
+MAX_FRAME = 1 << 30
+SEND_QUEUE_DEPTH = 1000  # reference tcp.rs:63
+BACKOFF_BASE_S = 0.2     # reference reconnect backoff (tcp.rs:110-137)
+BACKOFF_MAX_S = 5.0
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(4)
+    n = int.from_bytes(hdr, "big")
+    if n > MAX_FRAME:
+        raise ValueError(f"frame too large: {n}")
+    return await reader.readexactly(n)
+
+
+def write_frame(writer: asyncio.StreamWriter, body: bytes) -> None:
+    writer.write(len(body).to_bytes(4, "big") + body)
+
+
+class Transport:
+    """Owns the inbound listener and the per-peer outbound connect loops."""
+
+    def __init__(
+        self,
+        self_id: int,
+        bind_addr: tuple[str, int],
+        peers: dict[int, tuple[str, int]],  # node id -> (ip, port)
+        on_message: Callable[[WireMsg], None],
+        shutdown: Shutdown,
+    ):
+        self.self_id = self_id
+        self.bind_addr = bind_addr
+        self.peers = peers
+        self.on_message = on_message
+        self.shutdown = shutdown
+        self._queues: dict[int, asyncio.Queue[WireMsg]] = {
+            nid: asyncio.Queue(SEND_QUEUE_DEPTH) for nid in peers
+        }
+        self._tasks: list[asyncio.Task] = []
+        self._conn_tasks: set[asyncio.Task] = set()
+        self._server: asyncio.Server | None = None
+        self.dropped = 0  # drop-on-full counter (observability)
+
+    async def start(self) -> tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.bind_addr[0], self.bind_addr[1]
+        )
+        for nid in self.peers:
+            self._tasks.append(asyncio.create_task(self._send_loop(nid)))
+        addr = self._server.sockets[0].getsockname()[:2]
+        log.debug("node %d transport listening on %s", self.self_id, addr)
+        return addr
+
+    def send(self, peer_id: int, msg: WireMsg) -> None:
+        """Enqueue; full queue drops the message (reference tcp.rs:90-96 —
+        Raft tolerates loss, retry comes from the protocol itself)."""
+        q = self._queues.get(peer_id)
+        if q is None:
+            log.warning("send to unknown peer %d", peer_id)
+            return
+        try:
+            q.put_nowait(msg)
+        except asyncio.QueueFull:
+            self.dropped += 1
+
+    async def stop(self) -> None:
+        for t in list(self._tasks) + list(self._conn_tasks):
+            t.cancel()
+        await asyncio.gather(*self._tasks, *self._conn_tasks, return_exceptions=True)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------ internals
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+        try:
+            while not self.shutdown.is_shutdown:
+                body = await read_frame(reader)
+                try:
+                    msg = WireMsg.decode(body)
+                except Exception:
+                    log.warning("undecodable frame (%d bytes); closing conn", len(body))
+                    break
+                self.on_message(msg)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        except ValueError as e:
+            log.warning("closing connection: %s", e)
+        finally:
+            if task is not None:
+                self._conn_tasks.discard(task)
+            writer.close()
+
+    async def _send_loop(self, peer_id: int):
+        """Connect-with-backoff loop draining this peer's queue
+        (reference tcp.rs:110-137)."""
+        backoff = BACKOFF_BASE_S
+        q = self._queues[peer_id]
+        while not self.shutdown.is_shutdown:
+            writer = None
+            try:
+                host, port = self.peers[peer_id]
+                _, writer = await asyncio.open_connection(host, port)
+                backoff = BACKOFF_BASE_S
+                log.debug("node %d connected to peer %d", self.self_id, peer_id)
+                while True:
+                    msg = await q.get()
+                    write_frame(writer, msg.encode())
+                    # Coalesce whatever else is queued into one flush.
+                    while not q.empty():
+                        write_frame(writer, q.get_nowait().encode())
+                    await writer.drain()
+            except asyncio.CancelledError:
+                if writer is not None:
+                    writer.close()
+                return
+            except (ConnectionError, OSError):
+                if writer is not None:
+                    writer.close()
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, BACKOFF_MAX_S)

@@ -1,0 +1,145 @@
+"""Multi-node cluster over real localhost sockets.
+
+The analog of the reference's ``NodeManager`` integration harness
+(``tests/josefine.rs:13-99``): N full nodes in one process/event loop,
+full-mesh peer config, real TCP frames between them.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from josefine_tpu.config import NodeAddr, RaftConfig
+from josefine_tpu.raft.client import RaftClient
+from josefine_tpu.raft.server import JosefineRaft
+from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.shutdown import Shutdown
+
+
+class ListFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data: bytes) -> bytes:
+        self.applied.append(data)
+        return b"ok:" + data
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_nodes(n=3, tick_ms=30):
+    ports = free_ports(n)
+    ids_ = list(range(1, n + 1))
+    nodes, fsms = [], []
+    for i, nid in enumerate(ids_):
+        cfg = RaftConfig(
+            id=nid,
+            ip="127.0.0.1",
+            port=ports[i],
+            nodes=[
+                NodeAddr(id=oid, ip="127.0.0.1", port=ports[j])
+                for j, oid in enumerate(ids_)
+                if oid != nid
+            ],
+            tick_ms=tick_ms,
+            heartbeat_timeout_ms=tick_ms,
+            election_timeout_min_ms=4 * tick_ms,
+            election_timeout_max_ms=10 * tick_ms,
+        )
+        fsm = ListFsm()
+        fsms.append(fsm)
+        nodes.append(JosefineRaft(cfg, MemKV(), {0: fsm}, shutdown=Shutdown()))
+    return nodes, fsms
+
+
+async def wait_for_leader(nodes, timeout=10.0, exclude=()):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        leaders = [n for n in nodes if n not in exclude and n.engine.is_leader(0)]
+        if len(leaders) == 1:
+            return leaders[0]
+        await asyncio.sleep(0.05)
+    raise AssertionError("no leader within timeout")
+
+
+def test_three_nodes_over_sockets_propose_via_follower():
+    async def main():
+        nodes, fsms = make_nodes(3)
+        for n in nodes:
+            await n.start()
+        try:
+            leader = await wait_for_leader(nodes)
+            follower = next(n for n in nodes if n is not leader)
+            # Propose THROUGH the follower: exercises CLIENT_REQ forwarding
+            # to the leader and CLIENT_RESP correlation back.
+            client = RaftClient(follower)
+            result = await client.propose(b"via-follower", timeout=10.0)
+            assert result == b"ok:via-follower"
+            # Replicated + applied exactly once everywhere (wait out the
+            # pipeline).
+            for _ in range(100):
+                if all(f.applied == [b"via-follower"] for f in fsms):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(f.applied == [b"via-follower"] for f in fsms)
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_leader_crash_over_sockets():
+    async def main():
+        nodes, fsms = make_nodes(3)
+        for n in nodes:
+            await n.start()
+        try:
+            leader = await wait_for_leader(nodes)
+            client = RaftClient(leader)
+            assert await client.propose(b"a", timeout=10.0) == b"ok:a"
+            # Kill the leader process-style: stop its runtime.
+            await leader.stop()
+            survivors = [n for n in nodes if n is not leader]
+            new_leader = await wait_for_leader(survivors, timeout=15.0)
+            assert new_leader is not leader
+            result = await RaftClient(new_leader).propose(b"b", timeout=10.0)
+            assert result == b"ok:b"
+            for f in [fsms[nodes.index(n)] for n in survivors]:
+                for _ in range(100):
+                    if f.applied == [b"a", b"b"]:
+                        break
+                    await asyncio.sleep(0.05)
+                assert f.applied == [b"a", b"b"]
+        finally:
+            for n in nodes:
+                n.shutdown.shutdown()
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_single_node_over_socket():
+    async def main():
+        nodes, fsms = make_nodes(1)
+        await nodes[0].start()
+        try:
+            await wait_for_leader(nodes, timeout=5.0)
+            result = await RaftClient(nodes[0]).propose(b"solo", timeout=5.0)
+            assert result == b"ok:solo"
+            assert fsms[0].applied == [b"solo"]
+        finally:
+            await nodes[0].stop()
+
+    asyncio.run(main())
