@@ -1,0 +1,65 @@
+"""Native (C++) extension loader with a lazy g++ build step.
+
+The reference's perf-critical components are native Rust (SURVEY.md §2 ★
+rows); here the equivalents are C++ CPython extensions compiled on first
+import and cached next to their sources. No pip/pybind11 in this image, so
+extensions use the raw CPython C API and are built with a direct g++
+invocation (rebuilt automatically when the .cpp is newer than the .so).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src")
+_LOCK = threading.Lock()
+
+
+def _so_path(name: str) -> str:
+    return os.path.join(_HERE, f"_{name}{sysconfig.get_config_var('EXT_SUFFIX')}")
+
+
+def ensure_built(name: str) -> str:
+    """Compile ``src/<name>.cpp`` into ``_<name>.<ext>.so`` if missing or
+    stale; returns the .so path."""
+    cpp = os.path.join(_SRC, f"{name}.cpp")
+    so = _so_path(name)
+    with _LOCK:
+        if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(cpp):
+            return so
+        include = sysconfig.get_paths()["include"]
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            "-fvisibility=hidden", "-Wall",
+            f"-I{include}", cpp, "-o", tmp,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build of {name} failed:\n{' '.join(cmd)}\n{e.stderr}"
+            ) from None
+        os.replace(tmp, so)  # atomic: concurrent builders race harmlessly
+    return so
+
+
+def load(name: str):
+    """Import the built extension module ``_<name>`` (idempotent and
+    thread-safe: exactly one module object per extension)."""
+    so = ensure_built(name)
+    modname = f"josefine_tpu.native._{name}"
+    with _LOCK:
+        if modname in sys.modules:
+            return sys.modules[modname]
+        spec = importlib.util.spec_from_file_location(modname, so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        sys.modules[modname] = mod
+        return mod
