@@ -151,29 +151,37 @@ struct LogImpl {
 
   // Recompute next_offset from the tail record of the last segment. Torn
   // tail records (index entry written but the log write incomplete after a
-  // crash) are discarded — the index entry is dropped and the log truncated
-  // back to the last fully-readable record.
+  // crash — including a size-complete but zero-filled/garbage tail from
+  // filesystem delayed allocation) are discarded: the record must match its
+  // index entry's offset AND pass its CRC before being trusted.
   void recover_tail() {
     if (segments.empty()) { next_offset = 0; return; }
     Segment& s = segments.back();
     while (s.entries > 0) {
       uint8_t* e = s.entry(s.entries - 1);
+      uint64_t rel = get_u64(e);
       uint64_t pos = get_u64(e + 8);
       uint8_t hdr[RECORD_HEADER];
       if (pread(s.log_fd, hdr, RECORD_HEADER, pos) == (ssize_t)RECORD_HEADER) {
+        uint64_t off = get_u64(hdr);
+        uint32_t cnt = get_u32(hdr + 8);
         uint32_t len = get_u32(hdr + 12);
+        uint32_t crc = get_u32(hdr + 16);
         struct stat st;
         fstat(s.log_fd, &st);
-        if ((uint64_t)st.st_size >= pos + RECORD_HEADER + len) {
-          uint64_t off = get_u64(hdr);
-          uint32_t cnt = get_u32(hdr + 8);
-          next_offset = off + (cnt ? cnt : 1);
-          if ((uint64_t)st.st_size > pos + RECORD_HEADER + len) {
-            // trailing garbage past the last indexed record
-            if (ftruncate(s.log_fd, pos + RECORD_HEADER + len) == 0)
-              s.log_size = pos + RECORD_HEADER + len;
+        if (off == s.base + rel && (uint64_t)st.st_size >= pos + RECORD_HEADER + len) {
+          std::vector<uint8_t> payload(len);
+          if (len == 0 || pread(s.log_fd, payload.data(), len, pos + RECORD_HEADER) == (ssize_t)len) {
+            if (crc32(payload.data(), len) == crc) {
+              next_offset = off + (cnt ? cnt : 1);
+              if ((uint64_t)st.st_size > pos + RECORD_HEADER + len) {
+                // trailing garbage past the last indexed record
+                if (ftruncate(s.log_fd, pos + RECORD_HEADER + len) == 0)
+                  s.log_size = pos + RECORD_HEADER + len;
+              }
+              return;
+            }
           }
-          return;
         }
       }
       s.entries--;  // torn: drop the entry, truncate, try the previous one
@@ -224,6 +232,8 @@ struct LogImpl {
     Segment* s = &segments.back();
     if ((s->log_size + RECORD_HEADER + len > max_segment_bytes && s->log_size > 0) ||
         s->entries >= s->max_entries()) {
+      fdatasync(s->log_fd);  // seal the old tail durably before rolling
+      msync(s->index, s->index_cap, MS_SYNC);
       if (!open_segment(next_offset, true)) return false;
       s = &segments.back();
     }
@@ -274,11 +284,13 @@ struct LogImpl {
     return get_u64(s->entry(lo)) <= rel ? (int64_t)lo : -1;
   }
 
+  // Only the tail segment can be dirty: sealed segments are synced once at
+  // roll time (see append), so flush cost stays O(1) as the log ages.
   void flush() {
-    for (auto& s : segments) {
-      if (s.log_fd >= 0) fdatasync(s.log_fd);
-      if (s.index) msync(s.index, s.index_cap, MS_SYNC);
-    }
+    if (segments.empty()) return;
+    Segment& s = segments.back();
+    if (s.log_fd >= 0) fdatasync(s.log_fd);
+    if (s.index) msync(s.index, s.index_cap, MS_SYNC);
   }
 
   void close() {
@@ -341,8 +353,13 @@ int read_blob(LogImpl* L, uint64_t off, uint64_t* base, uint32_t* count,
   if (slot < 0) return 0;
   uint64_t pos = get_u64(s->entry(slot) + 8);
   uint8_t hdr[RECORD_HEADER];
-  if (pread(s->log_fd, hdr, RECORD_HEADER, pos) != (ssize_t)RECORD_HEADER)
-    return 0;
+  if (pread(s->log_fd, hdr, RECORD_HEADER, pos) != (ssize_t)RECORD_HEADER) {
+    // The index says a record lives here; failing to read its header is
+    // corruption or IO failure, not end-of-log.
+    PyErr_Format(PyExc_OSError, "short header read at log position %llu",
+                 (unsigned long long)pos);
+    return -1;
+  }
   *base = get_u64(hdr);
   *count = get_u32(hdr + 8);
   uint32_t len = get_u32(hdr + 12);

@@ -109,6 +109,26 @@ def test_reopen_with_smaller_index_keeps_entries(tmp_path):
     assert lg2.read(7) == (7, 1, b"keep-7")
 
 
+def test_zero_filled_tail_discarded_on_recovery(tmp_path):
+    # Filesystem delayed allocation can persist the size extension but not
+    # the data: a size-complete all-zero tail must fail its CRC check and be
+    # discarded, not steer next_offset (to 1) via a zero header.
+    lg = Log(tmp_path)
+    lg.append(b"good", count=2)
+    lg.append(b"will-be-zeroed", count=3)
+    lg.flush()
+    lg.close()
+    logfile = tmp_path / "00000000000000000000.log"
+    data = bytearray(logfile.read_bytes())
+    tail_len = 20 + len(b"will-be-zeroed")
+    data[-tail_len:] = b"\x00" * tail_len
+    logfile.write_bytes(bytes(data))
+    lg2 = Log(tmp_path)
+    assert lg2.next_offset() == 2
+    assert lg2.read(0) == (0, 2, b"good")
+    assert lg2.append(b"replacement") == 2
+
+
 def test_torn_tail_record_discarded_on_recovery(tmp_path):
     lg = Log(tmp_path)
     lg.append(b"good-record")
