@@ -1,0 +1,67 @@
+"""Kafka-client demo against a running node (see single-node/ multi-node/).
+
+Creates a topic, produces a record batch, fetches it back — exercising the
+full CreateTopics -> Raft -> LeaderAndIsr -> Produce -> Fetch path over the
+real wire protocol (the reference could only do the CreateTopics leg;
+SURVEY.md quirk 8).
+"""
+
+import asyncio
+import struct
+import sys
+
+from josefine_tpu.broker import records
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey
+
+
+def make_batch(payload: bytes, n_records: int = 1) -> bytes:
+    return records.build_batch(payload, n_records)
+
+
+async def main(host="127.0.0.1", port=8844):
+    cl = await kafka_client.connect(host, port, client_id="demo")
+    try:
+        versions = await cl.send(ApiKey.API_VERSIONS, 0, {})
+        print(f"broker speaks {len(versions['api_keys'])} APIs")
+
+        created = await cl.send(ApiKey.CREATE_TOPICS, 1, {
+            "topics": [{"name": "demo-topic", "num_partitions": 1,
+                        "replication_factor": 1, "assignments": [], "configs": []}],
+            "timeout_ms": 10000, "validate_only": False,
+        }, timeout=30.0)
+        print("create:", created["topics"])
+
+        md = await cl.send(ApiKey.METADATA, 1, {"topics": None})
+        print("metadata brokers:", [(b["node_id"], b["port"]) for b in md["brokers"]])
+        leader = md["topics"][0]["partitions"][0]["leader_id"]
+        leader_info = next(b for b in md["brokers"] if b["node_id"] == leader)
+
+        pl = await kafka_client.connect(leader_info["host"], leader_info["port"])
+        try:
+            produced = await pl.send(ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": "demo-topic", "partitions": [
+                    {"index": 0, "records": make_batch(b"hello, tpu", 1)}]}],
+            })
+            print("produce:", produced["responses"][0]["partitions"])
+
+            fetched = await pl.send(ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 100, "min_bytes": 1,
+                "max_bytes": 1 << 20, "isolation_level": 0,
+                "topics": [{"topic": "demo-topic", "partitions": [
+                    {"partition": 0, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 20}]}],
+            })
+            part = fetched["responses"][0]["partitions"][0]
+            print("fetch hw:", part["high_watermark"],
+                  "records tail:", part["records"][-10:])
+        finally:
+            await pl.close()
+    finally:
+        await cl.close()
+
+
+if __name__ == "__main__":
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 8844
+    asyncio.run(main(port=port))

@@ -1,0 +1,30 @@
+"""Multi-node example: 3 full nodes in one process/event loop.
+
+Parity: reference ``examples/multi-node/main.rs`` (three nodes on one tokio
+runtime from the node-*.toml configs). Ctrl-c stops all three.
+"""
+
+import asyncio
+import os
+import signal
+
+from josefine_tpu import josefine
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import setup_tracing
+
+
+async def main():
+    setup_tracing("INFO")
+    shutdown = Shutdown()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, shutdown.shutdown)
+    here = os.path.dirname(__file__)
+    await asyncio.gather(*(
+        josefine(os.path.join(here, f"node-{i}.toml"), shutdown.clone())
+        for i in (1, 2, 3)
+    ))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,27 @@
+"""Single-node example: run one full node from TOML config.
+
+Parity: reference ``examples/single-node/main.rs``. Start it, then talk
+Kafka to 127.0.0.1:8844 (e.g. ``python ../client_demo.py``).
+"""
+
+import asyncio
+import os
+import signal
+
+from josefine_tpu import josefine
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import setup_tracing
+
+
+async def main():
+    setup_tracing("INFO")
+    shutdown = Shutdown()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, shutdown.shutdown)
+    cfg = os.path.join(os.path.dirname(__file__), "node-1.toml")
+    await josefine(cfg, shutdown)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

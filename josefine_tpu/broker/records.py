@@ -1,0 +1,50 @@
+"""Kafka record-batch (v2) header helpers for the broker data plane.
+
+The broker treats record batches as opaque payloads (like the reference,
+``src/broker/handler/produce.rs:29-30``) EXCEPT for the two header fields
+it must own: the record count (to claim an offset span) and the base
+offset (assigned at append, rewritten in place). The batch CRC covers
+bytes from ``attributes`` onward, so rewriting the base offset does not
+invalidate it. The reference assigns no offsets at all (SURVEY.md quirk 8).
+
+Record batch v2 layout (bytes): base_offset 0-7, batch_length 8-11,
+partition_leader_epoch 12-15, magic 16, crc 17-20, attributes 21-22,
+last_offset_delta 23-26, ... records_count 57-60, records 61+.
+"""
+
+from __future__ import annotations
+
+import struct
+
+BATCH_OVERHEAD = 61
+_MAGIC_OFFSET = 16
+_LAST_OFFSET_DELTA = 23
+
+
+def record_count(batch: bytes) -> int:
+    """Offsets claimed by this batch (1 for short/legacy/opaque blobs)."""
+    if len(batch) < BATCH_OVERHEAD or batch[_MAGIC_OFFSET] != 2:
+        return 1
+    (delta,) = struct.unpack_from(">i", batch, _LAST_OFFSET_DELTA)
+    return max(1, delta + 1)
+
+
+def set_base_offset(batch: bytes, base: int) -> bytes:
+    """Rewrite the batch's base offset (no-op for non-v2 blobs)."""
+    if len(batch) < BATCH_OVERHEAD or batch[_MAGIC_OFFSET] != 2:
+        return batch
+    return struct.pack(">q", base) + batch[8:]
+
+
+_RECORDS_COUNT = 57
+
+
+def build_batch(payload: bytes, n_records: int = 1) -> bytes:
+    """A minimal v2 record batch wrapping opaque record bytes (test/demo
+    producer; the broker itself never builds batches)."""
+    header = bytearray(BATCH_OVERHEAD)
+    struct.pack_into(">i", header, 8, BATCH_OVERHEAD - 12 + len(payload))
+    header[_MAGIC_OFFSET] = 2
+    struct.pack_into(">i", header, _LAST_OFFSET_DELTA, n_records - 1)
+    struct.pack_into(">i", header, _RECORDS_COUNT, n_records)
+    return bytes(header) + payload

@@ -1,0 +1,55 @@
+"""Replica: binds a partition to an on-disk log.
+
+Parity: reference ``src/broker/replica.rs:6-21`` (Replica::new creates the
+log dir under ``data_dir/data/{partition}``) and the registry at
+``src/broker/mod.rs:45-65``. Upgrade: the replica tracks the partition's
+leader (from LeaderAndIsr) so the Produce/Fetch handlers can answer
+NOT_LEADER_OR_FOLLOWER correctly.
+"""
+
+from __future__ import annotations
+
+import os
+
+from josefine_tpu.broker.log import Log
+from josefine_tpu.broker.state import Partition
+
+
+class Replica:
+    def __init__(self, data_dir: str | os.PathLike, partition: Partition):
+        self.partition = partition
+        self.path = os.path.join(os.fspath(data_dir), "data", f"{partition.topic}-{partition.idx}")
+        self.log = Log(self.path)
+
+    @property
+    def leader(self) -> int:
+        return self.partition.leader
+
+    def close(self) -> None:
+        self.log.close()
+
+
+class ReplicaRegistry:
+    """(topic, idx) -> Replica, created on LeaderAndIsr."""
+
+    def __init__(self, data_dir: str | os.PathLike):
+        self._data_dir = os.fspath(data_dir)
+        self._replicas: dict[tuple[str, int], Replica] = {}
+
+    def ensure(self, partition: Partition) -> Replica:
+        key = (partition.topic, partition.idx)
+        rep = self._replicas.get(key)
+        if rep is None:
+            rep = Replica(self._data_dir, partition)
+            self._replicas[key] = rep
+        else:
+            rep.partition = partition  # refresh leader/isr on re-announce
+        return rep
+
+    def get(self, topic: str, idx: int) -> Replica | None:
+        return self._replicas.get((topic, idx))
+
+    def close(self) -> None:
+        for rep in self._replicas.values():
+            rep.close()
+        self._replicas.clear()

@@ -1,0 +1,118 @@
+"""Broker server: Kafka-protocol TCP surface.
+
+Parity: reference ``src/broker/server.rs`` (accept loop :32-50 + dispatcher
+:53-70), ``src/broker/tcp.rs`` (per-connection framed read → handle →
+framed write, correlation id echoed :48-57) and the ``JosefineBroker``
+facade (``src/broker/mod.rs:30-43``).
+
+Structural delta: the reference funnels every connection through ONE
+dispatcher task over an mpsc channel; here each connection is its own
+asyncio task calling the shared ``Broker`` directly — same single-threaded
+execution (one event loop), no channel hop, and per-connection request
+ordering is preserved by processing frames sequentially per task.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from josefine_tpu.broker.handlers import Broker
+from josefine_tpu.broker.state import Store
+from josefine_tpu.config import BrokerConfig
+from josefine_tpu.kafka import codec
+from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("broker.server")
+
+
+class JosefineBroker:
+    """Facade: bind + serve until shutdown (reference ``JosefineBroker::run``)."""
+
+    def __init__(
+        self,
+        config: BrokerConfig,
+        store: Store,
+        raft_client,
+        shutdown: Shutdown | None = None,
+        leader_hint=None,
+    ):
+        self.config = config
+        self.shutdown = shutdown or Shutdown()
+        self.broker = Broker(config, store, raft_client, leader_hint=leader_hint)
+        self._server: asyncio.Server | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+        self.bound_addr: tuple[str, int] | None = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.config.ip, self.config.port
+        )
+        sock = self._server.sockets[0]
+        self.bound_addr = sock.getsockname()[:2]
+        log.info("broker %d listening on %s:%d", self.config.id, *self.bound_addr)
+
+    async def run(self) -> None:
+        await self.start()
+        await self.shutdown.wait()
+        await self.stop()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Connection handlers park on read_frame between requests; cancel
+            # them or wait_closed() (which awaits handlers on >=3.12.1) hangs
+            # until every client hangs up.
+            for t in list(self._conn_tasks):
+                t.cancel()
+            await asyncio.gather(*self._conn_tasks, return_exceptions=True)
+            await self._server.wait_closed()
+        self.broker.replicas.close()
+
+    # ------------------------------------------------------------ internals
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
+        try:
+            while not self.shutdown.is_shutdown:
+                try:
+                    payload = await codec.read_frame(reader)
+                except (ConnectionError, ValueError) as e:
+                    log.warning("bad frame from %s: %s", peer, e)
+                    break
+                if payload is None:
+                    break
+                try:
+                    req = codec.decode_request(payload)
+                except ValueError as e:
+                    log.warning("undecodable request from %s: %s", peer, e)
+                    break
+                body = await self.broker.handle_request(
+                    req["api_key"], req["api_version"], req["body"]
+                )
+                if body is None:
+                    break  # unroutable: close (the reference panics here)
+                if body.pop("__no_response__", False):
+                    continue  # acks=0 produce
+                api_version = req["api_version"] if req["body"] is not None else 0
+                resp = codec.encode_response(
+                    req["api_key"], api_version, req["correlation_id"], body
+                )
+                writer.write(codec.frame(resp))
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        except Exception:
+            log.exception("connection handler crashed for %s", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass

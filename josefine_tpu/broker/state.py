@@ -1,0 +1,165 @@
+"""Replicated broker metadata: state models + Store.
+
+Parity: reference ``src/broker/state/`` — models (``topic.rs:8-16``,
+``partition.rs:12-18``, ``broker.rs:5-9``, ``group.rs:1-4``) and the
+sled-backed ``Store`` (``mod.rs:18-93``: topics map under "topics",
+partitions under ``"{topic}:partition:{idx}"``, brokers under
+``"broker:{id}"``, groups, bincode values :80-92).
+
+Deltas (deliberate): every record is its own key (the reference serializes
+the WHOLE topics map under one "topics" key, ``mod.rs:34-52`` — O(topics)
+rewrite per create); values are canonical JSON (sorted keys) so every node's
+store is byte-identical after applying the same committed sequence.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import asdict, dataclass, field
+
+from josefine_tpu.utils.kv import KV
+
+_TOPIC = b"topic:"
+_PARTITION = b"partition:"   # partition:{topic}:{idx:08d}
+_BROKER = b"broker:"         # broker:{id:08d}
+_GROUP = b"group:"
+
+
+def _dumps(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+@dataclass
+class Topic:
+    """Parity: reference ``topic.rs:8-16`` (id, name, partitions map
+    idx -> replica broker ids, internal flag)."""
+
+    name: str
+    id: str = ""
+    partitions: dict[int, list[int]] = field(default_factory=dict)
+    internal: bool = False
+
+    def encode(self) -> bytes:
+        d = asdict(self)
+        d["partitions"] = {str(k): v for k, v in self.partitions.items()}
+        return _dumps(d)
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Topic":
+        d = json.loads(raw)
+        d["partitions"] = {int(k): v for k, v in d["partitions"].items()}
+        return cls(**d)
+
+
+@dataclass
+class Partition:
+    """Parity: reference ``partition.rs:12-18`` (id, idx, topic, isr,
+    assigned replicas, leader)."""
+
+    topic: str
+    idx: int
+    id: str = ""
+    isr: list[int] = field(default_factory=list)
+    assigned_replicas: list[int] = field(default_factory=list)
+    leader: int = 0
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Partition":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class Broker:
+    """Parity: reference ``broker.rs:5-9``."""
+
+    id: int
+    ip: str
+    port: int
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Broker":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class Group:
+    """Parity: reference ``group.rs:1-4``."""
+
+    id: str
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Group":
+        return cls(**json.loads(raw))
+
+
+class Store:
+    """Metadata store over KV. All writes flow through the replicated FSM
+    (``broker/fsm.py``) — handlers only read."""
+
+    def __init__(self, kv: KV, prefix: bytes = b"store:"):
+        self._kv = kv
+        self._pfx = prefix
+
+    # ------------------------------------------------------------- topics
+
+    def create_topic(self, topic: Topic) -> Topic:
+        self._kv.put(self._pfx + _TOPIC + topic.name.encode(), topic.encode())
+        return topic
+
+    def get_topic(self, name: str) -> Topic | None:
+        raw = self._kv.get(self._pfx + _TOPIC + name.encode())
+        return None if raw is None else Topic.decode(raw)
+
+    def topic_exists(self, name: str) -> bool:
+        return self._kv.get(self._pfx + _TOPIC + name.encode()) is not None
+
+    def get_topics(self) -> list[Topic]:
+        return [Topic.decode(v) for _, v in self._kv.scan_prefix(self._pfx + _TOPIC)]
+
+    # --------------------------------------------------------- partitions
+
+    def _partition_key(self, topic: str, idx: int) -> bytes:
+        return self._pfx + _PARTITION + topic.encode() + b":%08d" % idx
+
+    def create_partition(self, partition: Partition) -> Partition:
+        self._kv.put(self._partition_key(partition.topic, partition.idx), partition.encode())
+        return partition
+
+    def get_partition(self, topic: str, idx: int) -> Partition | None:
+        raw = self._kv.get(self._partition_key(topic, idx))
+        return None if raw is None else Partition.decode(raw)
+
+    def get_partitions(self, topic: str) -> list[Partition]:
+        pfx = self._pfx + _PARTITION + topic.encode() + b":"
+        return [Partition.decode(v) for _, v in self._kv.scan_prefix(pfx)]
+
+    # ------------------------------------------------------------ brokers
+
+    def ensure_broker(self, broker: Broker) -> Broker:
+        self._kv.put(self._pfx + _BROKER + b"%08d" % broker.id, broker.encode())
+        return broker
+
+    def get_broker(self, broker_id: int) -> Broker | None:
+        raw = self._kv.get(self._pfx + _BROKER + b"%08d" % broker_id)
+        return None if raw is None else Broker.decode(raw)
+
+    def get_brokers(self) -> list[Broker]:
+        return [Broker.decode(v) for _, v in self._kv.scan_prefix(self._pfx + _BROKER)]
+
+    # ------------------------------------------------------------- groups
+
+    def create_group(self, group: Group) -> Group:
+        self._kv.put(self._pfx + _GROUP + group.id.encode(), group.encode())
+        return group
+
+    def get_groups(self) -> list[Group]:
+        return [Group.decode(v) for _, v in self._kv.scan_prefix(self._pfx + _GROUP)]

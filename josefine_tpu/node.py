@@ -1,28 +1,101 @@
 """Node composition: wire store -> broker task -> raft task and join.
 
-Parity: reference ``run()`` in ``src/lib.rs:31-56`` (one sled DB, one broker
-task, one raft task, ``try_join!``).
+Parity: reference ``run()`` in ``src/lib.rs:31-56`` — one embedded store
+(sled there, sqlite KV here) shared by the Raft chain and the broker
+metadata store, one broker task, one raft task, joined until shutdown.
+
+Addition over the reference: the node registers itself in the replicated
+broker registry at startup (EnsureBroker through Raft), which the reference
+defines a transition for but never invokes — its Metadata handler can only
+see brokers that were registered by hand.
 """
 
 from __future__ import annotations
 
 import asyncio
 
+from josefine_tpu.broker.fsm import JosefineFsm, Transition
+from josefine_tpu.broker.server import JosefineBroker
+from josefine_tpu.broker.state import Broker as BrokerInfo
+from josefine_tpu.broker.state import Store
 from josefine_tpu.config import JosefineConfig
+from josefine_tpu.raft.client import RaftClient
+from josefine_tpu.raft.server import JosefineRaft, ProposalTimeout
+from josefine_tpu.utils.kv import open_kv
 from josefine_tpu.utils.shutdown import Shutdown
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("node")
 
 
-async def run_node(config: JosefineConfig, shutdown: Shutdown):
-    """Run one full node (raft + broker) until shutdown.
+class Node:
+    """One full node: raft runtime + broker + shared durable store."""
 
-    The host runtime (raft server event loop, broker, Kafka surface) is under
-    construction; this composes whatever layers exist so far.
-    """
-    raise NotImplementedError(
-        "host runtime composition lands with josefine_tpu.raft.server and "
-        "josefine_tpu.broker; the device consensus engine "
-        "(josefine_tpu.models) is functional today"
-    )
+    def __init__(self, config: JosefineConfig, shutdown: Shutdown | None = None,
+                 in_memory: bool = False):
+        config.validate()
+        self.config = config
+        self.shutdown = shutdown or Shutdown()
+        self.kv = open_kv(None if in_memory else config.broker.state_file)
+        self.store = Store(self.kv)
+        self.raft = JosefineRaft(
+            config.raft,
+            self.kv,
+            fsms={0: JosefineFsm(self.store)},
+            groups=config.engine.partitions,
+            shutdown=self.shutdown.clone(),
+        )
+        self.client = RaftClient(self.raft)
+        self.broker = JosefineBroker(
+            config.broker,
+            self.store,
+            self.client,
+            shutdown=self.shutdown.clone(),
+            leader_hint=lambda: self.raft.engine.leader_id(0),
+        )
+        self._register_task: asyncio.Task | None = None
+
+    async def start(self) -> None:
+        await self.raft.start()
+        await self.broker.start()
+        self._register_task = asyncio.create_task(self._register_self())
+
+    async def _register_self(self) -> None:
+        """Propose EnsureBroker(self) until the cluster has a leader."""
+        b = BrokerInfo(id=self.config.broker.id, ip=self.config.broker.ip,
+                       port=self.config.broker.port)
+        payload = Transition.ensure_broker(b)
+        while not self.shutdown.is_shutdown:
+            try:
+                await self.client.propose(payload, timeout=5.0)
+                log.info("broker %d registered in cluster metadata", b.id)
+                return
+            except (ProposalTimeout, asyncio.TimeoutError):
+                continue
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                log.exception("broker self-registration failed; retrying")
+                await asyncio.sleep(0.5)
+
+    async def run(self) -> None:
+        """Start and block until shutdown (reference lib.rs try_join!)."""
+        await self.start()
+        try:
+            await self.shutdown.wait()
+        finally:
+            await self.stop()
+
+    async def stop(self) -> None:
+        self.shutdown.shutdown()
+        if self._register_task:
+            self._register_task.cancel()
+            await asyncio.gather(self._register_task, return_exceptions=True)
+        await self.broker.stop()
+        await self.raft.stop()
+        self.kv.close()
+
+
+async def run_node(config: JosefineConfig, shutdown: Shutdown | None = None) -> None:
+    """Run one full node (raft + broker) until shutdown."""
+    await Node(config, shutdown).run()

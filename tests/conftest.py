@@ -6,7 +6,11 @@ sandbox's ``sitecustomize`` pins ``JAX_PLATFORMS=axon``, so the env var alone
 is not enough — the config update after import is what sticks.
 """
 
+import asyncio
+import inspect
 import os
+
+import pytest
 
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
@@ -15,3 +19,22 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: coroutine test (run via asyncio.run)")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests without the pytest-asyncio plugin (not in
+    this image): each gets a fresh event loop via asyncio.run."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None

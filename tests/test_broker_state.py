@@ -1,0 +1,99 @@
+"""Broker metadata store + FSM tests.
+
+Parity model: reference store behavior (``src/broker/state/mod.rs``) and
+FSM transitions (``src/broker/fsm.rs:40-70``), driven through the same seam
+the reference tests use (a real store on an in-memory KV).
+"""
+
+from josefine_tpu.broker.fsm import JosefineFsm, Transition, decode_result
+from josefine_tpu.broker.state import Broker, Group, Partition, Store, Topic
+from josefine_tpu.utils.kv import MemKV
+
+
+def make_store():
+    return Store(MemKV())
+
+
+def test_topic_roundtrip():
+    s = make_store()
+    t = Topic(name="events", id="u-1", partitions={0: [1, 2], 1: [2, 3]})
+    s.create_topic(t)
+    assert s.topic_exists("events")
+    assert not s.topic_exists("absent")
+    got = s.get_topic("events")
+    assert got == t
+    assert got.partitions[1] == [2, 3]  # int keys survive the codec
+    assert [x.name for x in s.get_topics()] == ["events"]
+
+
+def test_partition_roundtrip_and_ordering():
+    s = make_store()
+    for idx in (2, 0, 1):
+        s.create_partition(Partition(topic="t", idx=idx, isr=[1], assigned_replicas=[1, 2], leader=1))
+    parts = s.get_partitions("t")
+    assert [p.idx for p in parts] == [0, 1, 2]  # zero-padded keys sort numerically
+    assert s.get_partition("t", 1).leader == 1
+    assert s.get_partition("t", 9) is None
+    assert s.get_partitions("other") == []
+
+
+def test_partition_prefix_no_collision():
+    # topic "a" partitions must not leak into topic "ab" scans.
+    s = make_store()
+    s.create_partition(Partition(topic="a", idx=0))
+    s.create_partition(Partition(topic="ab", idx=0))
+    assert len(s.get_partitions("a")) == 1
+    assert len(s.get_partitions("ab")) == 1
+
+
+def test_broker_and_group_roundtrip():
+    s = make_store()
+    s.ensure_broker(Broker(id=2, ip="10.0.0.2", port=8844))
+    s.ensure_broker(Broker(id=1, ip="10.0.0.1", port=8844))
+    assert [b.id for b in s.get_brokers()] == [1, 2]
+    assert s.get_broker(2).ip == "10.0.0.2"
+    assert s.get_broker(3) is None
+    s.create_group(Group(id="g1"))
+    assert [g.id for g in s.get_groups()] == ["g1"]
+
+
+def test_fsm_transitions_apply_and_echo():
+    s = make_store()
+    fsm = JosefineFsm(s)
+    t = Topic(name="t", id="u", partitions={0: [1]})
+    result = fsm.transition(Transition.ensure_topic(t))
+    assert decode_result(result) == t
+    assert s.get_topic("t") == t
+
+    p = Partition(topic="t", idx=0, isr=[1], assigned_replicas=[1], leader=1)
+    fsm.transition(Transition.ensure_partition(p))
+    assert s.get_partition("t", 0) == p
+
+    b = Broker(id=1, ip="h", port=8844)
+    fsm.transition(Transition.ensure_broker(b))
+    assert s.get_broker(1) == b
+
+
+def test_fsm_deterministic_across_nodes():
+    # Two nodes applying the same committed sequence -> byte-identical KV.
+    kv1, kv2 = MemKV(), MemKV()
+    seq = [
+        Transition.ensure_broker(Broker(id=1, ip="a", port=1234)),
+        Transition.ensure_topic(Topic(name="t", id="u", partitions={0: [1]})),
+        Transition.ensure_partition(Partition(topic="t", idx=0, leader=1)),
+    ]
+    for kv in (kv1, kv2):
+        fsm = JosefineFsm(Store(kv))
+        for data in seq:
+            fsm.transition(data)
+    assert dict(kv1.scan_prefix(b"")) == dict(kv2.scan_prefix(b""))
+
+
+def test_fsm_rejects_garbage():
+    fsm = JosefineFsm(make_store())
+    import pytest
+
+    with pytest.raises(ValueError):
+        fsm.transition(b"")
+    with pytest.raises(ValueError):
+        fsm.transition(bytes([99]) + b"{}")

@@ -1,0 +1,185 @@
+"""Full-node integration tests: N complete nodes (raft + broker + Kafka
+surface) in one process, talking over real localhost sockets.
+
+Parity: reference ``tests/josefine.rs`` — the ``NodeManager`` harness
+(:13-99) building N nodes with offset ids/ports and full-mesh peer lists,
+``single_node`` ApiVersions round-trip (:101-122), ``create_topic`` with
+replication_factor=2 / partitions=2 (:124-166), ``multi_node`` 3-node
+ApiVersions (:168-191). The reference's versions are bit-rotted (SURVEY.md
+quirk 9); these actually run, and extend the suite with the Produce/Fetch
+data path the reference couldn't reach over the wire (quirk 8).
+"""
+
+import asyncio
+import socket
+import struct
+
+import pytest
+
+from josefine_tpu.broker import records
+from josefine_tpu.config import BrokerConfig, EngineConfig, JosefineConfig, NodeAddr, RaftConfig
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.node import Node
+
+
+def free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class NodeManager:
+    """N full nodes in one event loop (reference tests/josefine.rs:13-99)."""
+
+    def __init__(self, n, tmp_path, tick_ms=30):
+        raft_ports = free_ports(n)
+        broker_ports = free_ports(n)
+        self.nodes = []
+        for i in range(n):
+            node_id = i + 1
+            peers = [NodeAddr(id=j + 1, ip="127.0.0.1", port=raft_ports[j])
+                     for j in range(n) if j != i]
+            cfg = JosefineConfig(
+                raft=RaftConfig(id=node_id, ip="127.0.0.1", port=raft_ports[i],
+                                nodes=peers, tick_ms=tick_ms,
+                                heartbeat_timeout_ms=tick_ms,
+                                election_timeout_min_ms=3 * tick_ms,
+                                election_timeout_max_ms=8 * tick_ms,
+                                data_directory=str(tmp_path / f"node-{node_id}/raft")),
+                broker=BrokerConfig(id=node_id, ip="127.0.0.1",
+                                    port=broker_ports[i],
+                                    state_file=str(tmp_path / f"node-{node_id}/state.db"),
+                                    data_directory=str(tmp_path / f"node-{node_id}/data")),
+                engine=EngineConfig(partitions=1),
+            )
+            self.nodes.append(Node(cfg, in_memory=True))
+        self.broker_ports = broker_ports
+
+    async def __aenter__(self):
+        for n in self.nodes:
+            await n.start()
+        return self
+
+    async def __aexit__(self, *exc):
+        await asyncio.gather(*(n.stop() for n in self.nodes), return_exceptions=True)
+
+    async def wait_registered(self, count=None, timeout=20.0):
+        """Block until every node's self-registration has replicated."""
+        count = count or len(self.nodes)
+        deadline = asyncio.get_running_loop().time() + timeout
+        while asyncio.get_running_loop().time() < deadline:
+            if all(len(n.store.get_brokers()) >= count for n in self.nodes):
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"brokers never registered on all nodes within {timeout}s")
+
+
+def make_batch(payload: bytes, n_records: int = 1) -> bytes:
+    return records.build_batch(payload, n_records)
+
+
+@pytest.mark.asyncio
+async def test_single_node_api_versions(tmp_path):
+    # Reference tests/josefine.rs:101-122.
+    async with NodeManager(1, tmp_path) as mgr:
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            body = await asyncio.wait_for(cl.send(ApiKey.API_VERSIONS, 0, {}), 10)
+            keys = {e["api_key"] for e in body["api_keys"]}
+            assert ApiKey.CREATE_TOPICS in keys and ApiKey.PRODUCE in keys
+        finally:
+            await cl.close()
+
+
+@pytest.mark.asyncio
+async def test_create_topic_replicated(tmp_path):
+    # Reference tests/josefine.rs:124-166 (RF=2, partitions=2, 3 nodes).
+    async with NodeManager(3, tmp_path) as mgr:
+        await mgr.wait_registered()
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            resp = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "replicated", "num_partitions": 2,
+                            "replication_factor": 2, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False,
+            }, timeout=20.0), 25)
+            assert resp["topics"][0]["error_code"] == ErrorCode.NONE
+
+            # The topic's metadata replicates to EVERY node's store.
+            async def all_replicated():
+                while not all(n.store.topic_exists("replicated") for n in mgr.nodes):
+                    await asyncio.sleep(0.05)
+            await asyncio.wait_for(all_replicated(), 10)
+            for n in mgr.nodes:
+                parts = n.store.get_partitions("replicated")
+                assert [p.idx for p in parts] == [0, 1]
+                assert all(len(p.assigned_replicas) == 2 for p in parts)
+
+            # Metadata over the wire from a different node agrees.
+            cl2 = await kafka_client.connect("127.0.0.1", mgr.broker_ports[1])
+            try:
+                md = await asyncio.wait_for(
+                    cl2.send(ApiKey.METADATA, 1, {"topics": [{"name": "replicated"}]}), 10)
+                assert md["topics"][0]["error_code"] == ErrorCode.NONE
+                assert len(md["topics"][0]["partitions"]) == 2
+                assert len(md["brokers"]) == 3
+            finally:
+                await cl2.close()
+        finally:
+            await cl.close()
+
+
+@pytest.mark.asyncio
+async def test_multi_node_api_versions(tmp_path):
+    # Reference tests/josefine.rs:168-191.
+    async with NodeManager(3, tmp_path) as mgr:
+        for port in mgr.broker_ports:
+            cl = await kafka_client.connect("127.0.0.1", port)
+            try:
+                body = await asyncio.wait_for(cl.send(ApiKey.API_VERSIONS, 0, {}), 10)
+                assert body["error_code"] == ErrorCode.NONE
+            finally:
+                await cl.close()
+
+
+@pytest.mark.asyncio
+async def test_produce_fetch_over_the_wire(tmp_path):
+    # End-to-end data path (unreachable in the reference: quirk 8).
+    async with NodeManager(1, tmp_path) as mgr:
+        await mgr.wait_registered()
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            resp = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "stream", "num_partitions": 1,
+                            "replication_factor": 1, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False,
+            }, timeout=20.0), 25)
+            assert resp["topics"][0]["error_code"] == ErrorCode.NONE
+
+            produced = await asyncio.wait_for(cl.send(ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": "stream", "partitions": [
+                    {"index": 0, "records": make_batch(b"payload-x", 3)}]}],
+            }), 10)
+            p = produced["responses"][0]["partitions"][0]
+            assert (p["error_code"], p["base_offset"]) == (ErrorCode.NONE, 0)
+
+            fetched = await asyncio.wait_for(cl.send(ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 0, "min_bytes": 1,
+                "max_bytes": 1 << 20, "isolation_level": 0,
+                "topics": [{"topic": "stream", "partitions": [
+                    {"partition": 0, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 20}]}],
+            }), 10)
+            fp = fetched["responses"][0]["partitions"][0]
+            assert fp["high_watermark"] == 3
+            assert fp["records"].endswith(b"payload-x")
+        finally:
+            await cl.close()
