@@ -10,18 +10,33 @@ client (``src/raft/fsm.rs:64-81``).
 
 from __future__ import annotations
 
-from josefine_tpu.broker.state import Broker, Group, Partition, Store, Topic
+from josefine_tpu.broker.state import (
+    Broker,
+    Group,
+    OffsetCommit,
+    OffsetCommitBatch,
+    Partition,
+    Store,
+    Topic,
+    TopicTombstone,
+)
 
 _ENSURE_TOPIC = 1
 _ENSURE_PARTITION = 2
 _ENSURE_BROKER = 3
 _ENSURE_GROUP = 4
+_COMMIT_OFFSET = 5
+_DELETE_TOPIC = 6
+_COMMIT_OFFSETS = 7
 
 _KINDS = {
     _ENSURE_TOPIC: Topic,
     _ENSURE_PARTITION: Partition,
     _ENSURE_BROKER: Broker,
     _ENSURE_GROUP: Group,
+    _COMMIT_OFFSET: OffsetCommit,
+    _DELETE_TOPIC: TopicTombstone,
+    _COMMIT_OFFSETS: OffsetCommitBatch,
 }
 _TAGS = {v: k for k, v in _KINDS.items()}
 
@@ -46,6 +61,18 @@ class Transition:
         return bytes([_ENSURE_GROUP]) + group.encode()
 
     @staticmethod
+    def commit_offset(oc: OffsetCommit) -> bytes:
+        return bytes([_COMMIT_OFFSET]) + oc.encode()
+
+    @staticmethod
+    def commit_offsets(batch: OffsetCommitBatch) -> bytes:
+        return bytes([_COMMIT_OFFSETS]) + batch.encode()
+
+    @staticmethod
+    def delete_topic(name: str) -> bytes:
+        return bytes([_DELETE_TOPIC]) + TopicTombstone(name=name).encode()
+
+    @staticmethod
     def decode(data: bytes):
         if not data:
             raise ValueError("empty transition")
@@ -60,8 +87,12 @@ class JosefineFsm:
     """Applies committed transitions to the Store (deterministic: same
     committed sequence -> same store bytes on every node)."""
 
-    def __init__(self, store: Store):
+    def __init__(self, store: Store, on_delete_topic=None):
         self.store = store
+        # Node-local side-effect hook: every node applies the same committed
+        # DeleteTopic, and each drops its own on-disk replica logs through
+        # this callback (the replicated store stays deterministic).
+        self.on_delete_topic = on_delete_topic
 
     def transition(self, data: bytes) -> bytes:
         entity = Transition.decode(data)
@@ -73,6 +104,17 @@ class JosefineFsm:
             applied = self.store.ensure_broker(entity)
         elif isinstance(entity, Group):
             applied = self.store.create_group(entity)
+        elif isinstance(entity, OffsetCommit):
+            applied = self.store.commit_offset(entity)
+        elif isinstance(entity, OffsetCommitBatch):
+            for oc in entity.entries:
+                self.store.commit_offset(oc)
+            applied = entity
+        elif isinstance(entity, TopicTombstone):
+            self.store.delete_topic(entity.name)
+            if self.on_delete_topic is not None:
+                self.on_delete_topic(entity.name)
+            applied = entity
         else:  # unreachable: decode() gates kinds
             raise ValueError(f"unhandled entity {entity!r}")
         return bytes([_TAGS[type(entity)]]) + applied.encode()

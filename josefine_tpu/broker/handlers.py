@@ -22,13 +22,22 @@ from __future__ import annotations
 
 import asyncio
 import random
+import re
 import uuid
 
 from josefine_tpu.broker import records
 from josefine_tpu.broker.fsm import Transition
+from josefine_tpu.broker.groups import GroupCoordinator
 from josefine_tpu.broker.replica import ReplicaRegistry
 from josefine_tpu.broker.state import Broker as BrokerInfo
-from josefine_tpu.broker.state import Partition, Store, Topic
+from josefine_tpu.broker.state import (
+    Group,
+    OffsetCommit,
+    OffsetCommitBatch,
+    Partition,
+    Store,
+    Topic,
+)
 from josefine_tpu.config import BrokerConfig
 from josefine_tpu.kafka import client as kafka_client
 from josefine_tpu.kafka.codec import ApiKey, ErrorCode, supported_apis
@@ -38,6 +47,15 @@ from josefine_tpu.utils.tracing import get_logger
 log = get_logger("broker.handlers")
 
 CLUSTER_ID = "josefine"  # reference metadata.rs cluster id
+
+# Kafka's legal topic names. The store's offset keys and the replica dir
+# layout rely on names never containing ':' or '/' — this is the gate that
+# guarantees it.
+_TOPIC_NAME = re.compile(r"^[a-zA-Z0-9._-]{1,249}$")
+
+
+def valid_topic_name(name: str) -> bool:
+    return bool(_TOPIC_NAME.match(name)) and name not in (".", "..")
 
 
 class Broker:
@@ -55,14 +73,31 @@ class Broker:
         self.store = store
         self.client = raft_client
         self.replicas = ReplicaRegistry(config.data_directory)
+        self.groups = GroupCoordinator(on_group_created=self._replicate_group)
         # Metadata-group leader lookup (controller identity); defaults to
         # self (the reference hardcodes controller_id 1, metadata.rs:30).
         self._leader_hint = leader_hint or (lambda: config.id)
         self._rng = random.Random()
+        # Strong refs: the loop holds tasks weakly; without this a pending
+        # fire-and-forget proposal could be garbage-collected mid-flight.
+        self._bg_tasks: set[asyncio.Task] = set()
+
+    def _replicate_group(self, group_id: str) -> None:
+        """Fire-and-forget EnsureGroup so ListGroups is cluster-wide."""
+        async def proposer():
+            try:
+                await self.client.propose(Transition.ensure_group(Group(id=group_id)))
+            except Exception as e:  # noqa: BLE001 - best-effort replication
+                log.warning("EnsureGroup(%s) replication failed: %s", group_id, e)
+        task = asyncio.get_running_loop().create_task(proposer())
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
 
     # --------------------------------------------------------------- router
 
-    async def handle_request(self, api_key: int, api_version: int, body: dict) -> dict | None:
+    async def handle_request(self, api_key: int, api_version: int, body: dict,
+                             client_id: str | None = None,
+                             client_host: str = "") -> dict | None:
         """Dispatch one decoded request; returns the response body, or None
         when the connection should be closed (undecodable API)."""
         if body is None:
@@ -77,8 +112,12 @@ class Broker:
                 return self.metadata(api_version, body)
             if api_key == ApiKey.CREATE_TOPICS:
                 return await self.create_topics(api_version, body)
+            if api_key == ApiKey.DELETE_TOPICS:
+                return await self.delete_topics(api_version, body)
             if api_key == ApiKey.LIST_GROUPS:
                 return self.list_groups(api_version, body)
+            if api_key == ApiKey.DESCRIBE_GROUPS:
+                return self.describe_groups(api_version, body)
             if api_key == ApiKey.FIND_COORDINATOR:
                 return self.find_coordinator(api_version, body)
             if api_key == ApiKey.LEADER_AND_ISR:
@@ -87,6 +126,20 @@ class Broker:
                 return self.produce(api_version, body)
             if api_key == ApiKey.FETCH:
                 return await self.fetch(api_version, body)
+            if api_key == ApiKey.LIST_OFFSETS:
+                return self.list_offsets(api_version, body)
+            if api_key == ApiKey.JOIN_GROUP:
+                return await self.join_group(api_version, body, client_id, client_host)
+            if api_key == ApiKey.SYNC_GROUP:
+                return await self.sync_group(api_version, body)
+            if api_key == ApiKey.HEARTBEAT:
+                return self.heartbeat(api_version, body)
+            if api_key == ApiKey.LEAVE_GROUP:
+                return self.leave_group(api_version, body)
+            if api_key == ApiKey.OFFSET_COMMIT:
+                return await self.offset_commit(api_version, body)
+            if api_key == ApiKey.OFFSET_FETCH:
+                return self.offset_fetch(api_version, body)
         except Exception:
             log.exception("handler error api=%d v=%d", api_key, api_version)
             raise
@@ -194,7 +247,10 @@ class Broker:
             num_partitions = t.get("num_partitions", 1)
             replication_factor = t.get("replication_factor", 1)
             err, msg = ErrorCode.NONE, None
-            if self.store.topic_exists(name):
+            if not valid_topic_name(name):
+                err, msg = ErrorCode.INVALID_TOPIC, (
+                    f"topic name {name!r} is not legal ([a-zA-Z0-9._-], <=249 chars)")
+            elif self.store.topic_exists(name):
                 err, msg = ErrorCode.TOPIC_ALREADY_EXISTS, f"topic {name!r} exists"
             elif num_partitions < 1:
                 err, msg = ErrorCode.INVALID_PARTITIONS, "num_partitions must be >= 1"
@@ -395,6 +451,180 @@ class Broker:
                 })
             out.append({"topic": t["topic"], "partitions": parts_out})
         return out
+
+
+    # ---------------------------------------------------------- ListOffsets
+
+    def list_offsets(self, version: int, body: dict) -> dict:
+        """Resolve log positions: timestamp -1 = latest (high watermark),
+        -2 = earliest (log start). No reference analog (its reader is a
+        stub). No time index: positive timestamps resolve to latest."""
+        topics_out = []
+        for t in body.get("topics") or []:
+            parts_out = []
+            for p in t.get("partitions") or []:
+                idx = p["partition_index"]
+                rep = self._local_replica(t["name"], idx)
+                if isinstance(rep, int):
+                    parts_out.append({"partition_index": idx, "error_code": rep,
+                                      "timestamp": -1, "offset": -1})
+                    continue
+                ts = p.get("timestamp", -1)
+                offset = 0 if ts == -2 else rep.log.next_offset()
+                parts_out.append({"partition_index": idx,
+                                  "error_code": ErrorCode.NONE,
+                                  "timestamp": -1, "offset": offset})
+            topics_out.append({"name": t["name"], "partitions": parts_out})
+        return {"throttle_time_ms": 0, "topics": topics_out}
+
+    # --------------------------------------------------------- DeleteTopics
+
+    async def delete_topics(self, version: int, body: dict) -> dict:
+        """Replicated topic deletion (the reference advertises DeleteTopics
+        but cannot decode it). Metadata removal goes through Raft; each
+        node's FSM drops its local replica logs on apply."""
+        responses = []
+        for name in body.get("topic_names") or []:
+            err = ErrorCode.NONE
+            if not self.store.topic_exists(name):
+                err = ErrorCode.UNKNOWN_TOPIC_OR_PARTITION
+            else:
+                try:
+                    await self.client.propose(Transition.delete_topic(name))
+                except (asyncio.TimeoutError, ProposalTimeout):
+                    err = ErrorCode.REQUEST_TIMED_OUT
+                except Exception:
+                    log.exception("delete_topics %s failed", name)
+                    err = ErrorCode.UNKNOWN_SERVER_ERROR
+            responses.append({"name": name, "error_code": err})
+        return {"throttle_time_ms": 0, "responses": responses}
+
+    # ------------------------------------------------- consumer group APIs
+
+    async def join_group(self, version: int, body: dict, client_id: str | None,
+                         client_host: str) -> dict:
+        protocols = [(p["name"], p.get("metadata") or b"")
+                     for p in body.get("protocols") or []]
+        resp = await self.groups.join_group(
+            group_id=body.get("group_id") or "",
+            member_id=body.get("member_id") or "",
+            protocol_type=body.get("protocol_type") or "",
+            protocols=protocols,
+            session_timeout_ms=body.get("session_timeout_ms") or 30_000,
+            rebalance_timeout_ms=body.get("rebalance_timeout_ms") or 0,
+            client_id=client_id or "",
+            client_host=client_host,
+        )
+        members = [{"member_id": m["member_id"], "metadata": m["metadata"]}
+                   for m in resp.get("members", [])]
+        return {"throttle_time_ms": 0, "error_code": resp["error_code"],
+                "generation_id": resp.get("generation_id", -1),
+                "protocol_name": resp.get("protocol_name", ""),
+                "leader": resp.get("leader", ""),
+                "member_id": resp.get("member_id", ""),
+                "members": members}
+
+    async def sync_group(self, version: int, body: dict) -> dict:
+        resp = await self.groups.sync_group(
+            group_id=body.get("group_id") or "",
+            generation_id=body.get("generation_id", -1),
+            member_id=body.get("member_id") or "",
+            assignments=body.get("assignments") or [],
+        )
+        return {"throttle_time_ms": 0, "error_code": resp["error_code"],
+                "assignment": resp.get("assignment", b"")}
+
+    def heartbeat(self, version: int, body: dict) -> dict:
+        err = self.groups.heartbeat(body.get("group_id") or "",
+                                    body.get("generation_id", -1),
+                                    body.get("member_id") or "")
+        return {"throttle_time_ms": 0, "error_code": err}
+
+    def leave_group(self, version: int, body: dict) -> dict:
+        err = self.groups.leave_group(body.get("group_id") or "",
+                                      body.get("member_id") or "")
+        return {"throttle_time_ms": 0, "error_code": err}
+
+    def describe_groups(self, version: int, body: dict) -> dict:
+        return {"throttle_time_ms": 0,
+                "groups": [self.groups.describe(g)
+                           for g in body.get("groups") or []]}
+
+    # ------------------------------------------------------ offsets APIs
+
+    async def offset_commit(self, version: int, body: dict) -> dict:
+        """Commit offsets through Raft so they survive coordinator loss
+        (real Kafka writes __consumer_offsets; the reference has nothing).
+        The whole request is one replicated transition — one consensus
+        round-trip regardless of partition count."""
+        group_id = body.get("group_id") or ""
+        gate = self.groups.validate_commit(group_id,
+                                           body.get("generation_id", -1),
+                                           body.get("member_id") or "")
+        batch = OffsetCommitBatch()
+        results: dict[tuple[str, int], int] = {}
+        for t in body.get("topics") or []:
+            for p in t.get("partitions") or []:
+                idx = p["partition_index"]
+                err = gate
+                if err == ErrorCode.NONE:
+                    if self.store.get_partition(t["name"], idx) is None:
+                        err = ErrorCode.UNKNOWN_TOPIC_OR_PARTITION
+                    else:
+                        batch.entries.append(OffsetCommit(
+                            group=group_id, topic=t["name"], partition=idx,
+                            offset=p.get("committed_offset", -1),
+                            metadata=p.get("committed_metadata")))
+                results[(t["name"], idx)] = int(err)
+        if batch.entries:
+            err = ErrorCode.NONE
+            try:
+                await self.client.propose(Transition.commit_offsets(batch))
+            except (asyncio.TimeoutError, ProposalTimeout):
+                err = ErrorCode.REQUEST_TIMED_OUT
+            except Exception:
+                log.exception("offset_commit %s failed", group_id)
+                err = ErrorCode.UNKNOWN_SERVER_ERROR
+            if err != ErrorCode.NONE:
+                for oc in batch.entries:
+                    results[(oc.topic, oc.partition)] = int(err)
+        topics_out = [
+            {"name": t["name"],
+             "partitions": [{"partition_index": p["partition_index"],
+                             "error_code": results[(t["name"], p["partition_index"])]}
+                            for p in t.get("partitions") or []]}
+            for t in body.get("topics") or []
+        ]
+        return {"throttle_time_ms": 0, "topics": topics_out}
+
+    def offset_fetch(self, version: int, body: dict) -> dict:
+        group_id = body.get("group_id") or ""
+        requested = body.get("topics")
+        topics_out = []
+        if requested is None:
+            # All committed offsets for the group (v2+ null topics).
+            by_topic: dict[str, list] = {}
+            for oc in self.store.get_offsets(group_id):
+                by_topic.setdefault(oc.topic, []).append(
+                    {"partition_index": oc.partition,
+                     "committed_offset": oc.offset,
+                     "metadata": oc.metadata, "error_code": ErrorCode.NONE})
+            topics_out = [{"name": name, "partitions": parts}
+                          for name, parts in sorted(by_topic.items())]
+        else:
+            for t in requested:
+                parts_out = []
+                for idx in t.get("partition_indexes") or []:
+                    oc = self.store.get_offset(group_id, t["name"], idx)
+                    parts_out.append({
+                        "partition_index": idx,
+                        "committed_offset": oc.offset if oc else -1,
+                        "metadata": oc.metadata if oc else None,
+                        "error_code": ErrorCode.NONE,
+                    })
+                topics_out.append({"name": t["name"], "partitions": parts_out})
+        return {"throttle_time_ms": 0, "topics": topics_out,
+                "error_code": ErrorCode.NONE}
 
 
 def _fetch_err(idx: int, err: int, high_watermark: int = -1) -> dict:

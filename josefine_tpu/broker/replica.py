@@ -10,6 +10,7 @@ NOT_LEADER_OR_FOLLOWER correctly.
 from __future__ import annotations
 
 import os
+import shutil
 
 from josefine_tpu.broker.log import Log
 from josefine_tpu.broker.state import Partition
@@ -48,6 +49,36 @@ class ReplicaRegistry:
 
     def get(self, topic: str, idx: int) -> Replica | None:
         return self._replicas.get((topic, idx))
+
+    def release_topic(self, topic: str) -> list[str]:
+        """Close and deregister every local replica of a topic (DeleteTopics)
+        and return the log dirs to purge — including dirs left by partitions
+        not currently materialized in memory (e.g. after a restart). File
+        deletion is split out so callers on an event loop can defer it to an
+        executor (rmtree of a large partition would stall the loop)."""
+        for key in [k for k in self._replicas if k[0] == topic]:
+            rep = self._replicas.pop(key)
+            try:
+                rep.close()
+            except OSError:
+                pass  # the dir is about to be purged anyway
+        dirs = []
+        data = os.path.join(self._data_dir, "data")
+        if os.path.isdir(data):
+            prefix = f"{topic}-"
+            for entry in os.listdir(data):
+                if entry.startswith(prefix) and entry[len(prefix):].isdigit():
+                    dirs.append(os.path.join(data, entry))
+        return dirs
+
+    @staticmethod
+    def purge_dirs(dirs: list[str]) -> None:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+    def drop_topic(self, topic: str) -> None:
+        """release_topic + synchronous purge (non-event-loop callers)."""
+        self.purge_dirs(self.release_topic(topic))
 
     def close(self) -> None:
         for rep in self._replicas.values():

@@ -48,6 +48,7 @@ class JosefineBroker:
         self._server = await asyncio.start_server(
             self._serve_connection, self.config.ip, self.config.port
         )
+        self.broker.groups.start()
         sock = self._server.sockets[0]
         self.bound_addr = sock.getsockname()[:2]
         log.info("broker %d listening on %s:%d", self.config.id, *self.bound_addr)
@@ -67,6 +68,7 @@ class JosefineBroker:
                 t.cancel()
             await asyncio.gather(*self._conn_tasks, return_exceptions=True)
             await self._server.wait_closed()
+        await self.broker.groups.close()
         self.broker.replicas.close()
 
     # ------------------------------------------------------------ internals
@@ -94,7 +96,9 @@ class JosefineBroker:
                     log.warning("undecodable request from %s: %s", peer, e)
                     break
                 body = await self.broker.handle_request(
-                    req["api_key"], req["api_version"], req["body"]
+                    req["api_key"], req["api_version"], req["body"],
+                    client_id=req.get("client_id"),
+                    client_host=str(peer[0]) if peer else "",
                 )
                 if body is None:
                     break  # unroutable: close (the reference panics here)

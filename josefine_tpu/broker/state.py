@@ -23,6 +23,7 @@ _TOPIC = b"topic:"
 _PARTITION = b"partition:"   # partition:{topic}:{idx:08d}
 _BROKER = b"broker:"         # broker:{id:08d}
 _GROUP = b"group:"
+_OFFSET = b"offset:"         # offset:{group}:{topic}:{idx:08d}
 
 
 def _dumps(obj) -> bytes:
@@ -101,6 +102,57 @@ class Group:
         return cls(**json.loads(raw))
 
 
+@dataclass
+class OffsetCommit:
+    """A consumer group's committed position for one partition. No reference
+    analog (its consumer-group APIs are stubs, ``list_groups.rs:5-14``);
+    replicated through Raft so committed offsets survive coordinator loss."""
+
+    group: str
+    topic: str
+    partition: int
+    offset: int
+    metadata: str | None = None
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "OffsetCommit":
+        return cls(**json.loads(raw))
+
+
+@dataclass
+class OffsetCommitBatch:
+    """All offsets of one OffsetCommit request as a single replicated
+    transition — one consensus round-trip regardless of partition count."""
+
+    entries: list[OffsetCommit] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        return _dumps({"entries": [asdict(e) for e in self.entries]})
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "OffsetCommitBatch":
+        d = json.loads(raw)
+        return cls(entries=[OffsetCommit(**e) for e in d["entries"]])
+
+
+@dataclass
+class TopicTombstone:
+    """Replicated topic deletion marker (DeleteTopics has no reference
+    analog — advertised but unimplemented there)."""
+
+    name: str
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "TopicTombstone":
+        return cls(**json.loads(raw))
+
+
 class Store:
     """Metadata store over KV. All writes flow through the replicated FSM
     (``broker/fsm.py``) — handlers only read."""
@@ -155,6 +207,20 @@ class Store:
     def get_brokers(self) -> list[Broker]:
         return [Broker.decode(v) for _, v in self._kv.scan_prefix(self._pfx + _BROKER)]
 
+    def delete_topic(self, name: str) -> None:
+        """Remove a topic, its partitions, and all groups' offsets for it."""
+        self._kv.delete(self._pfx + _TOPIC + name.encode())
+        pfx = self._pfx + _PARTITION + name.encode() + b":"
+        for k, _ in list(self._kv.scan_prefix(pfx)):
+            self._kv.delete(k)
+        for k, _ in list(self._kv.scan_prefix(self._pfx + _OFFSET)):
+            # key body = {group}:{topic}:{idx:08d}; topic names cannot contain
+            # ':' (Kafka restricts them to [a-zA-Z0-9._-]) so parse from the
+            # right — group ids are unrestricted.
+            body = k[len(self._pfx + _OFFSET):-9]
+            if body.rsplit(b":", 1)[-1] == name.encode():
+                self._kv.delete(k)
+
     # ------------------------------------------------------------- groups
 
     def create_group(self, group: Group) -> Group:
@@ -163,3 +229,21 @@ class Store:
 
     def get_groups(self) -> list[Group]:
         return [Group.decode(v) for _, v in self._kv.scan_prefix(self._pfx + _GROUP)]
+
+    # ------------------------------------------------------------- offsets
+
+    def _offset_key(self, group: str, topic: str, partition: int) -> bytes:
+        return (self._pfx + _OFFSET + group.encode() + b":" + topic.encode()
+                + b":%08d" % partition)
+
+    def commit_offset(self, oc: OffsetCommit) -> OffsetCommit:
+        self._kv.put(self._offset_key(oc.group, oc.topic, oc.partition), oc.encode())
+        return oc
+
+    def get_offset(self, group: str, topic: str, partition: int) -> OffsetCommit | None:
+        raw = self._kv.get(self._offset_key(group, topic, partition))
+        return None if raw is None else OffsetCommit.decode(raw)
+
+    def get_offsets(self, group: str) -> list[OffsetCommit]:
+        pfx = self._pfx + _OFFSET + group.encode() + b":"
+        return [OffsetCommit.decode(v) for _, v in self._kv.scan_prefix(pfx)]

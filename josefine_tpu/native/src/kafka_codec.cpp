@@ -37,12 +37,21 @@ namespace {
 enum ApiKey : int16_t {
   API_PRODUCE = 0,
   API_FETCH = 1,
+  API_LIST_OFFSETS = 2,
   API_METADATA = 3,
   API_LEADER_AND_ISR = 4,
+  API_OFFSET_COMMIT = 8,
+  API_OFFSET_FETCH = 9,
   API_FIND_COORDINATOR = 10,
+  API_JOIN_GROUP = 11,
+  API_HEARTBEAT = 12,
+  API_LEAVE_GROUP = 13,
+  API_SYNC_GROUP = 14,
+  API_DESCRIBE_GROUPS = 15,
   API_LIST_GROUPS = 16,
   API_API_VERSIONS = 18,
   API_CREATE_TOPICS = 19,
+  API_DELETE_TOPICS = 20,
 };
 
 struct ApiRange { int16_t key, min_ver, max_ver, flexible_from; };
@@ -52,12 +61,21 @@ struct ApiRange { int16_t key, min_ver, max_ver, flexible_from; };
 const ApiRange API_RANGES[] = {
     {API_PRODUCE, 2, 8, 9},
     {API_FETCH, 4, 6, 12},
+    {API_LIST_OFFSETS, 1, 2, 6},
     {API_METADATA, 0, 5, 9},
     {API_LEADER_AND_ISR, 0, 0, 4},
+    {API_OFFSET_COMMIT, 2, 3, 8},
+    {API_OFFSET_FETCH, 1, 3, 6},
     {API_FIND_COORDINATOR, 0, 2, 3},
+    {API_JOIN_GROUP, 0, 2, 6},
+    {API_HEARTBEAT, 0, 1, 4},
+    {API_LEAVE_GROUP, 0, 1, 4},
+    {API_SYNC_GROUP, 0, 1, 4},
+    {API_DESCRIBE_GROUPS, 0, 1, 5},
     {API_LIST_GROUPS, 0, 2, 3},
     {API_API_VERSIONS, 0, 3, 3},
     {API_CREATE_TOPICS, 0, 2, 5},
+    {API_DELETE_TOPICS, 0, 1, 4},
 };
 
 const ApiRange* find_api(int16_t key) {
@@ -149,6 +167,7 @@ enum FType : uint8_t {
   T_BYTES, T_NBYTES,     // bytes / nullable bytes
   T_ARRAY, T_NARRAY,     // array of structs / nullable array of structs
   T_INT32S,              // array of int32
+  T_STRINGS,             // array of string
 };
 
 struct Schema;
@@ -346,6 +365,158 @@ SCHEMA(CREATE_TOPICS_RESP,
   FLD({"throttle_time_ms", T_INT32, 2, 127, nullptr}),
   FLD({"topics", T_ARRAY, 0, 127, &CT_RTOPIC}))
 
+// -- ListOffsets (v1-v2; v1 switched to single-offset responses)
+SCHEMA(LO_REQ_PART,
+  FLD({"partition_index", T_INT32, 0, 127, nullptr}),
+  FLD({"timestamp", T_INT64, 0, 127, nullptr}))
+SCHEMA(LO_REQ_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partitions", T_ARRAY, 0, 127, &LO_REQ_PART}))
+SCHEMA(LIST_OFFSETS_REQ,
+  FLD({"replica_id", T_INT32, 0, 127, nullptr}),
+  FLD({"isolation_level", T_INT8, 2, 127, nullptr}),
+  FLD({"topics", T_ARRAY, 0, 127, &LO_REQ_TOPIC}))
+SCHEMA(LO_RESP_PART,
+  FLD({"partition_index", T_INT32, 0, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}),
+  FLD({"timestamp", T_INT64, 1, 127, nullptr}),
+  FLD({"offset", T_INT64, 1, 127, nullptr}))
+SCHEMA(LO_RESP_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partitions", T_ARRAY, 0, 127, &LO_RESP_PART}))
+SCHEMA(LIST_OFFSETS_RESP,
+  FLD({"throttle_time_ms", T_INT32, 2, 127, nullptr}),
+  FLD({"topics", T_ARRAY, 0, 127, &LO_RESP_TOPIC}))
+
+// -- OffsetCommit (v2-v3)
+SCHEMA(OC_REQ_PART,
+  FLD({"partition_index", T_INT32, 0, 127, nullptr}),
+  FLD({"committed_offset", T_INT64, 0, 127, nullptr}),
+  FLD({"committed_metadata", T_NSTRING, 0, 127, nullptr}))
+SCHEMA(OC_REQ_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partitions", T_ARRAY, 0, 127, &OC_REQ_PART}))
+SCHEMA(OFFSET_COMMIT_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"generation_id", T_INT32, 1, 127, nullptr}),
+  FLD({"member_id", T_STRING, 1, 127, nullptr}),
+  FLD({"retention_time_ms", T_INT64, 2, 4, nullptr}),
+  FLD({"topics", T_ARRAY, 0, 127, &OC_REQ_TOPIC}))
+SCHEMA(OC_RESP_PART,
+  FLD({"partition_index", T_INT32, 0, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}))
+SCHEMA(OC_RESP_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partitions", T_ARRAY, 0, 127, &OC_RESP_PART}))
+SCHEMA(OFFSET_COMMIT_RESP,
+  FLD({"throttle_time_ms", T_INT32, 3, 127, nullptr}),
+  FLD({"topics", T_ARRAY, 0, 127, &OC_RESP_TOPIC}))
+
+// -- OffsetFetch (v1-v3; topics nullable from v2 = "all topics")
+SCHEMA(OF_REQ_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partition_indexes", T_INT32S, 0, 127, nullptr}))
+SCHEMA(OFFSET_FETCH_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"topics", T_NARRAY, 0, 127, &OF_REQ_TOPIC}))
+SCHEMA(OF_RESP_PART,
+  FLD({"partition_index", T_INT32, 0, 127, nullptr}),
+  FLD({"committed_offset", T_INT64, 0, 127, nullptr}),
+  FLD({"metadata", T_NSTRING, 0, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}))
+SCHEMA(OF_RESP_TOPIC,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"partitions", T_ARRAY, 0, 127, &OF_RESP_PART}))
+SCHEMA(OFFSET_FETCH_RESP,
+  FLD({"throttle_time_ms", T_INT32, 3, 127, nullptr}),
+  FLD({"topics", T_ARRAY, 0, 127, &OF_RESP_TOPIC}),
+  FLD({"error_code", T_INT16, 2, 127, nullptr}))
+
+// -- JoinGroup (v0-v2)
+SCHEMA(JG_PROTOCOL,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"metadata", T_BYTES, 0, 127, nullptr}))
+SCHEMA(JOIN_GROUP_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"session_timeout_ms", T_INT32, 0, 127, nullptr}),
+  FLD({"rebalance_timeout_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"protocol_type", T_STRING, 0, 127, nullptr}),
+  FLD({"protocols", T_ARRAY, 0, 127, &JG_PROTOCOL}))
+SCHEMA(JG_MEMBER,
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"metadata", T_BYTES, 0, 127, nullptr}))
+SCHEMA(JOIN_GROUP_RESP,
+  FLD({"throttle_time_ms", T_INT32, 2, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}),
+  FLD({"generation_id", T_INT32, 0, 127, nullptr}),
+  FLD({"protocol_name", T_STRING, 0, 127, nullptr}),
+  FLD({"leader", T_STRING, 0, 127, nullptr}),
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"members", T_ARRAY, 0, 127, &JG_MEMBER}))
+
+// -- Heartbeat (v0-v1)
+SCHEMA(HEARTBEAT_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"generation_id", T_INT32, 0, 127, nullptr}),
+  FLD({"member_id", T_STRING, 0, 127, nullptr}))
+SCHEMA(HEARTBEAT_RESP,
+  FLD({"throttle_time_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}))
+
+// -- LeaveGroup (v0-v1)
+SCHEMA(LEAVE_GROUP_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"member_id", T_STRING, 0, 127, nullptr}))
+SCHEMA(LEAVE_GROUP_RESP,
+  FLD({"throttle_time_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}))
+
+// -- SyncGroup (v0-v1)
+SCHEMA(SG_ASSIGNMENT,
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"assignment", T_BYTES, 0, 127, nullptr}))
+SCHEMA(SYNC_GROUP_REQ,
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"generation_id", T_INT32, 0, 127, nullptr}),
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"assignments", T_ARRAY, 0, 127, &SG_ASSIGNMENT}))
+SCHEMA(SYNC_GROUP_RESP,
+  FLD({"throttle_time_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}),
+  FLD({"assignment", T_BYTES, 0, 127, nullptr}))
+
+// -- DescribeGroups (v0-v1)
+SCHEMA(DESCRIBE_GROUPS_REQ,
+  FLD({"groups", T_STRINGS, 0, 127, nullptr}))
+SCHEMA(DG_MEMBER,
+  FLD({"member_id", T_STRING, 0, 127, nullptr}),
+  FLD({"client_id", T_STRING, 0, 127, nullptr}),
+  FLD({"client_host", T_STRING, 0, 127, nullptr}),
+  FLD({"member_metadata", T_BYTES, 0, 127, nullptr}),
+  FLD({"member_assignment", T_BYTES, 0, 127, nullptr}))
+SCHEMA(DG_GROUP,
+  FLD({"error_code", T_INT16, 0, 127, nullptr}),
+  FLD({"group_id", T_STRING, 0, 127, nullptr}),
+  FLD({"group_state", T_STRING, 0, 127, nullptr}),
+  FLD({"protocol_type", T_STRING, 0, 127, nullptr}),
+  FLD({"protocol_data", T_STRING, 0, 127, nullptr}),
+  FLD({"members", T_ARRAY, 0, 127, &DG_MEMBER}))
+SCHEMA(DESCRIBE_GROUPS_RESP,
+  FLD({"throttle_time_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"groups", T_ARRAY, 0, 127, &DG_GROUP}))
+
+// -- DeleteTopics (v0-v1)
+SCHEMA(DELETE_TOPICS_REQ,
+  FLD({"topic_names", T_STRINGS, 0, 127, nullptr}),
+  FLD({"timeout_ms", T_INT32, 0, 127, nullptr}))
+SCHEMA(DT_RESP,
+  FLD({"name", T_STRING, 0, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}))
+SCHEMA(DELETE_TOPICS_RESP,
+  FLD({"throttle_time_ms", T_INT32, 1, 127, nullptr}),
+  FLD({"responses", T_ARRAY, 0, 127, &DT_RESP}))
+
 struct ApiSchemas {
   int16_t key;
   const Schema* req;
@@ -354,12 +525,21 @@ struct ApiSchemas {
 const ApiSchemas API_SCHEMAS[] = {
     {API_PRODUCE, &PRODUCE_REQ, &PRODUCE_RESP},
     {API_FETCH, &FETCH_REQ, &FETCH_RESP},
+    {API_LIST_OFFSETS, &LIST_OFFSETS_REQ, &LIST_OFFSETS_RESP},
     {API_METADATA, &METADATA_REQ, &METADATA_RESP},
     {API_LEADER_AND_ISR, &LAI_REQ, &LAI_RESP},
+    {API_OFFSET_COMMIT, &OFFSET_COMMIT_REQ, &OFFSET_COMMIT_RESP},
+    {API_OFFSET_FETCH, &OFFSET_FETCH_REQ, &OFFSET_FETCH_RESP},
     {API_FIND_COORDINATOR, &FIND_COORD_REQ, &FIND_COORD_RESP},
+    {API_JOIN_GROUP, &JOIN_GROUP_REQ, &JOIN_GROUP_RESP},
+    {API_HEARTBEAT, &HEARTBEAT_REQ, &HEARTBEAT_RESP},
+    {API_LEAVE_GROUP, &LEAVE_GROUP_REQ, &LEAVE_GROUP_RESP},
+    {API_SYNC_GROUP, &SYNC_GROUP_REQ, &SYNC_GROUP_RESP},
+    {API_DESCRIBE_GROUPS, &DESCRIBE_GROUPS_REQ, &DESCRIBE_GROUPS_RESP},
     {API_LIST_GROUPS, &LIST_GROUPS_REQ, &LIST_GROUPS_RESP},
     {API_API_VERSIONS, &API_VERSIONS_REQ, &API_VERSIONS_RESP},
     {API_CREATE_TOPICS, &CREATE_TOPICS_REQ, &CREATE_TOPICS_RESP},
+    {API_DELETE_TOPICS, &DELETE_TOPICS_REQ, &DELETE_TOPICS_RESP},
 };
 
 const Schema* find_schema(int16_t key, bool response) {
@@ -432,6 +612,19 @@ PyObject* decode_field(Reader& r, const Field& f, int ver, bool flexible) {
         if (!v || PyList_Append(lst, v) < 0) { Py_XDECREF(v); Py_DECREF(lst); return nullptr; }
         Py_DECREF(v);
       }
+      return lst;
+    }
+    case T_STRINGS: {
+      int64_t cnt = decode_array_len(r, false, flexible);
+      if (!r.ok) return nullptr;
+      PyObject* lst = PyList_New(0);
+      if (!lst) return nullptr;
+      for (int64_t i = 0; i < cnt && r.ok; i++) {
+        PyObject* v = decode_string(r, false, flexible);
+        if (!v || PyList_Append(lst, v) < 0) { Py_XDECREF(v); Py_DECREF(lst); return nullptr; }
+        Py_DECREF(v);
+      }
+      if (!r.ok) { Py_DECREF(lst); return nullptr; }
       return lst;
     }
     case T_ARRAY:
@@ -547,6 +740,27 @@ bool encode_field(Writer& w, const Field& f, int ver, bool flexible, PyObject* v
         long long x = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(seq, i));
         if (x == -1 && PyErr_Occurred()) { Py_DECREF(seq); return enc_err(f.name, "element not an int"); }
         w.i32((int32_t)x);
+      }
+      Py_DECREF(seq);
+      return true;
+    }
+    case T_STRINGS: {
+      if (!v || v == Py_None) {
+        if (flexible) w.uvarint(1); else w.i32(0);
+        return true;
+      }
+      PyObject* seq = PySequence_Fast(v, "expected a sequence");
+      if (!seq) return enc_err(f.name, "not a sequence");
+      Py_ssize_t cnt = PySequence_Fast_GET_SIZE(seq);
+      if (flexible) w.uvarint((uint32_t)cnt + 1); else w.i32((int32_t)cnt);
+      for (Py_ssize_t i = 0; i < cnt; i++) {
+        PyObject* el = PySequence_Fast_GET_ITEM(seq, i);
+        Py_ssize_t len;
+        const char* s = PyUnicode_AsUTF8AndSize(el, &len);
+        if (!s) { Py_DECREF(seq); return enc_err(f.name, "element not a str"); }
+        if (len > 0x7FFF && !flexible) { Py_DECREF(seq); return enc_err(f.name, "string too long"); }
+        if (flexible) w.uvarint((uint32_t)len + 1); else w.i16((int16_t)len);
+        w.raw(s, len);
       }
       Py_DECREF(seq);
       return true;

@@ -38,10 +38,11 @@ class Node:
         self.shutdown = shutdown or Shutdown()
         self.kv = open_kv(None if in_memory else config.broker.state_file)
         self.store = Store(self.kv)
+        self.fsm = JosefineFsm(self.store)
         self.raft = JosefineRaft(
             config.raft,
             self.kv,
-            fsms={0: JosefineFsm(self.store)},
+            fsms={0: self.fsm},
             groups=config.engine.partitions,
             shutdown=self.shutdown.clone(),
         )
@@ -53,7 +54,24 @@ class Node:
             shutdown=self.shutdown.clone(),
             leader_hint=lambda: self.raft.engine.leader_id(0),
         )
+        # Committed DeleteTopic reaches every node through the FSM; each
+        # drops its own on-disk replica logs. Deregistration is synchronous
+        # (later requests must see the topic gone); the rmtree runs in an
+        # executor so FSM apply never stalls the raft event loop.
+        self.fsm.on_delete_topic = self._drop_topic_local
         self._register_task: asyncio.Task | None = None
+
+    def _drop_topic_local(self, name: str) -> None:
+        replicas = self.broker.broker.replicas
+        dirs = replicas.release_topic(name)
+        if not dirs:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            replicas.purge_dirs(dirs)
+            return
+        loop.run_in_executor(None, replicas.purge_dirs, dirs)
 
     async def start(self) -> None:
         await self.raft.start()

@@ -183,3 +183,84 @@ async def test_produce_fetch_over_the_wire(tmp_path):
             assert fp["records"].endswith(b"payload-x")
         finally:
             await cl.close()
+
+
+@pytest.mark.asyncio
+async def test_consumer_group_lifecycle_over_the_wire(tmp_path):
+    # Full consumer session: FindCoordinator -> JoinGroup -> SyncGroup ->
+    # Heartbeat -> OffsetCommit -> OffsetFetch -> LeaveGroup -> DeleteTopics.
+    # (No reference analog: every one of these APIs is a stub or
+    # wire-undecodable there, SURVEY.md quirk 8.)
+    async with NodeManager(1, tmp_path) as mgr:
+        await mgr.wait_registered()
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            resp = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "evt", "num_partitions": 2,
+                            "replication_factor": 1, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False,
+            }, timeout=20.0), 25)
+            assert resp["topics"][0]["error_code"] == ErrorCode.NONE
+
+            fc = await asyncio.wait_for(cl.send(ApiKey.FIND_COORDINATOR, 1, {
+                "key": "workers", "key_type": 0}), 10)
+            assert fc["port"] == mgr.broker_ports[0]
+
+            join = await asyncio.wait_for(cl.send(ApiKey.JOIN_GROUP, 1, {
+                "group_id": "workers", "session_timeout_ms": 10000,
+                "rebalance_timeout_ms": 2000, "member_id": "",
+                "protocol_type": "consumer",
+                "protocols": [{"name": "range", "metadata": b"sub:evt"}],
+            }, timeout=10.0), 15)
+            assert join["error_code"] == ErrorCode.NONE
+            member, gen = join["member_id"], join["generation_id"]
+            assert join["leader"] == member
+            assert join["members"][0]["metadata"] == b"sub:evt"
+
+            sync = await asyncio.wait_for(cl.send(ApiKey.SYNC_GROUP, 1, {
+                "group_id": "workers", "generation_id": gen,
+                "member_id": member,
+                "assignments": [{"member_id": member, "assignment": b"evt:0,1"}],
+            }), 10)
+            assert (sync["error_code"], sync["assignment"]) == (ErrorCode.NONE,
+                                                                b"evt:0,1")
+
+            hb = await asyncio.wait_for(cl.send(ApiKey.HEARTBEAT, 1, {
+                "group_id": "workers", "generation_id": gen,
+                "member_id": member}), 10)
+            assert hb["error_code"] == ErrorCode.NONE
+
+            oc = await asyncio.wait_for(cl.send(ApiKey.OFFSET_COMMIT, 2, {
+                "group_id": "workers", "generation_id": gen,
+                "member_id": member, "retention_time_ms": -1,
+                "topics": [{"name": "evt", "partitions": [
+                    {"partition_index": 0, "committed_offset": 12,
+                     "committed_metadata": None}]}],
+            }, timeout=10.0), 15)
+            assert oc["topics"][0]["partitions"][0]["error_code"] == ErrorCode.NONE
+
+            of = await asyncio.wait_for(cl.send(ApiKey.OFFSET_FETCH, 1, {
+                "group_id": "workers",
+                "topics": [{"name": "evt", "partition_indexes": [0, 1]}]}), 10)
+            offsets = [p["committed_offset"]
+                       for p in of["topics"][0]["partitions"]]
+            assert offsets == [12, -1]
+
+            dg = await asyncio.wait_for(cl.send(ApiKey.DESCRIBE_GROUPS, 1, {
+                "groups": ["workers"]}), 10)
+            assert dg["groups"][0]["group_state"] == "Stable"
+
+            lv = await asyncio.wait_for(cl.send(ApiKey.LEAVE_GROUP, 1, {
+                "group_id": "workers", "member_id": member}), 10)
+            assert lv["error_code"] == ErrorCode.NONE
+
+            dt = await asyncio.wait_for(cl.send(ApiKey.DELETE_TOPICS, 1, {
+                "topic_names": ["evt"], "timeout_ms": 5000}, timeout=10.0), 15)
+            assert dt["responses"][0]["error_code"] == ErrorCode.NONE
+            md = await asyncio.wait_for(cl.send(ApiKey.METADATA, 1, {
+                "topics": [{"name": "evt"}]}), 10)
+            assert (md["topics"][0]["error_code"]
+                    == ErrorCode.UNKNOWN_TOPIC_OR_PARTITION)
+        finally:
+            await cl.close()
