@@ -125,22 +125,25 @@ class GroupCoordinator:
                          protocols: list[tuple[str, bytes]], session_timeout_ms: int,
                          rebalance_timeout_ms: int, client_id: str = "",
                          client_host: str = "") -> dict:
+        # Validate everything BEFORE creating/replicating the group — a
+        # rejected join must leave no phantom group behind.
         if not group_id:
             return _join_err(ErrorCode.INVALID_GROUP_ID)
         if not (MIN_SESSION_TIMEOUT_MS <= session_timeout_ms <= MAX_SESSION_TIMEOUT_MS):
             return _join_err(ErrorCode.INVALID_SESSION_TIMEOUT)
+        if not protocols:
+            return _join_err(ErrorCode.INCONSISTENT_GROUP_PROTOCOL)
         group = self._groups.get(group_id)
+        if group is not None and group.protocol_type and \
+                protocol_type != group.protocol_type:
+            return _join_err(ErrorCode.INCONSISTENT_GROUP_PROTOCOL)
+        if member_id and (group is None or member_id not in group.members):
+            return _join_err(ErrorCode.UNKNOWN_MEMBER_ID)
         if group is None:
             group = self._groups[group_id] = GroupMeta(group_id=group_id,
                                                        protocol_type=protocol_type)
             if self._on_group_created is not None:
                 self._on_group_created(group_id)
-        if group.protocol_type and protocol_type != group.protocol_type:
-            return _join_err(ErrorCode.INCONSISTENT_GROUP_PROTOCOL)
-        if not protocols:
-            return _join_err(ErrorCode.INCONSISTENT_GROUP_PROTOCOL)
-        if member_id and member_id not in group.members:
-            return _join_err(ErrorCode.UNKNOWN_MEMBER_ID)
 
         if not member_id:
             member_id = f"{client_id or 'member'}-{uuid.uuid4()}"

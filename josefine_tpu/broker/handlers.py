@@ -505,12 +505,15 @@ class Broker:
                          client_host: str) -> dict:
         protocols = [(p["name"], p.get("metadata") or b"")
                      for p in body.get("protocols") or []]
+        session_timeout_ms = body.get("session_timeout_ms")
         resp = await self.groups.join_group(
             group_id=body.get("group_id") or "",
             member_id=body.get("member_id") or "",
             protocol_type=body.get("protocol_type") or "",
             protocols=protocols,
-            session_timeout_ms=body.get("session_timeout_ms") or 30_000,
+            # `or` would coerce an (invalid) explicit 0 into the default and
+            # mask the client bug; only absence gets the default.
+            session_timeout_ms=30_000 if session_timeout_ms is None else session_timeout_ms,
             rebalance_timeout_ms=body.get("rebalance_timeout_ms") or 0,
             client_id=client_id or "",
             client_host=client_host,

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import shutil
+import uuid
 
 from josefine_tpu.broker.log import Log
 from josefine_tpu.broker.state import Partition
@@ -52,10 +53,13 @@ class ReplicaRegistry:
 
     def release_topic(self, topic: str) -> list[str]:
         """Close and deregister every local replica of a topic (DeleteTopics)
-        and return the log dirs to purge — including dirs left by partitions
-        not currently materialized in memory (e.g. after a restart). File
-        deletion is split out so callers on an event loop can defer it to an
-        executor (rmtree of a large partition would stall the loop)."""
+        and return tombstone dirs to purge — including dirs left by
+        partitions not currently materialized in memory (e.g. after a
+        restart). Each log dir is atomically renamed to a ``.deleted``
+        tombstone here, so a re-created topic can never race the deferred
+        rmtree; file deletion is split out so callers on an event loop can
+        push it to an executor (rmtree of a large partition would stall the
+        loop)."""
         for key in [k for k in self._replicas if k[0] == topic]:
             rep = self._replicas.pop(key)
             try:
@@ -68,7 +72,13 @@ class ReplicaRegistry:
             prefix = f"{topic}-"
             for entry in os.listdir(data):
                 if entry.startswith(prefix) and entry[len(prefix):].isdigit():
-                    dirs.append(os.path.join(data, entry))
+                    src = os.path.join(data, entry)
+                    dst = f"{src}.deleted.{uuid.uuid4().hex}"
+                    try:
+                        os.rename(src, dst)
+                        dirs.append(dst)
+                    except OSError:
+                        dirs.append(src)  # rename failed: purge in place
         return dirs
 
     @staticmethod

@@ -245,5 +245,8 @@ class Store:
         return None if raw is None else OffsetCommit.decode(raw)
 
     def get_offsets(self, group: str) -> list[OffsetCommit]:
+        # Group ids are unrestricted, so one id may be a ':'-extended prefix
+        # of another and over-match the scan; filter on the decoded group.
         pfx = self._pfx + _OFFSET + group.encode() + b":"
-        return [OffsetCommit.decode(v) for _, v in self._kv.scan_prefix(pfx)]
+        out = [OffsetCommit.decode(v) for _, v in self._kv.scan_prefix(pfx)]
+        return [oc for oc in out if oc.group == group]

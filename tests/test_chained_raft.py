@@ -344,3 +344,26 @@ def test_partial_membership_quorum():
     assert ((roles == LEADER) & np.array(member)).sum(axis=1).tolist() == [1] * P
     assert (roles[~np.array(member)] == FOLLOWER).all()
     assert (np.array(st.commit.s).max(axis=1) > 10).all()
+
+
+def test_churn_round_harness_converges():
+    """bench_churn's jitted round: crash all leaders -> every partition
+    re-elects within the tick budget and crashed nodes rejoin."""
+    import bench_churn
+
+    P, N = 256, 5
+    params = step_params(timeout_min=5, timeout_max=10, hb_ticks=1,
+                         auto_proposals=1)
+    st, member = cr.init_state(P, N, base_seed=3, params=params)
+    inbox = cr.empty_inbox(P, N)
+    props = jnp.zeros((P, N), jnp.int32)
+    st, inbox, _ = cr.run_ticks(params, member, st, inbox, props, 60)
+
+    st, inbox, conv = bench_churn.churn_round(params, member, st, inbox, 64)
+    conv = np.asarray(conv)
+    assert (conv > 0).all(), f"{(conv < 0).sum()} partitions never re-elected"
+    assert float(np.median(conv)) <= 20  # reference's own expectation: 20 ticks
+    # Crashed nodes were restarted and the cluster is healthy again.
+    assert np.asarray(st.alive).all()
+    roles = np.asarray(st.role)
+    assert (((roles == LEADER) & np.asarray(st.alive)).sum(axis=1) == 1).all()

@@ -381,6 +381,40 @@ async def test_offset_commit_batches_into_one_proposal(broker):
     assert broker.store.get_offset("batchy", "t", 1).offset == 2
 
 
+@pytest.mark.asyncio
+async def test_offset_fetch_no_cross_group_leak(broker):
+    """Group ids may contain ':' — one id being a prefix of another must not
+    leak offsets across groups in the all-topics fetch."""
+    await create_topic(broker, "t", partitions=1)
+    for grp, off in (("team", 1), ("team:sub", 99)):
+        await broker.offset_commit(2, {
+            "group_id": grp, "generation_id": -1, "member_id": "",
+            "topics": [{"name": "t", "partitions": [
+                {"partition_index": 0, "committed_offset": off}]}]})
+    of = broker.offset_fetch(2, {"group_id": "team", "topics": None})
+    offs = [p["committed_offset"] for t in of["topics"] for p in t["partitions"]]
+    assert offs == [1]
+
+
+@pytest.mark.asyncio
+async def test_rejected_join_leaves_no_phantom_group():
+    coord = GroupCoordinator()
+    resp = await coord.join_group("ghosty", "", "consumer", [], 10_000, 100)
+    assert resp["error_code"] == ErrorCode.INCONSISTENT_GROUP_PROTOCOL
+    assert "ghosty" not in coord._groups
+    resp = await coord.join_group("ghosty", "stale-member", "consumer",
+                                  [("r", b"")], 10_000, 100)
+    assert resp["error_code"] == ErrorCode.UNKNOWN_MEMBER_ID
+    assert "ghosty" not in coord._groups
+
+
+@pytest.mark.asyncio
+async def test_join_session_timeout_zero_rejected_via_handler(broker):
+    body = join_body() | {"session_timeout_ms": 0}
+    resp = await broker.join_group(1, body, "cli", "h")
+    assert resp["error_code"] == ErrorCode.INVALID_SESSION_TIMEOUT
+
+
 def test_offset_commit_transition_is_deterministic():
     store1, store2 = Store(MemKV()), Store(MemKV())
     payload = Transition.commit_offset(OffsetCommit(
