@@ -8,10 +8,17 @@ messages per second* summed over all followers of all partitions (the
 conservative message-op count; each message also carries a span of blocks —
 the blocks/sec rate is reported in extra).
 
+Engine: the fused multi-tick Pallas kernel (``ops/pallas_step.py``) —
+state stays resident in VMEM for a whole 100-tick window per partition tile.
+Set JOSEFINE_NO_PALLAS=1 to fall back to the per-tick XLA path
+(``chained_raft.run_ticks``); the fallback also triggers automatically if
+the Pallas path fails on this backend.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -28,6 +35,19 @@ N = 5
 TICKS = 100
 REPS = 5
 PROPOSALS_PER_TICK = 4
+TILE = 256
+
+
+def run_xla(params, member, state, inbox, proposals, ticks):
+    """XLA fallback window; returns (state, inbox, totals dict)."""
+    state, inbox, mets = cr.run_ticks(params, member, state, inbox, proposals, ticks)
+    rep = jax.tree.map(lambda a: jnp.sum(a, dtype=jnp.int32), mets)
+    totals = {
+        "accepted_msgs": int(np.asarray(rep.accepted_msgs)),
+        "accepted_blocks": int(np.asarray(rep.accepted_blocks)),
+        "commit_delta": int(np.asarray(rep.commit_delta)),
+    }
+    return state, inbox, totals
 
 
 def main():
@@ -37,25 +57,40 @@ def main():
     inbox = cr.empty_inbox(P, N)
     proposals = jnp.zeros((P, N), jnp.int32)
 
-    # Warmup: compile the scan + elect leaders + fill the replication pipeline.
-    state, inbox, _ = cr.run_ticks(params, member, state, inbox, proposals, TICKS)
-    jax.block_until_ready(jax.tree.leaves((state, inbox)))
+    engine = "pallas-fused"
+    if os.environ.get("JOSEFINE_NO_PALLAS"):
+        window = run_xla
+        engine = "xla-scan"
+    else:
+        try:
+            from josefine_tpu.ops.pallas_step import run_ticks_fused
 
-    # Time REPS dependent repetitions in one window (the first post-warmup
-    # dispatch can report an illusory sub-ms readiness through the device
-    # tunnel; a multi-rep window washes that out).
-    # Timing is bounded by a host transfer of totals that depend on every
-    # rep's work — async dispatch (or a device tunnel's optimistic
-    # block_until_ready) cannot fake it.
-    totals = None
+            def window(params, member, state, inbox, proposals, ticks):
+                return run_ticks_fused(params, member, state, inbox, proposals,
+                                       ticks, tile=TILE)
+
+            # Warmup doubles as the probe: compile and run the FULL-size
+            # window once, so a Pallas failure at real scale (not just on a
+            # tiny shape) still falls back to the XLA engine.
+            state, inbox, _ = window(params, member, state, inbox, proposals, TICKS)
+        except Exception:
+            window = run_xla
+            engine = "xla-scan (pallas unavailable)"
+
+    if engine != "pallas-fused":
+        # Warmup the fallback engine (or the explicitly requested XLA path).
+        state, inbox, _ = window(params, member, state, inbox, proposals, TICKS)
+
+    # Time REPS dependent repetitions in one window. Each window's totals are
+    # host int sums that depend on every rep's device work — async dispatch
+    # (or a device tunnel's optimistic block_until_ready) cannot fake it.
+    msgs = blocks = committed = 0
     t0 = time.perf_counter()
     for _ in range(REPS):
-        state, inbox, mets = cr.run_ticks(params, member, state, inbox, proposals, TICKS)
-        rep = jax.tree.map(lambda a: jnp.sum(a, dtype=jnp.int32), mets)
-        totals = rep if totals is None else jax.tree.map(jnp.add, totals, rep)
-    msgs = int(np.asarray(totals.accepted_msgs))
-    blocks = int(np.asarray(totals.accepted_blocks))
-    committed = int(np.asarray(totals.commit_delta))
+        state, inbox, tot = window(params, member, state, inbox, proposals, TICKS)
+        msgs += tot["accepted_msgs"]
+        blocks += tot["accepted_blocks"]
+        committed += tot["commit_delta"]
     dt = time.perf_counter() - t0
 
     leaders = int((np.asarray(state.role) == 2).sum())
@@ -67,11 +102,12 @@ def main():
         "unit": "msgs/s",
         "vs_baseline": round(value / BASELINE_APPENDS_PER_SEC, 3),
         "extra": {
+            "engine": engine,
             "partitions": P,
             "nodes_per_partition": N,
             "ticks_timed": TICKS * REPS,
             "wall_s": round(dt, 4),
-            "ticks_per_sec": round(TICKS / dt, 1),
+            "ticks_per_sec": round(TICKS * REPS / dt, 1),
             "replicated_blocks_per_sec": round(blocks / dt, 1),
             "committed_blocks_per_sec": round(committed / dt, 1),
             "leaders": leaders,

@@ -113,7 +113,7 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int):
     # -- VoteResponse (reference candidate.rs:91-98).
     is_vresp = cur & (m.kind == MSG_VOTE_RESP) & (st.role == CANDIDATE)
     st = st.replace(
-        votes=st.votes.at[src].set(st.votes[src] | (is_vresp & (m.ok == 1)))
+        votes=ids.set_row(st.votes, src, st.votes[src] | (is_vresp & (m.ok == 1)))
     )
 
     # -- AppendEntries / heartbeat (reference follower.rs:130-217).
@@ -182,8 +182,15 @@ def node_step(
 
     Parity: one iteration of the reference event loop select
     (``src/raft/server.rs:120-161``) plus ``apply_tick`` of the current role.
+
+    The fused Pallas kernel does NOT call this function — Mosaic cannot lower
+    what vmap's batching rules emit for it — but its hand-vectorized twin
+    (``ops/pallas_step._tile_step``) mirrors it statement for statement, and
+    ``tests/test_pallas_step.py`` asserts exact integer equality between the
+    two. Any semantic change here must be mirrored there.
     """
     N = member.shape[0]
+    dstN = jnp.arange(N, dtype=_I32)
     st_in = st
     commit_s0 = st.commit.s
 
@@ -194,7 +201,7 @@ def node_step(
     for src in range(N):
         m = jax.tree.map(lambda a: a[src], inbox)
         st, rep, span, acc = _process_msg(params, st, m, src)
-        reply = jax.tree.map(lambda R, r: R.at[src].set(r), reply, rep)
+        reply = jax.tree.map(lambda R, r: ids.set_row(R, src, r), reply, rep)
         acc_blocks = acc_blocks + span
         acc_msgs = acc_msgs + acc
 
@@ -204,7 +211,7 @@ def node_step(
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
     timed_out = st.alive & ~is_leader & (elapsed >= st.timeout)
     new_term = jnp.where(timed_out, st.term + 1, st.term)
-    self_vote = jnp.arange(N) == me
+    self_vote = dstN == me
     st = st.replace(
         term=new_term,
         elapsed=jnp.where(timed_out, 0, elapsed),
@@ -249,13 +256,14 @@ def node_step(
             s=st.head.s + minted,
         )
     )
+    # Self-row update via the one-hot ``self_vote`` mask rather than a
+    # traced-index ``.at[me]`` scatter — keeps this statement-for-statement
+    # alignable with the Pallas twin (``_tile_step``'s eye-mask update).
+    sv_lead = self_vote & is_leader
+    self_headN = ids.broadcast_to(st.head, (N,))
     st = st.replace(
-        match=ids.set_at(
-            st.match, me, ids.where(is_leader, st.head, ids.index(st.match, me))
-        ),
-        nxt=ids.set_at(
-            st.nxt, me, ids.where(is_leader, st.head, ids.index(st.nxt, me))
-        ),
+        match=ids.where(sv_lead, self_headN, st.match),
+        nxt=ids.where(sv_lead, self_headN, st.nxt),
     )
 
     # ---- 5. quorum commit: k-th largest match (k = quorum) via an O(N^2)
@@ -276,8 +284,7 @@ def node_step(
     # ---- 6. outbox: broadcast VoteRequest on new candidacy; leader sends
     # AE to lagging peers every tick and to all peers at heartbeat cadence
     # (leader.rs:44-51,124-174 unified); else per-src replies.
-    dst = jnp.arange(N)
-    is_peer = member & (dst != me)
+    is_peer = member & (dstN != me)
     hb_due = st.hb_elapsed >= params.hb_ticks
     send_ae = is_leader & st.alive & is_peer & (hb_due | ids.lt(st.nxt, st.head))
     st = st.replace(

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import struct
 
 
@@ -83,8 +84,27 @@ def index(b: Bid, i) -> Bid:
     return Bid(t=b.t[i], s=b.s[i])
 
 
+def set_row(x: jnp.ndarray, i, v: jnp.ndarray) -> jnp.ndarray:
+    """``x.at[i].set(v)`` for a *static* leading index, built from static
+    slices + concatenate instead of ``lax.scatter`` — Mosaic (Pallas TPU) has
+    no scatter lowering, and every consensus-step update site uses a static
+    node index anyway. Falls back to ``.at[]`` for traced indices."""
+    if not isinstance(i, (int, np.integer)):
+        return x.at[i].set(v)
+    i = int(i) % x.shape[0]  # normalize negative indices to match .at[]
+    v = jnp.asarray(v, x.dtype)
+    row = v if v.ndim == x.ndim else v[None]
+    parts = []
+    if i > 0:
+        parts.append(x[:i])
+    parts.append(row)
+    if i + 1 < x.shape[0]:
+        parts.append(x[i + 1:])
+    return jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
+
+
 def set_at(b: Bid, i, v: Bid) -> Bid:
-    return Bid(t=b.t.at[i].set(v.t), s=b.s.at[i].set(v.s))
+    return Bid(t=set_row(b.t, i, v.t), s=set_row(b.s, i, v.s))
 
 
 def broadcast_to(b: Bid, shape) -> Bid:

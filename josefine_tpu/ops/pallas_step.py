@@ -1,0 +1,475 @@
+"""Fused multi-tick Pallas TPU kernel for the batched Chained-Raft step.
+
+The XLA path (``chained_raft.run_ticks``) dispatches one fused-by-XLA tick at
+a time under a ``lax.scan``; every tick streams the full (P, N[,N]) state +
+inbox tensors HBM -> VMEM -> HBM. But partitions are **completely
+independent** — a Raft group's N nodes all live in the same partition row and
+messages never cross partitions — so a tile of partitions can run *many*
+ticks entirely in VMEM and only touch HBM twice per window. That is what this
+kernel does:
+
+* layout: partitions on the **lane** axis — state leaves ``(N, T)`` /
+  ``(N, N, T)``, inbox ``(N_dst, N_src, T)`` (the host API's ``(P, ...)``
+  layout is transposed at the window boundary, amortized over all ticks),
+* grid over P-tiles; each program loads its tile's state + in-flight inbox
+  into VMEM, runs ``ticks`` iterations of a ``fori_loop`` over
+  :func:`_tile_step`, then writes the final state + in-flight inbox back,
+* message delivery (the (dst, src) transpose of ``cluster_step_impl``) is a
+  leading-axis swap — the lane axis never moves,
+* metrics are accumulated in VMEM and reduced to 8 scalars per tile.
+
+:func:`_tile_step` is a statement-for-statement hand-vectorization of
+:func:`josefine_tpu.models.chained_raft.node_step` over the static node axis
+(the per-node scalar logic becomes (N, T) planes; per-peer rows become
+(N, N, T) bricks). It is hand-written rather than ``vmap``-derived because
+Mosaic cannot relayout the transposed i1 intermediates vmap's batching rules
+introduce; the price is a second copy of the role-machine logic, and the
+equivalence test (`tests/test_pallas_step.py`) pays it down by asserting
+exact integer equality against the XLA path. Reference semantics:
+``src/raft/follower.rs`` / ``candidate.rs`` / ``leader.rs`` with SURVEY.md
+quirks 1-5 fixed (see ``chained_raft`` module docs).
+
+Mosaic constraints honored here (pallas guide "Common Pitfalls"):
+no 1-D iota (2/3-D ``broadcasted_iota``), no scatter (static-index
+slice+concat updates), no i32<->i1 casts across HBM or loop carries (bools
+travel as int32, i1 lives only inside one tick body), lane axis is always
+the minor axis.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import (
+    CANDIDATE,
+    FOLLOWER,
+    LEADER,
+    MSG_APPEND,
+    MSG_APPEND_RESP,
+    MSG_NONE,
+    MSG_VOTE_REQ,
+    MSG_VOTE_RESP,
+    Msgs,
+    NodeState,
+    StepParams,
+)
+from josefine_tpu.ops import ids
+
+_I32 = jnp.int32
+
+# Number of scalar params packed into the SMEM params row.
+_N_PARAMS = 4
+# Number of metric scalars per tile (5 used; padded to 8 lanes).
+_N_METRICS = 8
+_METRIC_FIELDS = ("accepted_blocks", "accepted_msgs", "minted",
+                  "commit_delta", "became_leader")
+
+
+def _to_lanes(tree):
+    """(P, ...) -> (..., P): partitions onto the lane (last) axis."""
+    return jax.tree.map(lambda a: jnp.moveaxis(a, 0, -1), tree)
+
+
+def _from_lanes(tree):
+    return jax.tree.map(lambda a: jnp.moveaxis(a, -1, 0), tree)
+
+
+def _set_col(x: jnp.ndarray, j: int, v: jnp.ndarray) -> jnp.ndarray:
+    """``x[:, j, :] = v`` on a (N, N, T) brick without scatter."""
+    parts = []
+    if j > 0:
+        parts.append(x[:, :j, :])
+    parts.append(v[:, None, :].astype(x.dtype))
+    if j + 1 < x.shape[1]:
+        parts.append(x[:, j + 1:, :])
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else parts[0]
+
+
+def _set_col_bid(b: ids.Bid, j: int, v: ids.Bid) -> ids.Bid:
+    return ids.Bid(t=_set_col(b.t, j, v.t), s=_set_col(b.s, j, v.s))
+
+
+def _sel(pred2, a, b):
+    """Per-leaf where; ``pred2`` is (N, T), leaves are (N, T) or (N, N, T)."""
+    def one(x, y):
+        p = pred2 if x.ndim == 2 else pred2[:, None, :]
+        return jnp.where(p, x, y)
+    return jax.tree.map(one, a, b)
+
+
+def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
+    """One lockstep tick of a (nodes N x partitions T) tile.
+
+    Hand-vectorized twin of ``chained_raft.node_step`` (same statement
+    order, same semantics — see module docstring). Shapes: scalar-per-node
+    state leaves (N, T); votes/match/nxt (N, N_peer, T); inbox/outbox
+    (N_dst, N_src, T) / outbox indexed [sender, dst].
+
+    ALL leaves (including the logically-boolean ``alive``/``votes``/
+    ``member``) are **int32** 0/1 masks: Mosaic cannot select between
+    i1-valued vectors, so i1 appears only as ephemeral predicates.
+    """
+    N, T = member.shape
+    st_in = st
+    commit_s0 = st.commit.s
+
+    node3 = jax.lax.broadcasted_iota(_I32, (N, N, T), 0)
+    peer3 = jax.lax.broadcasted_iota(_I32, (N, N, T), 1)
+    eye3 = node3 == peer3  # [node, peer]: peer == me (i1 predicate)
+    eyei = jnp.where(eye3, 1, 0).astype(_I32)
+    alive_b = st.alive != 0
+    member_b = member != 0
+
+    # ---- 1. inbox fold (sequential over srcs; N is small and static) ----
+    reply = jax.tree.map(lambda a: jnp.zeros((N, N, T), _I32),
+                         Msgs(kind=0, term=0, x=ids.Bid(0, 0),
+                              y=ids.Bid(0, 0), z=ids.Bid(0, 0), ok=0))
+    acc_blocks = jnp.zeros((N, T), _I32)
+    acc_msgs = jnp.zeros((N, T), _I32)
+    for src in range(N):
+        m = jax.tree.map(lambda a: a[:, src, :], ib)  # leaves (N_dst, T)
+
+        valid = (m.kind != MSG_NONE) & alive_b
+        # universal term catch-up (strictly greater only; reference quirk 1
+        # fixed — node_step ``_process_msg`` step 2).
+        higher = valid & (m.term > st.term)
+        new_term = jnp.where(higher, m.term, st.term)
+        st = st.replace(
+            term=new_term,
+            role=jnp.where(higher, FOLLOWER, st.role),
+            voted_for=jnp.where(higher, -1, st.voted_for),
+            leader=jnp.where(higher, -1, st.leader),
+            elapsed=jnp.where(higher, 0, st.elapsed),
+            timeout=jnp.where(higher, cr._draw_timeout(st.seed, new_term, params),
+                              st.timeout),
+            votes=jnp.where(higher[:, None, :], 0, st.votes),
+        )
+        cur = valid & (m.term == st.term)
+
+        # VoteRequest (+ up-to-dateness check the reference omits).
+        is_vr = valid & (m.kind == MSG_VOTE_REQ)
+        grant = (
+            cur & (m.kind == MSG_VOTE_REQ) & (st.role == FOLLOWER)
+            & ((st.voted_for == -1) | (st.voted_for == src))
+            & ids.ge(m.x, st.head)
+        )
+        st = st.replace(
+            voted_for=jnp.where(grant, src, st.voted_for),
+            elapsed=jnp.where(grant, 0, st.elapsed),
+        )
+
+        # VoteResponse.
+        is_vresp = cur & (m.kind == MSG_VOTE_RESP) & (st.role == CANDIDATE)
+        st = st.replace(
+            votes=_set_col(st.votes, src,
+                           jnp.where(is_vresp & (m.ok == 1), 1, st.votes[:, src, :]))
+        )
+
+        # AppendEntries / heartbeat.
+        is_ae_kind = valid & (m.kind == MSG_APPEND)
+        is_ae = is_ae_kind & cur
+        st = st.replace(
+            role=jnp.where(is_ae, FOLLOWER, st.role),
+            leader=jnp.where(is_ae, src, st.leader),
+            elapsed=jnp.where(is_ae, 0, st.elapsed),
+        )
+        accept = is_ae & (ids.eq(m.x, st.head) | ids.eq(m.x, st.commit))
+        old_head_s = st.head.s
+        new_head = ids.where(accept, m.y, st.head)
+        new_commit = ids.where(
+            accept, ids.max_(st.commit, ids.min_(m.z, new_head)), st.commit)
+        span = jnp.where(accept, jnp.maximum(0, m.y.s - old_head_s), 0)
+        st = st.replace(head=new_head, commit=new_commit)
+
+        # AppendResponse -> progress advance.
+        is_ar = cur & (m.kind == MSG_APPEND_RESP) & (st.role == LEADER)
+        ok = m.ok == 1
+        mi = ids.Bid(t=st.match.t[:, src, :], s=st.match.s[:, src, :])
+        ni = ids.Bid(t=st.nxt.t[:, src, :], s=st.nxt.s[:, src, :])
+        st = st.replace(
+            match=_set_col_bid(st.match, src,
+                               ids.where(is_ar & ok, ids.max_(mi, m.x), mi)),
+            nxt=_set_col_bid(st.nxt, src,
+                             ids.where(is_ar,
+                                       ids.where(ok, ids.max_(ni, m.x), m.x), ni)),
+        )
+
+        # Reply (addressed to dst=src).
+        rep_kind = jnp.where(is_vr, MSG_VOTE_RESP,
+                             jnp.where(is_ae_kind, MSG_APPEND_RESP, MSG_NONE))
+        zero = jnp.zeros((N, T), _I32)
+        rep = Msgs(
+            kind=rep_kind.astype(_I32),
+            term=st.term,
+            x=ids.where(accept, st.head, st.commit),
+            y=ids.Bid(zero, zero),
+            z=ids.Bid(zero, zero),
+            ok=jnp.where(grant | accept, 1, 0).astype(_I32),
+        )
+        reply = jax.tree.map(lambda R, r: _set_col(R, src, r), reply, rep)
+        acc_blocks = acc_blocks + span
+        acc_msgs = acc_msgs + jnp.where(accept, 1, 0)
+
+    # ---- 2. timers -> candidacy ----
+    is_leader = st.role == LEADER
+    elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
+    timed_out = alive_b & ~is_leader & (elapsed >= st.timeout)
+    new_term = jnp.where(timed_out, st.term + 1, st.term)
+    me2 = jax.lax.broadcasted_iota(_I32, (N, T), 0)
+    st = st.replace(
+        term=new_term,
+        elapsed=jnp.where(timed_out, 0, elapsed),
+        role=jnp.where(timed_out, CANDIDATE, st.role),
+        voted_for=jnp.where(timed_out, me2, st.voted_for),
+        leader=jnp.where(timed_out, -1, st.leader),
+        votes=jnp.where(timed_out[:, None, :], eyei, st.votes),
+        timeout=jnp.where(timed_out, cr._draw_timeout(st.seed, new_term, params),
+                          st.timeout),
+    )
+    just_cand = timed_out
+
+    # ---- 3. election tally ----
+    member3 = member[None, :, :]                                  # i32 0/1
+    nvotes = jnp.sum(st.votes * member3, axis=1)                  # (N, T)
+    quorum = (jnp.sum(member, axis=0) // 2) + 1                   # (T,)
+    elected = alive_b & (st.role == CANDIDATE) & (nvotes >= quorum[None, :])
+    noop = ids.Bid(t=st.term, s=st.head.s + 1)
+    head_after = ids.where(elected, noop, st.head)
+    head3 = ids.Bid(t=jnp.broadcast_to(head_after.t[:, None, :], (N, N, T)),
+                    s=jnp.broadcast_to(head_after.s[:, None, :], (N, N, T)))
+    commit3 = ids.Bid(t=jnp.broadcast_to(st.commit.t[:, None, :], (N, N, T)),
+                      s=jnp.broadcast_to(st.commit.s[:, None, :], (N, N, T)))
+    fresh_match = ids.where(eye3, head3, ids.full((N, N, T)))
+    fresh_nxt = ids.where(eye3, head3, commit3)
+    el3 = elected[:, None, :]
+    st = st.replace(
+        role=jnp.where(elected, LEADER, st.role),
+        leader=jnp.where(elected, me2, st.leader),
+        head=head_after,
+        match=ids.where(el3, fresh_match, st.match),
+        nxt=ids.where(el3, fresh_nxt, st.nxt),
+        hb_elapsed=jnp.where(elected, params.hb_ticks, st.hb_elapsed),
+    )
+
+    # ---- 4. proposal minting + self progress row ----
+    is_leader = st.role == LEADER
+    minted = jnp.where(is_leader & alive_b, props + params.auto_proposals, 0)
+    st = st.replace(
+        head=ids.Bid(
+            t=jnp.where(minted > 0, st.term, st.head.t),
+            s=st.head.s + minted,
+        )
+    )
+    head3 = ids.Bid(t=jnp.broadcast_to(st.head.t[:, None, :], (N, N, T)),
+                    s=jnp.broadcast_to(st.head.s[:, None, :], (N, N, T)))
+    sv_lead = eye3 & is_leader[:, None, :]
+    st = st.replace(
+        match=ids.where(sv_lead, head3, st.match),
+        nxt=ids.where(sv_lead, head3, st.nxt),
+    )
+
+    # ---- 5. quorum commit: k-th largest match (k = quorum) ----
+    mt, ms = st.match.t, st.match.s                               # (N, Np, T)
+    ge_mat = ((mt[:, None, :, :] > mt[:, :, None, :])
+              | ((mt[:, None, :, :] == mt[:, :, None, :])
+                 & (ms[:, None, :, :] >= ms[:, :, None, :])))     # (N, Np, Npk, T)
+    support = jnp.sum(jnp.where(ge_mat, member[None, None, :, :], 0), axis=2)
+    eligible = (member3 != 0) & (support >= quorum[None, None, :])  # (N, Np, T) i1
+    best = ids.full((N, T), -1, -1)
+    for i in range(N):
+        cand = ids.Bid(t=st.match.t[:, i, :], s=st.match.s[:, i, :])
+        take = eligible[:, i, :] & ids.gt(cand, best)
+        best = ids.where(take, cand, best)
+    advance = is_leader & alive_b & (best.t == st.term) & ids.gt(best, st.commit)
+    st = st.replace(commit=ids.where(advance, best, st.commit))
+
+    # ---- 6. outbox ----
+    is_peer = (member3 != 0) & ~eye3                              # [me, dst] i1
+    hb_due = st.hb_elapsed >= params.hb_ticks
+    lead3 = (is_leader & alive_b)[:, None, :]
+    send_ae = lead3 & is_peer & (hb_due[:, None, :] | ids.lt(st.nxt, head3))
+    st = st.replace(
+        hb_elapsed=jnp.where(is_leader,
+                             jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
+    )
+    bc_vr = (just_cand & alive_b & ~is_leader)[:, None, :] & is_peer
+
+    commit3 = ids.Bid(t=jnp.broadcast_to(st.commit.t[:, None, :], (N, N, T)),
+                      s=jnp.broadcast_to(st.commit.s[:, None, :], (N, N, T)))
+    term3 = jnp.broadcast_to(st.term[:, None, :], (N, N, T))
+    kind = jnp.where(send_ae, MSG_APPEND, jnp.where(bc_vr, MSG_VOTE_REQ, reply.kind))
+    out = Msgs(
+        kind=jnp.where(alive_b[:, None, :], kind, MSG_NONE).astype(_I32),
+        term=jnp.where(send_ae | bc_vr, term3, reply.term),
+        x=ids.where(send_ae, st.nxt, ids.where(bc_vr, head3, reply.x)),
+        y=ids.where(send_ae, head3, reply.y),
+        z=ids.where(send_ae, commit3, reply.z),
+        ok=reply.ok,
+    )
+    st = st.replace(nxt=ids.where(send_ae, head3, st.nxt))
+
+    # ---- crashed nodes frozen entirely ----
+    st = _sel(st_in.alive != 0, st, st_in)
+    metrics = dict(
+        accepted_blocks=acc_blocks,
+        accepted_msgs=acc_msgs,
+        minted=minted,
+        commit_delta=st.commit.s - commit_s0,
+        became_leader=jnp.where(elected & (st_in.alive != 0), 1, 0),
+    )
+    return st, out, metrics
+
+
+def _kernel(params_ref, member_ref, props_ref, *refs, n_state: int, n_inbox: int,
+            state_def, inbox_def, N: int, ticks: int):
+    in_state = refs[:n_state]
+    in_inbox = refs[n_state:n_state + n_inbox]
+    out_state = refs[n_state + n_inbox:2 * n_state + n_inbox]
+    out_inbox = refs[2 * n_state + n_inbox:2 * (n_state + n_inbox)]
+    met_ref = refs[-1]
+
+    params = StepParams(*(params_ref[0, k] for k in range(_N_PARAMS)))
+    member_i = member_ref[:]             # (N, T) i32; bool -> != 0 per tick
+    props = props_ref[:]                 # (N, T) i32
+
+    # Everything is int32 end to end (bool leaves were converted by the host
+    # wrapper): Mosaic stores i1 vectors as i8 and cannot cast or select them.
+    state_io = [r[:] for r in in_state]
+    inbox_io = [r[:] for r in in_inbox]
+
+    def tick_body(_, carry):
+        st_leaves, ib_leaves, acc = carry
+        st = jax.tree.unflatten(state_def, st_leaves)
+        ib = jax.tree.unflatten(inbox_def, ib_leaves)
+        st, out, met = _tile_step(params, member_i, props, st, ib)
+        # Delivery: next_inbox[dst, src] = out[src, dst] — swap the two
+        # leading (non-lane) axes.
+        ib2 = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), out)
+        acc = [a + jnp.sum(met[f]) for a, f in zip(acc, _METRIC_FIELDS)]
+        return (jax.tree.leaves(st), jax.tree.leaves(ib2), acc)
+
+    acc0 = [jnp.zeros((), _I32)] * len(_METRIC_FIELDS)
+    state_io, inbox_io, acc = jax.lax.fori_loop(
+        0, ticks, tick_body, (state_io, inbox_io, acc0), unroll=False)
+
+    for r, leaf in zip(out_state, state_io):
+        r[:] = leaf
+    for r, leaf in zip(out_inbox, inbox_io):
+        r[:] = leaf
+    for k in range(len(_METRIC_FIELDS)):
+        met_ref[0, 0, k] = acc[k]
+    for k in range(len(_METRIC_FIELDS), _N_METRICS):
+        met_ref[0, 0, k] = jnp.zeros((), _I32)
+
+
+@functools.partial(jax.jit, static_argnames=("ticks", "tile", "interpret"))
+def _run_window(params, member, state, inbox, proposals, *, ticks: int,
+                tile: int, interpret: bool):
+    P, N = member.shape
+
+    # --- lane layout + pad P to a tile multiple (padded rows: member False,
+    # alive False -> frozen, no messages, zero metrics).
+    G = pl.cdiv(P, tile)
+    Ppad = G * tile
+    pad = Ppad - P
+
+    def prep(tree):
+        t = _to_lanes(tree)
+        if pad:
+            t = jax.tree.map(
+                lambda a: jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)]), t)
+        return t
+
+    state_t, inbox_t = prep(state), prep(inbox)
+    member_t = prep(member.astype(_I32))
+    props_t = prep(proposals)
+
+    state_leaves, state_def = jax.tree.flatten(state_t)
+    inbox_leaves, inbox_def = jax.tree.flatten(inbox_t)
+    state_dtypes = tuple(l.dtype for l in state_leaves)
+    inbox_dtypes = tuple(l.dtype for l in inbox_leaves)
+    # I/O as int32 (bool tiling on TPU wants (32, 128) sublanes; int32 keeps
+    # every leaf on the same (8, 128) tiling).
+    state_io = [l.astype(_I32) for l in state_leaves]
+    inbox_io = [l.astype(_I32) for l in inbox_leaves]
+
+    pk = jnp.stack([params.timeout_min, params.timeout_max, params.hb_ticks,
+                    params.auto_proposals]).reshape(1, _N_PARAMS)
+
+    def vspec(a):
+        nd = a.ndim
+        return pl.BlockSpec(
+            a.shape[:-1] + (tile,),
+            (lambda i: (0,) * (nd - 1) + (i,)),
+            memory_space=pltpu.VMEM,
+        )
+
+    in_specs = (
+        [pl.BlockSpec((1, _N_PARAMS), lambda i: (0, 0), memory_space=pltpu.SMEM),
+         vspec(member_t), vspec(props_t)]
+        + [vspec(a) for a in state_io]
+        + [vspec(a) for a in inbox_io]
+    )
+    out_specs = (
+        [vspec(a) for a in state_io]
+        + [vspec(a) for a in inbox_io]
+        + [pl.BlockSpec((1, 1, _N_METRICS), lambda i: (i, 0, 0),
+                        memory_space=pltpu.SMEM)]
+    )
+    out_shape = (
+        [jax.ShapeDtypeStruct(a.shape, _I32) for a in state_io]
+        + [jax.ShapeDtypeStruct(a.shape, _I32) for a in inbox_io]
+        + [jax.ShapeDtypeStruct((G, 1, _N_METRICS), _I32)]
+    )
+
+    kernel = functools.partial(
+        _kernel, n_state=len(state_io), n_inbox=len(inbox_io),
+        state_def=state_def, inbox_def=inbox_def, N=N, ticks=ticks)
+
+    outs = pl.pallas_call(
+        kernel,
+        grid=(G,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(pk, member_t, props_t, *state_io, *inbox_io)
+
+    ns, ni = len(state_io), len(inbox_io)
+    new_state_leaves = [o.astype(d) for o, d in zip(outs[:ns], state_dtypes)]
+    new_inbox_leaves = [o.astype(d) for o, d in zip(outs[ns:ns + ni], inbox_dtypes)]
+    tile_metrics = outs[-1]
+
+    def unprep(tree):
+        if pad:
+            tree = jax.tree.map(lambda a: a[..., :P], tree)
+        return _from_lanes(tree)
+
+    new_state = unprep(jax.tree.unflatten(state_def, new_state_leaves))
+    new_inbox = unprep(jax.tree.unflatten(inbox_def, new_inbox_leaves))
+    return new_state, new_inbox, tile_metrics
+
+
+def run_ticks_fused(params, member, state, inbox, proposals, ticks: int,
+                    tile: int = 512, interpret: bool = False):
+    """Run ``ticks`` lockstep ticks in one fused kernel launch per tile.
+
+    Same contract as :func:`chained_raft.run_ticks` (``proposals`` re-offered
+    every tick) except metrics come back as a dict of **window totals**
+    (int64 host scalars summed across tiles) instead of per-tick vectors:
+    keys ``accepted_blocks, accepted_msgs, minted, commit_delta,
+    became_leader``. Inputs/outputs use the standard (P, ...) layout.
+    """
+    state, inbox, tile_metrics = _run_window(
+        params, member, state, inbox, proposals,
+        ticks=int(ticks), tile=int(tile), interpret=bool(interpret))
+    tm = np.asarray(tile_metrics).astype(np.int64).sum(axis=(0, 1))
+    totals = {f: int(tm[i]) for i, f in enumerate(_METRIC_FIELDS)}
+    return state, inbox, totals
