@@ -119,6 +119,17 @@ class JosefineFsm:
             raise ValueError(f"unhandled entity {entity!r}")
         return bytes([_TAGS[type(entity)]]) + applied.encode()
 
+    # Raft snapshot support (engine log compaction + follower snapshot
+    # install — see josefine_tpu.raft.fsm.Fsm docs). The store dump is
+    # deterministic (sorted pairs), so every node snapshots byte-identically
+    # at the same commit point.
+
+    def snapshot(self) -> bytes:
+        return self.store.dump()
+
+    def restore(self, data: bytes) -> None:
+        self.store.load(data)
+
 
 def decode_result(data: bytes):
     """Decode a transition result (same framing as the transition)."""

@@ -161,6 +161,31 @@ class Store:
         self._kv = kv
         self._pfx = prefix
 
+    # ----------------------------------------------------- snapshot dump
+
+    def dump(self) -> bytes:
+        """Canonical byte dump of the whole store (for Raft snapshots):
+        sorted length-prefixed (key, value) pairs under our prefix."""
+        pairs = sorted(self._kv.scan_prefix(self._pfx))
+        out = bytearray()
+        for k, v in pairs:
+            k = k[len(self._pfx):]
+            out += len(k).to_bytes(4, "big") + k
+            out += len(v).to_bytes(4, "big") + v
+        return bytes(out)
+
+    def load(self, raw: bytes) -> None:
+        """Replace the store's contents with a dump() image."""
+        for k, _ in list(self._kv.scan_prefix(self._pfx)):
+            self._kv.delete(k)
+        i = 0
+        while i < len(raw):
+            klen = int.from_bytes(raw[i:i + 4], "big"); i += 4
+            k = raw[i:i + klen]; i += klen
+            vlen = int.from_bytes(raw[i:i + 4], "big"); i += 4
+            v = raw[i:i + vlen]; i += vlen
+            self._kv.put(self._pfx + k, v)
+
     # ------------------------------------------------------------- topics
 
     def create_topic(self, topic: Topic) -> Topic:

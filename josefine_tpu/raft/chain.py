@@ -30,6 +30,7 @@ GENESIS = 0  # (term 0, seq 0)
 
 _COMMIT_KEY = b"meta:commit"
 _HEAD_KEY = b"meta:head"
+_FLOOR_KEY = b"meta:floor"
 _BLOCK_PREFIX = b"b:"
 
 
@@ -104,6 +105,13 @@ class Chain:
         else:
             (self.head,) = struct.unpack(">Q", raw_head)
             (self.committed,) = struct.unpack(">Q", raw_commit)
+        raw_floor = kv.get(prefix + _FLOOR_KEY)
+        # Snapshot floor: blocks at or below this id (except the floor block
+        # itself, kept as the branch anchor) have been truncated away. The
+        # reference only has config knobs for this (vestigial snapshotting,
+        # src/raft/config.rs:38-40, Progress<Snapshot> never constructed —
+        # SURVEY.md aux notes); here it is real.
+        self.floor = GENESIS if raw_floor is None else struct.unpack(">Q", raw_floor)[0]
 
     # ------------------------------------------------------------- reads
 
@@ -121,11 +129,15 @@ class Chain:
         out: list[Block] = []
         cur = to_id
         while cur != from_id:
+            if cur < self.floor:
+                raise ChainError(
+                    f"range: {cur:#x} below snapshot floor {self.floor:#x}"
+                )
             b = self.get(cur)
             if b is None:
                 raise ChainError(f"range: missing block {cur:#x}")
             out.append(b)
-            if cur == GENESIS:
+            if cur == GENESIS or cur == self.floor:
                 raise ChainError(f"range: {from_id:#x} not an ancestor of {to_id:#x}")
             cur = b.parent
         out.reverse()
@@ -188,7 +200,7 @@ class Chain:
         cur = self.head
         while True:
             live.add(cur)
-            if cur == GENESIS:
+            if cur == GENESIS or cur == self.floor:
                 break
             b = self.get(cur)
             if b is None:
@@ -204,6 +216,60 @@ class Chain:
         if dead:
             log.debug("compacted %d dead blocks", len(dead))
         return len(dead)
+
+    def truncate(self, upto: int) -> int:
+        """Log compaction after a snapshot at committed block ``upto``:
+        delete every block with id below ``upto`` and strip ``upto``'s
+        payload (it is captured by the snapshot), keeping it as the branch
+        anchor so children's parent-exists checks still pass. Returns the
+        number of blocks deleted.
+
+        The reference never implements this (snapshot knobs are vestigial);
+        here the id keyspace makes it a prefix scan: ids are (term << 32) |
+        seq and anything below the committed id is either an ancestor or a
+        dead branch.
+        """
+        if upto <= self.floor:
+            return 0
+        if upto > self.committed:
+            raise ChainError(
+                f"truncate: {upto:#x} beyond commit {self.committed:#x}"
+            )
+        anchor = self.get(upto)
+        if anchor is None:
+            raise ChainError(f"truncate: unknown block {upto:#x}")
+        removed = 0
+        for k, _ in list(self._kv.scan_prefix(self._pfx + _BLOCK_PREFIX)):
+            (bid,) = struct.unpack(">Q", k[len(self._pfx) + len(_BLOCK_PREFIX):])
+            if bid < upto:
+                self._kv.delete(k)
+                removed += 1
+        if anchor.data:
+            self._kv.put(self._pfx + _block_key(upto),
+                         _encode_block(Block(id=upto, parent=GENESIS)))
+        self.floor = upto
+        self._kv.put(self._pfx + _FLOOR_KEY, struct.pack(">Q", upto))
+        log.debug("truncated %d blocks below %#x", removed, upto)
+        return removed
+
+    def install_snapshot(self, snap_id: int) -> None:
+        """Replace the entire chain with a snapshot anchor at ``snap_id``
+        (follower catch-up when the leader has truncated past our head).
+        After this: head = commit = floor = snap_id, no other blocks."""
+        if snap_id <= self.committed and self.committed != GENESIS:
+            raise ChainError(
+                f"install_snapshot: {snap_id:#x} not ahead of commit "
+                f"{self.committed:#x}"
+            )
+        for k, _ in list(self._kv.scan_prefix(self._pfx + _BLOCK_PREFIX)):
+            self._kv.delete(k)
+        self._kv.put(self._pfx + _block_key(snap_id),
+                     _encode_block(Block(id=snap_id, parent=GENESIS)))
+        self.committed = snap_id
+        self._kv.put(self._pfx + _COMMIT_KEY, struct.pack(">Q", snap_id))
+        self.floor = snap_id
+        self._kv.put(self._pfx + _FLOOR_KEY, struct.pack(">Q", snap_id))
+        self._set_head(snap_id)
 
     def force_head(self, bid: int) -> None:
         """Point head at a stored block (engine reconciliation after the

@@ -43,8 +43,8 @@ from josefine_tpu.models.types import (
 )
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
-from josefine_tpu.raft.chain import Chain, pack_id, id_term, id_seq
-from josefine_tpu.raft.fsm import Driver, Fsm
+from josefine_tpu.raft.chain import GENESIS, Chain, pack_id, id_term, id_seq
+from josefine_tpu.raft.fsm import Driver, Fsm, supports_snapshot
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.tracing import get_logger
 
@@ -96,6 +96,8 @@ class RaftEngine:
         fsms: dict[int, Fsm] | None = None,
         params: StepParams | None = None,
         base_seed: int = 0,
+        snapshot_threshold: int | None = None,
+        snapshot_interval_ticks: int | None = None,
     ):
         self.kv = kv
         self.node_ids = sorted(node_ids)
@@ -114,6 +116,43 @@ class RaftEngine:
 
         self.chains = [Chain(kv, prefix=b"g%d:" % g) for g in range(groups)]
         self.drivers = {g: Driver(fsm) for g, fsm in (fsms or {}).items()}
+
+        # Snapshotting / log compaction (real, unlike the reference's
+        # vestigial knobs): take an FSM snapshot + truncate the chain when a
+        # group has >= snapshot_threshold committed blocks above its floor,
+        # or every snapshot_interval_ticks ticks if it made any progress.
+        self.snapshot_threshold = snapshot_threshold
+        self.snapshot_interval_ticks = snapshot_interval_ticks
+        self._ticks = 0
+        self._last_snap_tick: dict[int, int] = {}
+        self._snap_sent_tick: dict[tuple[int, int], int] = {}
+
+        # Restart recovery for snapshot-capable FSMs: restore the latest
+        # snapshot, then replay the committed suffix (snap, commit] — the
+        # classic snapshot + WAL-replay recovery the reference lacks (it
+        # relies on sled durability alone). FSMs without restore() are
+        # assumed durable in their own right and get no replay.
+        for g, drv in self.drivers.items():
+            if not supports_snapshot(drv.fsm):
+                continue
+            ch = self.chains[g]
+            if ch.committed == GENESIS:
+                continue
+            snap_id, snap_data = self._load_snapshot(g)
+            start = GENESIS
+            if snap_id is not None:
+                drv.fsm.restore(snap_data)
+                start = snap_id
+            else:
+                # No snapshot yet: reset to the empty baseline before the
+                # full replay so replay is the sole source of state — a
+                # durable FSM must never see its transitions applied twice
+                # on top of its already-current contents. (Replay-time
+                # side-effect hooks like on_delete_topic are wired after
+                # engine construction precisely so they do not fire here.)
+                drv.fsm.restore(b"")
+            if ch.committed > start:
+                drv.apply(ch.range(start, ch.committed))
 
         full, member = cr.init_state(groups, self.N, base_seed=base_seed, params=self.params)
         self.member = member  # (P, N)
@@ -145,7 +184,11 @@ class RaftEngine:
 
     def receive(self, msg: rpc.WireMsg) -> None:
         """Queue a consensus wire message for the next tick. Malformed AE
-        spans are dropped here (see module invariant)."""
+        spans are dropped here (see module invariant). InstallSnapshot is
+        handled immediately, host-side — it never enters the device inbox."""
+        if msg.kind == rpc.MSG_SNAPSHOT:
+            self._install_snapshot(msg)
+            return
         if msg.kind not in (rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND, rpc.MSG_APPEND_RESP):
             raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
         if not msg.span_is_valid():
@@ -274,6 +317,8 @@ class RaftEngine:
         self._h_leader = n_leader.astype(np.int64)
 
         res.outbound = self._decode_outbox(outbox)
+        self._ticks += 1
+        self._maybe_snapshot()
         return res
 
     # ------------------------------------------------------------ lookups
@@ -290,6 +335,99 @@ class RaftEngine:
 
     def term(self, group: int = 0) -> int:
         return int(self._h_term[group])
+
+    # --------------------------------------------------------- snapshots
+
+    def _load_snapshot(self, g: int) -> tuple[int | None, bytes]:
+        raw_id = self.kv.get(b"g%d:snap:id" % g)
+        if raw_id is None:
+            return None, b""
+        data = self.kv.get(b"g%d:snap:data" % g) or b""
+        return int.from_bytes(raw_id, "big"), data
+
+    def _store_snapshot(self, g: int, snap_id: int, data: bytes) -> None:
+        self.kv.put(b"g%d:snap:data" % g, data)
+        self.kv.put(b"g%d:snap:id" % g, snap_id.to_bytes(8, "big"))
+
+    def take_snapshot(self, g: int) -> int | None:
+        """Snapshot group ``g`` at its current commit point and truncate the
+        chain below it. Returns the snapshot block id, or None if the group's
+        FSM cannot snapshot or there is nothing new to capture."""
+        drv = self.drivers.get(g)
+        if drv is None or not supports_snapshot(drv.fsm):
+            return None
+        ch = self.chains[g]
+        if ch.committed <= ch.floor:
+            return None
+        data = drv.fsm.snapshot()
+        self._store_snapshot(g, ch.committed, data)
+        snap_id = ch.committed
+        removed = ch.truncate(snap_id)
+        self._last_snap_tick[g] = self._ticks
+        log.info("snapshot g=%d at %#x (%d bytes, %d blocks truncated)",
+                 g, snap_id, len(data), removed)
+        return snap_id
+
+    def _maybe_snapshot(self) -> None:
+        if self.snapshot_threshold is None and self.snapshot_interval_ticks is None:
+            return
+        for g in self.drivers:
+            ch = self.chains[g]
+            backlog = id_seq(ch.committed) - id_seq(ch.floor)
+            if backlog <= 0:
+                continue
+            due = (
+                self.snapshot_threshold is not None
+                and backlog >= self.snapshot_threshold
+            ) or (
+                self.snapshot_interval_ticks is not None
+                and self._ticks - self._last_snap_tick.get(g, 0)
+                >= self.snapshot_interval_ticks
+            )
+            if due:
+                self.take_snapshot(g)
+
+    def _install_snapshot(self, msg: rpc.WireMsg) -> None:
+        """Follower side: adopt a leader snapshot we cannot reach by log
+        replay (our head fell below the leader's truncation floor)."""
+        g = msg.group
+        if not (0 <= g < self.P):
+            return
+        ch = self.chains[g]
+        if msg.x <= ch.committed:
+            return  # stale: we already have this prefix
+        drv = self.drivers.get(g)
+        if drv is not None:
+            if not supports_snapshot(drv.fsm):
+                log.warning(
+                    "cannot install snapshot g=%d: FSM has no restore()", g)
+                return
+            drv.drop_waiters()
+            drv.fsm.restore(msg.payload)
+        # Persist the snapshot record BEFORE mutating the chain (same order
+        # as take_snapshot): a crash in between must leave a state the
+        # restart recovery can boot from — floor > GENESIS with no matching
+        # snapshot record is unrecoverable.
+        self._store_snapshot(g, msg.x, msg.payload)
+        ch.install_snapshot(msg.x)
+        # Adopt the snapshot's mint term if it is ahead of ours: the
+        # term >= id_term(head) invariant must hold or a later election won
+        # at a lower term would mint a non-advancing block id.
+        snap_term = id_term(msg.x)
+        if snap_term > int(self._h_term[g]):
+            self._store_meta(g, b"term", snap_term)
+            self._h_term[g] = snap_term
+            self.state = self.state.replace(
+                term=self.state.term.at[g].set(jnp.asarray(snap_term, _I32)))
+        # Re-point this node's device row at the snapshot: head = commit =
+        # snap id. The next AE probe not rooted here is rejected with our
+        # commit as the hint, re-rooting the leader in 2 ticks.
+        t, s = jnp.asarray(snap_term, _I32), jnp.asarray(id_seq(msg.x), _I32)
+        self.state = self.state.replace(
+            head=ids.Bid(self.state.head.t.at[g].set(t), self.state.head.s.at[g].set(s)),
+            commit=ids.Bid(self.state.commit.t.at[g].set(t), self.state.commit.s.at[g].set(s)),
+        )
+        log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(msg.payload))
 
     # ------------------------------------------------------------ helpers
 
@@ -354,8 +492,24 @@ class RaftEngine:
                 ok=int(okf[g, dst]),
             )
             if m.kind == rpc.MSG_APPEND and m.y != m.x:
+                ch = self.chains[g]
+                if m.x < ch.floor:
+                    # The span bottom is below our truncation floor: log
+                    # replay cannot reach this follower — ship the snapshot
+                    # (throttled; it is the large message here) plus a
+                    # heartbeat probe. The probe keeps the device-level
+                    # reject/re-root loop alive, so once the follower has
+                    # installed, its reject hint (= snapshot id) re-roots
+                    # our send pointer above the floor within 2 ticks.
+                    snap = self._snapshot_msg(g, dst, m)
+                    if snap is not None:
+                        out.append(snap)
+                    m.y = m.x
+                    m.z = min(m.z, m.x)
+                    out.append(m)
+                    continue
                 try:
-                    m.blocks = self.chains[g].range(m.x, m.y)
+                    m.blocks = ch.range(m.x, m.y)
                 except Exception:
                     # Can't materialize the span (e.g. probe pointer on a
                     # branch we no longer hold): send a pure heartbeat at the
@@ -366,3 +520,18 @@ class RaftEngine:
                     m.z = min(m.z, m.x)
             out.append(m)
         return out
+
+    def _snapshot_msg(self, g: int, dst: int, ae: rpc.WireMsg) -> rpc.WireMsg | None:
+        last = self._snap_sent_tick.get((g, dst))
+        if last is not None and self._ticks - last < 5:
+            return None  # in flight; don't spam the big payload every tick
+        snap_id, data = self._load_snapshot(g)
+        if snap_id is None or snap_id != self.chains[g].floor:
+            log.warning("no usable snapshot for floor %#x g=%d",
+                        self.chains[g].floor, g)
+            return None
+        self._snap_sent_tick[(g, dst)] = self._ticks
+        return rpc.WireMsg(
+            kind=rpc.MSG_SNAPSHOT, group=g, src=self.me, dst=dst,
+            term=ae.term, x=snap_id, z=ae.z, payload=data,
+        )

@@ -26,9 +26,27 @@ class Fsm(Protocol):
     """Apply one committed payload, return the response bytes.
 
     Must be deterministic: every node applies the same committed sequence.
+
+    An FSM may additionally implement the snapshot pair::
+
+        def snapshot(self) -> bytes        # full-state dump at this commit
+        def restore(self, data: bytes)     # replace state with a dump;
+                                           # b"" resets to the initial state
+
+    which enables log compaction (the engine truncates the chain below the
+    snapshot point) and snapshot-install catch-up for followers that fell
+    behind the truncation floor. The reference declares snapshot config
+    knobs but never implements any of this (``src/raft/config.rs:38-40``,
+    ``src/raft/progress.rs:182-203`` — SURVEY.md aux notes).
     """
 
     def transition(self, data: bytes) -> bytes: ...
+
+
+def supports_snapshot(fsm) -> bool:
+    return callable(getattr(fsm, "snapshot", None)) and callable(
+        getattr(fsm, "restore", None)
+    )
 
 
 class Driver:

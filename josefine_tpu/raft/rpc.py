@@ -32,6 +32,11 @@ from josefine_tpu.models.types import (  # noqa: E402
 # Host-only kinds (never enter the device inbox).
 MSG_CLIENT_REQ = 10
 MSG_CLIENT_RESP = 11
+# InstallSnapshot: x = snapshot block id, z = leader commit, payload = FSM
+# state dump. Handled entirely host-side; the follower's device row is
+# re-pointed at the snapshot id afterwards (the reference's never-constructed
+# Progress<Snapshot> path, src/raft/progress.rs:182-203, made real).
+MSG_SNAPSHOT = 12
 
 
 @dataclass

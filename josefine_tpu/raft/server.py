@@ -65,6 +65,10 @@ class JosefineRaft:
                 hb_ticks=max(1, config.heartbeat_timeout_ms // config.tick_ms),
             ),
             base_seed=config.id,
+            snapshot_threshold=config.snapshot_threshold,
+            snapshot_interval_ticks=max(
+                1, config.snapshot_interval_s * 1000 // config.tick_ms
+            ),
         )
         addr_by_id = {n.id: n.addr for n in config.nodes}
         self.transport = Transport(

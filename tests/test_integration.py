@@ -111,9 +111,15 @@ async def test_create_topic_replicated(tmp_path):
             }, timeout=20.0), 25)
             assert resp["topics"][0]["error_code"] == ErrorCode.NONE
 
-            # The topic's metadata replicates to EVERY node's store.
+            # The topic's metadata replicates to EVERY node's store. Wait for
+            # the full partition set, not just the topic record — the
+            # EnsurePartition commits trail the EnsureTopic commit by a tick
+            # or two on followers.
             async def all_replicated():
-                while not all(n.store.topic_exists("replicated") for n in mgr.nodes):
+                while not all(
+                    len(n.store.get_partitions("replicated")) == 2
+                    for n in mgr.nodes
+                ):
                     await asyncio.sleep(0.05)
             await asyncio.wait_for(all_replicated(), 10)
             for n in mgr.nodes:
