@@ -128,7 +128,19 @@ class JosefineFsm:
         return self.store.dump()
 
     def restore(self, data: bytes) -> None:
+        """Replace store contents with a snapshot image (b"" = reset).
+
+        Topics that existed locally but are absent from the snapshot were
+        deleted while we were behind — fire the same node-local side-effect
+        hook a live DeleteTopic commit would, so replica logs for them are
+        deregistered and purged rather than silently served forever.
+        """
+        before = {t.name for t in self.store.get_topics()}
         self.store.load(data)
+        if self.on_delete_topic is not None:
+            after = {t.name for t in self.store.get_topics()}
+            for name in before - after:
+                self.on_delete_topic(name)
 
 
 def decode_result(data: bytes):

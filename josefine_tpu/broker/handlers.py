@@ -42,9 +42,15 @@ from josefine_tpu.config import BrokerConfig
 from josefine_tpu.kafka import client as kafka_client
 from josefine_tpu.kafka.codec import ApiKey, ErrorCode, supported_apis
 from josefine_tpu.raft.server import ProposalTimeout
+from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("broker.handlers")
+
+_m_requests = REGISTRY.counter("broker_requests_total",
+                               "Kafka API requests dispatched, by api key")
+_m_errors = REGISTRY.counter("broker_request_errors_total",
+                             "Kafka API handler exceptions, by api key")
 
 CLUSTER_ID = "josefine"  # reference metadata.rs cluster id
 
@@ -100,6 +106,7 @@ class Broker:
                              client_host: str = "") -> dict | None:
         """Dispatch one decoded request; returns the response body, or None
         when the connection should be closed (undecodable API)."""
+        _m_requests.inc(api=api_key)
         if body is None:
             if api_key == ApiKey.API_VERSIONS:
                 return self._api_versions_unsupported()
@@ -141,6 +148,7 @@ class Broker:
             if api_key == ApiKey.OFFSET_FETCH:
                 return self.offset_fetch(api_version, body)
         except Exception:
+            _m_errors.inc(api=api_key)
             log.exception("handler error api=%d v=%d", api_key, api_version)
             raise
         log.warning("closing connection: unrouted api %d", api_key)

@@ -85,12 +85,17 @@ class BrokerConfig:
     state_file: str = "/tmp/josefine-tpu/state"
     data_directory: str = "/tmp/josefine-tpu/data"
     peers: list[NodeAddr] = field(default_factory=list)
+    # Observability endpoint (/metrics, /state, /healthz); 0 = disabled.
+    # TPU-build addition: the reference has no metrics at all (SURVEY.md §5).
+    metrics_port: int = 0
 
     def validate(self) -> None:
         if self.id == 0:
             raise ValueError("broker.id must be non-zero")
         if self.port <= 1023:
             raise ValueError("broker.port must be > 1023")
+        if self.metrics_port != 0 and self.metrics_port <= 1023:
+            raise ValueError("broker.metrics_port must be 0 (disabled) or > 1023")
 
 
 @dataclass

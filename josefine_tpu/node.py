@@ -60,6 +60,16 @@ class Node:
         # executor so FSM apply never stalls the raft event loop.
         self.fsm.on_delete_topic = self._drop_topic_local
         self._register_task: asyncio.Task | None = None
+        # Observability endpoint (TPU-build addition; the reference's only
+        # runtime introspection is a debug file rewritten every tick).
+        self.metrics_server = None
+        if config.broker.metrics_port:
+            from josefine_tpu.utils.metrics import MetricsServer
+
+            self.metrics_server = MetricsServer(
+                config.broker.ip, config.broker.metrics_port,
+                state_fn=lambda: self.raft.engine.debug_state(),
+            )
 
     def _drop_topic_local(self, name: str) -> None:
         replicas = self.broker.broker.replicas
@@ -76,6 +86,8 @@ class Node:
     async def start(self) -> None:
         await self.raft.start()
         await self.broker.start()
+        if self.metrics_server is not None:
+            await self.metrics_server.start()
         self._register_task = asyncio.create_task(self._register_self())
 
     async def _register_self(self) -> None:
@@ -111,6 +123,8 @@ class Node:
             await asyncio.gather(self._register_task, return_exceptions=True)
         await self.broker.stop()
         await self.raft.stop()
+        if self.metrics_server is not None:
+            await self.metrics_server.stop()
         self.kv.close()
 
 

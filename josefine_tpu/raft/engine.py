@@ -46,9 +46,19 @@ from josefine_tpu.raft import rpc
 from josefine_tpu.raft.chain import GENESIS, Chain, pack_id, id_term, id_seq
 from josefine_tpu.raft.fsm import Driver, Fsm, supports_snapshot
 from josefine_tpu.utils.kv import KV
+from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("raft.engine")
+
+_m_ticks = REGISTRY.counter("raft_ticks_total", "Engine ticks executed")
+_m_elections = REGISTRY.counter("raft_elections_won_total", "Elections won across groups")
+_m_committed = REGISTRY.counter("raft_blocks_committed_total", "Blocks committed and applied")
+_m_out = REGISTRY.counter("raft_msgs_out_total", "Consensus wire messages sent")
+_m_in = REGISTRY.counter("raft_msgs_in_total", "Consensus wire messages accepted into the inbox")
+_m_snapshots = REGISTRY.counter("raft_snapshots_total", "Snapshots taken (log compactions)")
+_m_installs = REGISTRY.counter("raft_snapshot_installs_total", "Snapshots installed from a leader")
+_m_led = REGISTRY.gauge("raft_groups_led", "Groups this node currently leads")
 
 _I32 = jnp.int32
 
@@ -126,6 +136,7 @@ class RaftEngine:
         self._ticks = 0
         self._last_snap_tick: dict[int, int] = {}
         self._snap_sent_tick: dict[tuple[int, int], int] = {}
+        self._snap_cache: dict[int, tuple[int, bytes]] = {}
 
         # Restart recovery for snapshot-capable FSMs: restore the latest
         # snapshot, then replay the committed suffix (snap, commit] — the
@@ -197,6 +208,7 @@ class RaftEngine:
         if not (0 <= msg.group < self.P) or not (0 <= msg.src < self.N):
             log.warning("dropping message for unknown group/node g=%d src=%d", msg.group, msg.src)
             return
+        _m_in.inc(node=self.self_id)
         self._pending_msgs.append(msg)
 
     def propose(self, group: int, payload: bytes) -> asyncio.Future:
@@ -301,6 +313,7 @@ class RaftEngine:
             if new_commit != ch.committed:
                 blocks = ch.commit(new_commit)
                 res.committed[g] = new_commit
+                _m_committed.inc(len(blocks), node=self.self_id)
                 drv = self.drivers.get(g)
                 if drv:
                     drv.apply(blocks)
@@ -319,6 +332,12 @@ class RaftEngine:
         res.outbound = self._decode_outbox(outbox)
         self._ticks += 1
         self._maybe_snapshot()
+        _m_ticks.inc(node=self.self_id)
+        if res.became_leader:
+            _m_elections.inc(len(res.became_leader), node=self.self_id)
+        if res.outbound:
+            _m_out.inc(len(res.outbound), node=self.self_id)
+        _m_led.set(int((self._h_role == LEADER).sum()), node=self.self_id)
         return res
 
     # ------------------------------------------------------------ lookups
@@ -336,18 +355,51 @@ class RaftEngine:
     def term(self, group: int = 0) -> int:
         return int(self._h_term[group])
 
+    def debug_state(self) -> dict:
+        """Cluster-state view for the /state endpoint — replaces the
+        reference leader's per-tick synchronous debug file
+        (``src/raft/leader.rs:101-121``, SURVEY.md quirk 7) with an
+        on-demand read of the host mirrors."""
+        out = {
+            "node": self.self_id,
+            "groups": self.P,
+            "groups_led": int((self._h_role == LEADER).sum()),
+            "ticks": self._ticks,
+        }
+        if self.P <= 64:  # full per-group detail only at small scale
+            out["detail"] = [
+                {
+                    "group": g,
+                    "term": int(self._h_term[g]),
+                    "role": int(self._h_role[g]),
+                    "leader": self.leader_id(g),
+                    "commit": self.chains[g].committed,
+                    "head": self.chains[g].head,
+                    "floor": self.chains[g].floor,
+                }
+                for g in range(self.P)
+            ]
+        return out
+
     # --------------------------------------------------------- snapshots
 
     def _load_snapshot(self, g: int) -> tuple[int | None, bytes]:
-        raw_id = self.kv.get(b"g%d:snap:id" % g)
-        if raw_id is None:
+        cached = self._snap_cache.get(g)
+        if cached is not None:
+            return cached
+        # Single record (8-byte id || data): one KV put is one transaction,
+        # so a crash can never pair an old id with a new image (which would
+        # double-apply (old, new] on restart recovery).
+        raw = self.kv.get(b"g%d:snap" % g)
+        if raw is None:
             return None, b""
-        data = self.kv.get(b"g%d:snap:data" % g) or b""
-        return int.from_bytes(raw_id, "big"), data
+        snap = (int.from_bytes(raw[:8], "big"), raw[8:])
+        self._snap_cache[g] = snap
+        return snap
 
     def _store_snapshot(self, g: int, snap_id: int, data: bytes) -> None:
-        self.kv.put(b"g%d:snap:data" % g, data)
-        self.kv.put(b"g%d:snap:id" % g, snap_id.to_bytes(8, "big"))
+        self.kv.put(b"g%d:snap" % g, snap_id.to_bytes(8, "big") + data)
+        self._snap_cache[g] = (snap_id, data)
 
     def take_snapshot(self, g: int) -> int | None:
         """Snapshot group ``g`` at its current commit point and truncate the
@@ -364,6 +416,7 @@ class RaftEngine:
         snap_id = ch.committed
         removed = ch.truncate(snap_id)
         self._last_snap_tick[g] = self._ticks
+        _m_snapshots.inc(node=self.self_id)
         log.info("snapshot g=%d at %#x (%d bytes, %d blocks truncated)",
                  g, snap_id, len(data), removed)
         return snap_id
@@ -402,7 +455,9 @@ class RaftEngine:
                 log.warning(
                     "cannot install snapshot g=%d: FSM has no restore()", g)
                 return
-            drv.drop_waiters()
+            # Fail (not cancel) outstanding proposals so clients re-route,
+            # same as the tick() leadership-loss path; msg.src is the leader.
+            drv.drop_waiters(NotLeader(g, msg.src))
             drv.fsm.restore(msg.payload)
         # Persist the snapshot record BEFORE mutating the chain (same order
         # as take_snapshot): a crash in between must leave a state the
@@ -427,6 +482,7 @@ class RaftEngine:
             head=ids.Bid(self.state.head.t.at[g].set(t), self.state.head.s.at[g].set(s)),
             commit=ids.Bid(self.state.commit.t.at[g].set(t), self.state.commit.s.at[g].set(s)),
         )
+        _m_installs.inc(node=self.self_id)
         log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(msg.payload))
 
     # ------------------------------------------------------------ helpers

@@ -18,10 +18,18 @@ import asyncio
 from typing import Callable
 
 from josefine_tpu.raft.rpc import WireMsg
+from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.shutdown import Shutdown
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("raft.tcp")
+
+_m_received = REGISTRY.counter("raft_transport_frames_received_total",
+                               "Decoded inbound transport frames")
+_m_dropped = REGISTRY.counter("raft_transport_dropped_total",
+                              "Messages dropped on a full per-peer queue")
+_m_reconnects = REGISTRY.counter("raft_transport_reconnects_total",
+                                 "Outbound peer reconnect attempts after failure")
 
 MAX_FRAME = 1 << 30
 SEND_QUEUE_DEPTH = 1000  # reference tcp.rs:63
@@ -86,6 +94,7 @@ class Transport:
             q.put_nowait(msg)
         except asyncio.QueueFull:
             self.dropped += 1
+            _m_dropped.inc(node=self.self_id)
 
     async def stop(self) -> None:
         for t in list(self._tasks) + list(self._conn_tasks):
@@ -109,6 +118,7 @@ class Transport:
                 except Exception:
                     log.warning("undecodable frame (%d bytes); closing conn", len(body))
                     break
+                _m_received.inc(node=self.self_id)
                 self.on_message(msg)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
@@ -145,5 +155,6 @@ class Transport:
             except (ConnectionError, OSError):
                 if writer is not None:
                     writer.close()
+                _m_reconnects.inc(node=self.self_id)
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, BACKOFF_MAX_S)

@@ -1,0 +1,193 @@
+"""Metrics: counters/gauges + a Prometheus-style exposition endpoint.
+
+The reference has no metrics at all — observability is tracing logs plus a
+debug JSON file the leader rewrites synchronously every 100 ms tick
+(``src/raft/leader.rs:101-121``, SURVEY.md quirk 7). Here: a process-local
+registry the hot paths bump (plain int adds; no locks — all writers run on
+the asyncio event loop), read out on demand over a tiny HTTP endpoint
+(``/metrics`` Prometheus text, ``/state`` the debug-state JSON the
+reference's tick file carried, ``/healthz``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable
+
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("metrics")
+
+
+class Counter:
+    """Monotone counter, optionally labelled. ``inc(n, label=value, ...)``."""
+
+    def __init__(self, name: str, help_: str, registry: "Registry | None" = None):
+        self.name = name
+        self.help = help_
+        self.values: dict[tuple, float] = {}
+        (registry or REGISTRY)._add(self)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        self.values[key] = self.values.get(key, 0) + n
+
+    def get(self, **labels) -> float:
+        return self.values.get(tuple(sorted(labels.items())), 0)
+
+    _TYPE = "counter"
+
+
+class Gauge(Counter):
+    """Point-in-time value; ``set()`` replaces, ``inc()`` adjusts. May also
+    wrap a callback via ``set_fn`` for sampled-at-scrape values."""
+
+    _TYPE = "gauge"
+
+    def __init__(self, name: str, help_: str, registry: "Registry | None" = None):
+        super().__init__(name, help_, registry)
+        self._fn: Callable[[], float] | None = None
+
+    def set(self, v: float, **labels) -> None:
+        self.values[tuple(sorted(labels.items()))] = v
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    def get(self, **labels) -> float:
+        if self._fn is not None and not labels:
+            return self._fn()
+        return super().get(**labels)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Counter] = {}
+
+    def _add(self, m: Counter) -> None:
+        if m.name in self._metrics:
+            raise ValueError(f"duplicate metric {m.name}")
+        self._metrics[m.name] = m
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        """Get-or-create (idempotent across node restarts in one process)."""
+        m = self._metrics.get(name)
+        if m is None:
+            m = Counter(name, help_, self)
+        return m
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        m = self._metrics.get(name)
+        if m is None:
+            m = Gauge(name, help_, self)
+        if not isinstance(m, Gauge):
+            raise ValueError(f"{name} is not a gauge")
+        return m
+
+    def dump(self) -> dict:
+        out = {}
+        for name, m in sorted(self._metrics.items()):
+            if isinstance(m, Gauge) and m._fn is not None:
+                out[name] = m.get()
+            elif len(m.values) == 1 and () in m.values:
+                out[name] = m.values[()]
+            else:
+                out[name] = {
+                    ",".join(f"{k}={v}" for k, v in key): val
+                    for key, val in sorted(m.values.items())
+                }
+        return out
+
+    def render_prometheus(self) -> str:
+        lines = []
+        for name, m in sorted(self._metrics.items()):
+            if m.help:
+                lines.append(f"# HELP {name} {m.help}")
+            lines.append(f"# TYPE {name} {m._TYPE}")
+            if isinstance(m, Gauge) and m._fn is not None:
+                lines.append(f"{name} {m.get()}")
+                continue
+            if not m.values:
+                lines.append(f"{name} 0")
+                continue
+            for key, val in sorted(m.values.items()):
+                if key:
+                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lines.append(f"{name}{{{lbl}}} {val}")
+                else:
+                    lines.append(f"{name} {val}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        self._metrics.clear()
+
+
+REGISTRY = Registry()
+
+
+class MetricsServer:
+    """Minimal asyncio HTTP/1.0 exposition server (no framework deps).
+
+    Routes: ``/metrics`` (Prometheus text), ``/state`` (JSON from the
+    supplied callback — the engine's per-group leader/term/commit view,
+    replacing the reference's per-tick debug file), ``/healthz``.
+    """
+
+    def __init__(self, host: str, port: int,
+                 state_fn: Callable[[], dict] | None = None,
+                 registry: Registry | None = None):
+        self.host = host
+        self.port = port
+        self.state_fn = state_fn
+        self.registry = registry or REGISTRY
+        self._server: asyncio.AbstractServer | None = None
+        self.bound_port: int | None = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.bound_port = self._server.sockets[0].getsockname()[1]
+        log.info("metrics endpoint on %s:%d", self.host, self.bound_port)
+        return self.bound_port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            req = await asyncio.wait_for(reader.readline(), 5)
+            parts = req.decode("latin1").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            while True:  # drain headers
+                line = await asyncio.wait_for(reader.readline(), 5)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            if path == "/metrics":
+                body = self.registry.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
+                status = "200 OK"
+            elif path == "/state":
+                state = self.state_fn() if self.state_fn else {}
+                body = json.dumps(state).encode()
+                ctype = "application/json"
+                status = "200 OK"
+            elif path == "/healthz":
+                body = b'{"ok":true}'
+                ctype = "application/json"
+                status = "200 OK"
+            else:
+                body = b"not found"
+                ctype = "text/plain"
+                status = "404 Not Found"
+            writer.write(
+                f"HTTP/1.0 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n".encode()
+                + body
+            )
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            writer.close()

@@ -1,0 +1,129 @@
+"""Metrics registry, instrumentation, and the exposition endpoint.
+
+The reference has no metrics subsystem (SURVEY.md §5: logging only plus a
+per-tick debug file); this is a TPU-build addition, so the tests define the
+contract rather than mirroring reference tests.
+"""
+
+import asyncio
+import json
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.metrics import REGISTRY, Counter, Gauge, MetricsServer, Registry
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+def test_counter_gauge_render():
+    reg = Registry()
+    c = Counter("reqs_total", "requests", reg)
+    c.inc(api=3)
+    c.inc(2, api=3)
+    c.inc(api=18)
+    g = Gauge("depth", "queue depth", reg)
+    g.set(7)
+    text = reg.render_prometheus()
+    assert '# TYPE reqs_total counter' in text
+    assert 'reqs_total{api="3"} 3' in text
+    assert 'reqs_total{api="18"} 1' in text
+    assert "depth 7" in text
+    assert reg.dump()["depth"] == 7
+
+    fn = Gauge("sampled", "", reg)
+    fn.set_fn(lambda: 42)
+    assert "sampled 42" in reg.render_prometheus()
+
+
+def test_counter_get_or_create_is_idempotent():
+    reg = Registry()
+    a = reg.counter("x_total")
+    b = reg.counter("x_total")
+    assert a is b
+
+
+def test_engine_increments_metrics():
+    kv = MemKV()
+    e = RaftEngine(kv, [99], 99, groups=2, params=PARAMS)
+    before = REGISTRY.counter("raft_ticks_total").get(node=99)
+    for _ in range(15):
+        e.tick()
+    assert REGISTRY.counter("raft_ticks_total").get(node=99) == before + 15
+    assert REGISTRY.counter("raft_elections_won_total").get(node=99) >= 2
+    assert REGISTRY.gauge("raft_groups_led").get(node=99) == 2
+    state = e.debug_state()
+    assert state["groups"] == 2 and state["groups_led"] == 2
+    assert len(state["detail"]) == 2
+    assert all(d["leader"] == 99 for d in state["detail"])
+
+
+async def _http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return head.decode("latin1").split("\r\n")[0], body
+
+
+def test_metrics_server_endpoints():
+    async def main():
+        reg = Registry()
+        reg.counter("widget_total", "widgets").inc(5)
+        srv = MetricsServer("127.0.0.1", 0, state_fn=lambda: {"ok": 1, "role": "leader"},
+                            registry=reg)
+        port = await srv.start()
+        try:
+            status, body = await _http_get(port, "/metrics")
+            assert status.endswith("200 OK")
+            assert b"widget_total 5" in body
+
+            status, body = await _http_get(port, "/state")
+            assert json.loads(body) == {"ok": 1, "role": "leader"}
+
+            status, body = await _http_get(port, "/healthz")
+            assert json.loads(body) == {"ok": True}
+
+            status, _ = await _http_get(port, "/nope")
+            assert status.endswith("404 Not Found")
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_node_metrics_endpoint(tmp_path):
+    """Full node exposes /metrics and /state when metrics_port is set."""
+    from josefine_tpu.config import JosefineConfig
+
+    async def main():
+        cfg = JosefineConfig()
+        cfg.raft.id = 1
+        cfg.raft.port = 7861
+        cfg.raft.tick_ms = 20
+        cfg.broker.id = 1
+        cfg.broker.port = 7862
+        cfg.broker.metrics_port = 7863
+        cfg.broker.state_file = str(tmp_path / "state")
+        cfg.broker.data_directory = str(tmp_path / "data")
+
+        from josefine_tpu.node import Node
+        node = Node(cfg, in_memory=True)
+        await node.start()
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if node.raft.engine.is_leader(0):
+                    break
+            status, body = await _http_get(7863, "/metrics")
+            assert status.endswith("200 OK")
+            assert b"raft_ticks_total" in body
+            status, body = await _http_get(7863, "/state")
+            st = json.loads(body)
+            assert st["node"] == 1 and st["groups_led"] == 1
+        finally:
+            await node.stop()
+
+    asyncio.run(main())

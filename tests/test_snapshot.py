@@ -110,8 +110,8 @@ def test_restart_with_snapshot_stored_but_chain_not_installed():
         # Simulate a crash right after _store_snapshot, before
         # chain.install_snapshot/truncate: a snapshot AHEAD of the local
         # chain is on disk, the chain itself is untouched.
-        kv.put(b"g0:snap:data", json.dumps(["w", "x", "y"]).encode())
-        kv.put(b"g0:snap:id", pack_id(9, 99).to_bytes(8, "big"))
+        kv.put(b"g0:snap", pack_id(9, 99).to_bytes(8, "big")
+               + json.dumps(["w", "x", "y"]).encode())
         fsm2 = SnapFsm()
         e2 = RaftEngine(kv, [1], 1, groups=1, fsms={0: fsm2}, params=PARAMS)
         # Boots; FSM reflects the newer snapshot, chain untouched.
@@ -147,7 +147,7 @@ def test_engine_auto_snapshot_and_restart_recovery():
         # Threshold crossed -> snapshot taken, chain truncated.
         ch = e.chains[0]
         assert ch.floor > GENESIS
-        assert kv.get(b"g0:snap:id") is not None
+        assert kv.get(b"g0:snap") is not None
 
         # Restart on the same KV with a FRESH (empty) volatile FSM:
         # snapshot restore + replay of the committed suffix rebuilds it.
