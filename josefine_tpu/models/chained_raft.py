@@ -18,7 +18,10 @@ decisions (SURVEY.md "quirks" 1-5):
 * conflicting AppendEntries are *rejected* (with the follower's commit as the
   probe hint), never assert-crashed,
 * fork recovery: a follower abandons a dead branch by accepting a span rooted
-  at its commit pointer (committed prefix is quorum-shared, so this is safe),
+  at its commit pointer — but only for a strictly NEWER branch head
+  (term-major id order), so stale reordered heartbeats can never regress a
+  head below acked blocks (committed prefix is quorum-shared, so this is
+  safe),
 * a fresh leader mints a no-op block so old-term entries can commit (the
   classic Raft liveness fix; the reference lacks it).
 
@@ -125,8 +128,17 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int):
         elapsed=jnp.where(is_ae, 0, st.elapsed),
     )
     # Accept if the span is rooted at our head (normal append / empty
-    # heartbeat) or at our commit pointer (dead-branch abandonment).
-    accept = is_ae & (ids.eq(m.x, st.head) | ids.eq(m.x, st.commit))
+    # heartbeat) or at our commit pointer (dead-branch abandonment) — the
+    # latter only when the offered head is at least ours (term-major id
+    # order; >= not > so idempotent duplicate spans are re-accepted rather
+    # than entering a reject/re-root livelock). Without the ge guard a
+    # stale, reordered heartbeat rooted at our commit would regress our
+    # head below blocks we already acked, letting the leader commit on
+    # phantom acks and lose the entry on failover (found by the chaos
+    # suite, tests/test_chaos.py).
+    accept = is_ae & (
+        ids.eq(m.x, st.head) | (ids.eq(m.x, st.commit) & ids.ge(m.y, st.head))
+    )
     old_head_s = st.head.s
     new_head = ids.where(accept, m.y, st.head)
     new_commit = ids.where(

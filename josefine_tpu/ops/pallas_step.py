@@ -180,7 +180,9 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
             leader=jnp.where(is_ae, src, st.leader),
             elapsed=jnp.where(is_ae, 0, st.elapsed),
         )
-        accept = is_ae & (ids.eq(m.x, st.head) | ids.eq(m.x, st.commit))
+        accept = is_ae & (
+            ids.eq(m.x, st.head) | (ids.eq(m.x, st.commit) & ids.ge(m.y, st.head))
+        )
         old_head_s = st.head.s
         new_head = ids.where(accept, m.y, st.head)
         new_commit = ids.where(

@@ -415,6 +415,10 @@ class RaftEngine:
         self._store_snapshot(g, ch.committed, data)
         snap_id = ch.committed
         removed = ch.truncate(snap_id)
+        # Piggyback dead-branch GC (reference chain.rs:239-253) on the
+        # snapshot cadence: truncation only removes blocks below the floor;
+        # abandoned fork blocks above it are collected here.
+        removed += ch.compact()
         self._last_snap_tick[g] = self._ticks
         _m_snapshots.inc(node=self.self_id)
         log.info("snapshot g=%d at %#x (%d bytes, %d blocks truncated)",

@@ -367,3 +367,30 @@ def test_churn_round_harness_converges():
     assert np.asarray(st.alive).all()
     roles = np.asarray(st.role)
     assert (((roles == LEADER) & np.asarray(st.alive)).sum(axis=1) == 1).all()
+
+
+# ------------------------------------------------- stale-heartbeat regression
+
+def test_stale_heartbeat_cannot_regress_head():
+    """Durability regression (found by tests/test_chaos.py): a reordered
+    heartbeat rooted at the follower's commit pointer but advertising an OLD
+    leader head must be rejected — otherwise the follower silently abandons
+    blocks it already acked and the leader commits on phantom acks."""
+    st = make_node(term=jnp.int32(2), head=ids.bid(2, 5), commit=ids.bid(2, 4))
+    stale_hb = msg_at(3, 1, MSG_APPEND, term=2, x=(2, 4), y=(2, 4), z=(2, 4))
+    st2, out, met = step(st, stale_hb)
+    assert (int(st2.head.t), int(st2.head.s)) == (2, 5)  # head unchanged
+    # The reply is a reject whose hint re-roots the leader at our commit.
+    assert int(out.kind[1]) == MSG_APPEND_RESP
+    assert int(out.ok[1]) == 0
+    assert (int(out.x.t[1]), int(out.x.s[1])) == (2, 4)
+
+
+def test_fork_abandonment_still_works_for_newer_branch():
+    """The legitimate dead-branch abandonment: a NEW leader's branch (higher
+    term, possibly lower seq) rooted at our commit is adopted."""
+    st = make_node(term=jnp.int32(2), head=ids.bid(2, 7), commit=ids.bid(2, 4))
+    ae = msg_at(3, 1, MSG_APPEND, term=3, x=(2, 4), y=(3, 5), z=(2, 4))
+    st2, out, met = step(st, ae)
+    assert (int(st2.head.t), int(st2.head.s)) == (3, 5)  # adopted new branch
+    assert int(out.ok[1]) == 1
