@@ -56,6 +56,9 @@ class RaftConfig:
     # by the host snapshotter.
     snapshot_interval_s: int = 120
     snapshot_threshold: int = 8192
+    # Pre-allocated node slots for runtime membership changes (0 = exactly
+    # the configured nodes; the reference has no membership change at all).
+    max_nodes: int = 0
     data_directory: str = "/tmp/josefine-tpu"
 
     def validate(self) -> None:
@@ -68,6 +71,8 @@ class RaftConfig:
             raise ValueError("raft.heartbeat_timeout_ms must be >= 10ms")
         if self.election_timeout_min_ms < self.heartbeat_timeout_ms:
             raise ValueError("election timeout must be >= heartbeat timeout")
+        if self.max_nodes and self.max_nodes < len(self.nodes) + 1:
+            raise ValueError("raft.max_nodes must cover the configured nodes")
         if self.election_timeout_max_ms < self.election_timeout_min_ms:
             raise ValueError("election_timeout_max_ms < election_timeout_min_ms")
         for n in self.nodes:

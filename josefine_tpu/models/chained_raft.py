@@ -71,16 +71,21 @@ def _tree_select(pred, a, b):
     return jax.tree.map(lambda x, y: jnp.where(pred, x, y), a, b)
 
 
-def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int):
+def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
+                 src_member=True):
     """Apply one inbox message (from node index ``src``) to scalar node state.
 
     Returns (state', reply, accepted_span, accepted_msg). The reply is a
     scalar Msgs addressed back to ``src`` (kind MSG_NONE if no reply).
     Parity: the reference's ``Apply::apply(Command)`` dispatch
     (``src/raft/mod.rs:471-489``) for the four wire commands.
+
+    ``src_member`` masks out messages from non-member slots (runtime
+    membership: a removed node must not bump terms, win votes, or reset
+    election timers — it no longer exists as far as the group is concerned).
     """
     src_i = jnp.asarray(src, _I32)
-    valid = (m.kind != MSG_NONE) & st.alive
+    valid = (m.kind != MSG_NONE) & st.alive & src_member
 
     # -- universal term catch-up: any message from a higher term demotes us.
     # (Strictly-greater only: fixes the reference's unconditional heartbeat
@@ -212,7 +217,7 @@ def node_step(
     acc_msgs = jnp.zeros((), _I32)
     for src in range(N):
         m = jax.tree.map(lambda a: a[src], inbox)
-        st, rep, span, acc = _process_msg(params, st, m, src)
+        st, rep, span, acc = _process_msg(params, st, m, src, member[src])
         reply = jax.tree.map(lambda R, r: ids.set_row(R, src, r), reply, rep)
         acc_blocks = acc_blocks + span
         acc_msgs = acc_msgs + acc

@@ -136,7 +136,9 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
     for src in range(N):
         m = jax.tree.map(lambda a: a[:, src, :], ib)  # leaves (N_dst, T)
 
-        valid = (m.kind != MSG_NONE) & alive_b
+        # Non-member srcs are masked out (runtime membership; mirrors
+        # node_step's src_member parameter).
+        valid = (m.kind != MSG_NONE) & alive_b & member_b[src][None, :]
         # universal term catch-up (strictly greater only; reference quirk 1
         # fixed — node_step ``_process_msg`` step 2).
         higher = valid & (m.term > st.term)

@@ -45,6 +45,7 @@ from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
 from josefine_tpu.raft.chain import GENESIS, Chain, pack_id, id_term, id_seq
 from josefine_tpu.raft.fsm import Driver, Fsm, supports_snapshot
+from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange, MemberTable, is_conf
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.tracing import get_logger
@@ -80,6 +81,7 @@ class TickResult:
     committed: dict[int, int] = field(default_factory=dict)  # group -> new commit id
     became_leader: list[int] = field(default_factory=list)
     lost_leadership: list[int] = field(default_factory=list)
+    conf_changes: list[ConfChange] = field(default_factory=list)
 
 
 def _node_view(state: NodeState, me: int) -> NodeState:
@@ -108,15 +110,30 @@ class RaftEngine:
         base_seed: int = 0,
         snapshot_threshold: int | None = None,
         snapshot_interval_ticks: int | None = None,
+        max_nodes: int | None = None,
     ):
         self.kv = kv
-        self.node_ids = sorted(node_ids)
-        if self_id not in self.node_ids:
+        if self_id not in node_ids:
             raise ValueError(f"self id {self_id} not in node_ids {node_ids}")
-        self.me = self.node_ids.index(self_id)
         self.self_id = self_id
         self.P = groups
-        self.N = len(self.node_ids)
+        # Membership: node-axis columns are pre-allocated slots; the cluster
+        # can grow into free slots and shrink by masking columns (the
+        # reference's peer set is frozen config — SURVEY.md §5). The durable
+        # member table (updated by committed conf blocks) overrides the
+        # configured bootstrap list on restart.
+        max_slots = max(len(node_ids), max_nodes or 0)
+        self.members = (MemberTable.load(kv, max_slots)
+                        or MemberTable.bootstrap(list(node_ids), max_slots))
+        self.N = self.members.max_slots
+        slot = self.members.slot_of(self_id)
+        if slot is None:
+            raise ValueError(
+                f"self id {self_id} has no slot in the member table "
+                f"({sorted(self.members.by_id)}) — a joining node must be "
+                "configured with the full current member list")
+        self.me = slot
+        self.node_ids = [self.members.id_of(s) for s in range(self.N)]
         self.params = params or step_params()
         if int(self.params.auto_proposals) != 0:
             # The auto-proposal lane is a bench-only device feature; the
@@ -165,7 +182,9 @@ class RaftEngine:
             if ch.committed > start:
                 drv.apply(ch.range(start, ch.committed))
 
-        full, member = cr.init_state(groups, self.N, base_seed=base_seed, params=self.params)
+        mask = self._member_mask()
+        full, member = cr.init_state(groups, self.N, member=mask,
+                                     base_seed=base_seed, params=self.params)
         self.member = member  # (P, N)
         st = _node_view(full, self.me)
         # Durable recovery: chain head/commit + persisted term/voted_for
@@ -190,6 +209,12 @@ class RaftEngine:
 
         self._pending_msgs: list[rpc.WireMsg] = []
         self._proposals: dict[int, list[tuple[bytes, asyncio.Future | None]]] = {}
+        # Conf-change bookkeeping: block-id-keyed commit waiters, the
+        # single-in-flight guard (leader side), and conf notifications
+        # produced outside tick() (snapshot install) for the next TickResult.
+        self._conf_waiters: dict[int, asyncio.Future] = {}
+        self._conf_pending: int | None = None
+        self._conf_notify: list[ConfChange] = []
 
     # ------------------------------------------------------------ intake
 
@@ -214,10 +239,22 @@ class RaftEngine:
     def propose(self, group: int, payload: bytes) -> asyncio.Future:
         """Submit a client payload; resolves with the FSM result once the
         block commits (reference ``RaftClient::propose`` semantics end to
-        end). Fails with NotLeader if this node cannot mint at tick time."""
+        end). Fails with NotLeader if this node cannot mint at tick time.
+
+        A payload with the conf-change prefix is a membership mutation: it
+        must target group 0, the leader assigns the node slot at mint time,
+        and commit applies it to the member table instead of the app FSM.
+        """
         fut = asyncio.get_running_loop().create_future()
+        if is_conf(payload) and group != 0:
+            fut.set_exception(ValueError("conf changes must go through group 0"))
+            return fut
         self._proposals.setdefault(group, []).append((payload, fut))
         return fut
+
+    def propose_conf(self, change: ConfChange) -> asyncio.Future:
+        """Propose a membership change (resolved at commit)."""
+        return self.propose(0, change.encode())
 
     # -------------------------------------------------------------- tick
 
@@ -263,6 +300,12 @@ class RaftEngine:
                 drv = self.drivers.get(g)
                 if drv:
                     drv.drop_waiters(NotLeader(g, int(n_leader[g])))
+                if g == 0:
+                    self._conf_pending = None
+                    for fut in self._conf_waiters.values():
+                        if not fut.done():
+                            fut.set_exception(NotLeader(g, int(n_leader[g])))
+                    self._conf_waiters.clear()
 
             # Minted payload blocks (leader): mirror device ids exactly.
             queue = self._proposals.get(g, [])
@@ -273,10 +316,30 @@ class RaftEngine:
                         f"{len(queue)} payloads (group {g})"
                     )
                 for payload, fut in queue:
+                    conf_err = None
+                    if is_conf(payload):
+                        # Leader-side conf admission: assign the slot, and
+                        # enforce one change in flight. The device already
+                        # counted this mint, so a refused change still
+                        # appends — as a harmless no-op block.
+                        try:
+                            if self._conf_pending is not None:
+                                raise ValueError(
+                                    "a membership change is already in flight")
+                            change = self.members.assign(ConfChange.decode(payload))
+                            payload = change.encode()
+                        except ValueError as e:
+                            conf_err, payload = e, b""
                     blk = ch.append(int(n_term[g]), payload)
                     drv = self.drivers.get(g)
-                    if fut is not None and not fut.done():
-                        if drv is not None:
+                    if is_conf(payload):
+                        self._conf_pending = blk.id
+                        if fut is not None and not fut.done():
+                            self._conf_waiters[blk.id] = fut
+                    elif fut is not None and not fut.done():
+                        if conf_err is not None:
+                            fut.set_exception(conf_err)
+                        elif drv is not None:
                             drv.notify(blk.id, fut)
                         else:
                             fut.set_result(b"")
@@ -314,9 +377,15 @@ class RaftEngine:
                 blocks = ch.commit(new_commit)
                 res.committed[g] = new_commit
                 _m_committed.inc(len(blocks), node=self.self_id)
+                app_blocks = []
+                for blk in blocks:
+                    if is_conf(blk.data):
+                        self._apply_conf_block(g, blk, res)
+                    else:
+                        app_blocks.append(blk)
                 drv = self.drivers.get(g)
                 if drv:
-                    drv.apply(blocks)
+                    drv.apply(app_blocks)
 
             # Durable volatile state (term / voted_for).
             if n_term[g] != self._h_term[g]:
@@ -329,6 +398,9 @@ class RaftEngine:
         self._h_role = n_role.astype(np.int64)
         self._h_leader = n_leader.astype(np.int64)
 
+        if self._conf_notify:
+            res.conf_changes.extend(self._conf_notify)
+            self._conf_notify.clear()
         res.outbound = self._decode_outbox(outbox)
         self._ticks += 1
         self._maybe_snapshot()
@@ -380,6 +452,43 @@ class RaftEngine:
                 for g in range(self.P)
             ]
         return out
+
+    # -------------------------------------------------------- membership
+
+    def _member_mask(self) -> jnp.ndarray:
+        m = np.zeros(self.N, bool)
+        for s in self.members.active_slots():
+            m[s] = True
+        return jnp.broadcast_to(jnp.asarray(m)[None, :], (self.P, self.N))
+
+    def _apply_conf_block(self, g: int, blk, res: TickResult | None) -> None:
+        """Commit-time application of a membership change (deterministic on
+        every node: same committed block -> same member table)."""
+        if g != 0:
+            log.error("conf block committed on group %d ignored (group 0 only)", g)
+            return
+        try:
+            change = ConfChange.decode(blk.data)
+        except ValueError:
+            log.exception("undecodable conf block %#x", blk.id)
+            return
+        self.members.apply(change)
+        self.members.store(self.kv)
+        self.node_ids = [self.members.id_of(s) for s in range(self.N)]
+        self.member = self._member_mask()
+        if self._conf_pending == blk.id:
+            self._conf_pending = None
+        fut = self._conf_waiters.pop(blk.id, None)
+        if fut is not None and not fut.done():
+            fut.set_result(blk.data)
+        if res is not None:
+            res.conf_changes.append(change)
+        else:
+            self._conf_notify.append(change)
+        log.info("membership: %s node %d (slot %d); active slots now %s",
+                 change.op, change.node_id,
+                 self.members.slot_of(change.node_id),
+                 sorted(self.members.active_slots()))
 
     # --------------------------------------------------------- snapshots
 
@@ -486,6 +595,31 @@ class RaftEngine:
             head=ids.Bid(self.state.head.t.at[g].set(t), self.state.head.s.at[g].set(s)),
             commit=ids.Bid(self.state.commit.t.at[g].set(t), self.state.commit.s.at[g].set(s)),
         )
+        # Adopt the leader's member table (conf blocks below its floor are
+        # not replayable); my own slot must be unchanged.
+        if msg.aux:
+            kv_mt = self.kv.get(MemberTable.KEY)
+            if kv_mt != msg.aux:
+                self.kv.put(MemberTable.KEY, msg.aux)
+                new_members = MemberTable.load(self.kv, self.N)
+                my_slot = new_members.slot_of(self.self_id)
+                if my_slot != self.me or new_members.max_slots != self.N:
+                    # Do not adopt a table that reassigns our slot or a
+                    # different slot count — the device row identity /
+                    # tensor shapes would silently change.
+                    self.kv.put(MemberTable.KEY, kv_mt or b"")
+                    log.error("snapshot member table incompatible (my slot "
+                              "%d -> %s, slots %d -> %d); refusing",
+                              self.me, my_slot, self.N, new_members.max_slots)
+                else:
+                    self.members = new_members
+                    self.node_ids = [self.members.id_of(s) for s in range(self.N)]
+                    self.member = self._member_mask()
+                    self._conf_notify.extend(
+                        ConfChange(op=ADD if m.active else REMOVE,
+                                   node_id=m.node_id, ip=m.ip, port=m.port,
+                                   slot=m.slot)
+                        for m in self.members.by_id.values())
         _m_installs.inc(node=self.self_id)
         log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(msg.payload))
 
@@ -591,7 +725,10 @@ class RaftEngine:
                         self.chains[g].floor, g)
             return None
         self._snap_sent_tick[(g, dst)] = self._ticks
+        # Group 0 snapshots carry the member table: the receiving node may
+        # have missed conf blocks that are now below our truncation floor.
+        aux = (self.kv.get(MemberTable.KEY) or b"") if g == 0 else b""
         return rpc.WireMsg(
             kind=rpc.MSG_SNAPSHOT, group=g, src=self.me, dst=dst,
-            term=ae.term, x=snap_id, z=ae.z, payload=data,
+            term=ae.term, x=snap_id, z=ae.z, payload=data, aux=aux,
         )

@@ -52,7 +52,10 @@ class WireMsg:
     ok: int = 0
     blocks: list[Block] = field(default_factory=list)  # AE payload span (x, y]
     req_id: str = ""      # CLIENT_* correlation
-    payload: bytes = b""  # CLIENT_* body
+    payload: bytes = b""  # CLIENT_* / SNAPSHOT body
+    aux: bytes = b""      # SNAPSHOT: serialized member table (conf blocks
+                          # below the truncation floor are gone, so cluster
+                          # shape must ride the snapshot)
 
     def encode(self) -> bytes:
         d = {
@@ -67,6 +70,8 @@ class WireMsg:
             d["r"] = self.req_id
         if self.payload:
             d["p"] = base64.b64encode(self.payload).decode()
+        if self.aux:
+            d["a"] = base64.b64encode(self.aux).decode()
         return json.dumps(d, separators=(",", ":")).encode()
 
     @classmethod
@@ -82,6 +87,7 @@ class WireMsg:
             ],
             req_id=d.get("r", ""),
             payload=base64.b64decode(d["p"]) if "p" in d else b"",
+            aux=base64.b64decode(d["a"]) if "a" in d else b"",
         )
 
     def span_is_valid(self) -> bool:

@@ -21,7 +21,7 @@ import uuid
 
 from josefine_tpu.config import RaftConfig
 from josefine_tpu.models.types import StepParams, step_params
-from josefine_tpu.raft import rpc
+from josefine_tpu.raft import membership, rpc
 from josefine_tpu.raft.engine import NotLeader, RaftEngine
 from josefine_tpu.raft.fsm import Fsm
 from josefine_tpu.raft.tcp import Transport
@@ -69,8 +69,16 @@ class JosefineRaft:
             snapshot_interval_ticks=max(
                 1, config.snapshot_interval_s * 1000 // config.tick_ms
             ),
+            max_nodes=config.max_nodes,
         )
+        # Peer addresses: configured nodes, plus any members the durable
+        # member table knows that config does not (nodes added at runtime
+        # before our last shutdown).
         addr_by_id = {n.id: n.addr for n in config.nodes}
+        for m in self.engine.members.by_id.values():
+            if m.active and m.node_id != config.id and m.node_id not in addr_by_id:
+                if m.ip and m.port:
+                    addr_by_id[m.node_id] = (m.ip, m.port)
         self.transport = Transport(
             config.id,
             (config.ip, config.port),
@@ -164,6 +172,24 @@ class JosefineRaft:
         finally:
             self._forwarded.pop(req_id, None)
 
+    # ----------------------------------------------------------- membership
+
+    async def add_node(self, node_id: int, ip: str, port: int,
+                       timeout: float = 10.0) -> None:
+        """Add (or re-add) a node to the cluster at runtime. Routed to the
+        leader like any proposal; resolves when the conf change commits.
+        Start the new node afterwards with the full member list in its
+        config — it will catch up by log replay or snapshot install."""
+        change = membership.ConfChange(op=membership.ADD, node_id=node_id,
+                                       ip=ip, port=port)
+        await self.propose(change.encode(), group=0, timeout=timeout)
+
+    async def remove_node(self, node_id: int, timeout: float = 10.0) -> None:
+        """Remove a node: its column is masked out of every group's quorum
+        once the change commits. Shut the removed process down afterwards."""
+        change = membership.ConfChange(op=membership.REMOVE, node_id=node_id)
+        await self.propose(change.encode(), group=0, timeout=timeout)
+
     # ------------------------------------------------------------ internals
 
     def _on_message(self, msg: rpc.WireMsg) -> None:
@@ -222,8 +248,17 @@ class JosefineRaft:
             while not self.shutdown.is_shutdown:
                 t0 = asyncio.get_running_loop().time()
                 res = self.engine.tick()
+                for ch in res.conf_changes:
+                    if ch.node_id == self.config.id:
+                        continue
+                    if ch.op == membership.ADD and ch.ip and ch.port:
+                        self.transport.add_peer(ch.node_id, (ch.ip, ch.port))
+                    elif ch.op == membership.REMOVE:
+                        self.transport.remove_peer(ch.node_id)
                 for m in res.outbound:
-                    self.transport.send(self.engine.node_ids[m.dst], m)
+                    dst_id = self.engine.node_ids[m.dst]
+                    if dst_id is not None:
+                        self.transport.send(dst_id, m)
                 elapsed = asyncio.get_running_loop().time() - t0
                 await asyncio.sleep(max(0.0, interval - elapsed))
         except asyncio.CancelledError:

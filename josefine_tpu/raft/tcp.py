@@ -68,20 +68,43 @@ class Transport:
         self._queues: dict[int, asyncio.Queue[WireMsg]] = {
             nid: asyncio.Queue(SEND_QUEUE_DEPTH) for nid in peers
         }
-        self._tasks: list[asyncio.Task] = []
+        self._peer_tasks: dict[int, asyncio.Task] = {}
         self._conn_tasks: set[asyncio.Task] = set()
         self._server: asyncio.Server | None = None
+        self._started = False
         self.dropped = 0  # drop-on-full counter (observability)
 
     async def start(self) -> tuple[str, int]:
         self._server = await asyncio.start_server(
             self._handle_conn, self.bind_addr[0], self.bind_addr[1]
         )
+        self._started = True
         for nid in self.peers:
-            self._tasks.append(asyncio.create_task(self._send_loop(nid)))
+            self._peer_tasks[nid] = asyncio.create_task(self._send_loop(nid))
         addr = self._server.sockets[0].getsockname()[:2]
         log.debug("node %d transport listening on %s", self.self_id, addr)
         return addr
+
+    def add_peer(self, peer_id: int, addr: tuple[str, int]) -> None:
+        """Runtime membership: start (or re-point) the outbound connect loop
+        for a peer. The reference's peer set is startup-frozen config
+        (``src/raft/config.rs:26``); here the cluster can grow live."""
+        if peer_id == self.self_id:
+            return
+        self.peers[peer_id] = addr
+        if peer_id not in self._queues:
+            self._queues[peer_id] = asyncio.Queue(SEND_QUEUE_DEPTH)
+        if self._started and peer_id not in self._peer_tasks:
+            self._peer_tasks[peer_id] = asyncio.create_task(self._send_loop(peer_id))
+            log.info("node %d transport: added peer %d at %s", self.self_id, peer_id, addr)
+
+    def remove_peer(self, peer_id: int) -> None:
+        """Runtime membership: tear down a removed peer's connect loop."""
+        task = self._peer_tasks.pop(peer_id, None)
+        if task is not None:
+            task.cancel()
+        self._queues.pop(peer_id, None)
+        self.peers.pop(peer_id, None)
 
     def send(self, peer_id: int, msg: WireMsg) -> None:
         """Enqueue; full queue drops the message (reference tcp.rs:90-96 —
@@ -97,9 +120,10 @@ class Transport:
             _m_dropped.inc(node=self.self_id)
 
     async def stop(self) -> None:
-        for t in list(self._tasks) + list(self._conn_tasks):
+        tasks = list(self._peer_tasks.values()) + list(self._conn_tasks)
+        for t in tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, *self._conn_tasks, return_exceptions=True)
+        await asyncio.gather(*tasks, return_exceptions=True)
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()

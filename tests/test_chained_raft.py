@@ -394,3 +394,16 @@ def test_fork_abandonment_still_works_for_newer_branch():
     st2, out, met = step(st, ae)
     assert (int(st2.head.t), int(st2.head.s)) == (3, 5)  # adopted new branch
     assert int(out.ok[1]) == 1
+
+
+def test_nonmember_messages_are_invisible():
+    """Runtime membership: messages from a slot outside the member mask must
+    not bump terms, win votes, or reset election timers — a removed node
+    cannot disrupt the group."""
+    member = jnp.array([True, True, False])
+    st = make_node(term=jnp.int32(1))
+    inbox = msg_at(3, 2, MSG_VOTE_REQ, term=9, x=(5, 5))
+    st2, out, _ = step(st, inbox, member=member)
+    assert int(st2.term) == 1            # no term catch-up from non-member
+    assert int(st2.voted_for) == -1      # no vote granted
+    assert int(out.kind[2]) == 0         # no reply to it either

@@ -1,0 +1,247 @@
+"""Runtime membership change: add/remove/re-add nodes.
+
+The reference's peer set is frozen TOML config (``src/raft/config.rs:26``;
+SURVEY.md §5 "no membership change, no node add/remove at runtime") — this
+subsystem is a TPU-build addition, so the tests define the contract: conf
+blocks through group 0, slot pre-allocation (raft.max_nodes), commit-time
+member-mask application, durable member tables, catch-up of joiners by
+replay or snapshot install, and non-members being invisible to consensus.
+"""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange, MemberTable
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class SnapFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data: bytes) -> bytes:
+        self.applied.append(data)
+        return b"ok:" + data
+
+    def snapshot(self) -> bytes:
+        return json.dumps([a.decode() for a in self.applied]).encode()
+
+    def restore(self, data: bytes) -> None:
+        self.applied = [x.encode() for x in json.loads(data)] if data else []
+
+
+# ------------------------------------------------------------ member table
+
+
+def test_member_table_bootstrap_assign_apply_persist():
+    kv = MemKV()
+    t = MemberTable.bootstrap([30, 10, 20], max_slots=5)
+    assert [t.slot_of(i) for i in (10, 20, 30)] == [0, 1, 2]
+    assert t.free_slot() == 3
+
+    add = t.assign(ConfChange(op=ADD, node_id=40, ip="h", port=9))
+    assert add.slot == 3
+    t.apply(add)
+    assert t.active_slots() == {0, 1, 2, 3}
+
+    t.apply(ConfChange(op=REMOVE, node_id=20))
+    assert t.active_slots() == {0, 2, 3}
+    # Re-add keeps the old slot (and with it the durable chain identity).
+    readd = t.assign(ConfChange(op=ADD, node_id=20, ip="h2", port=7))
+    assert readd.slot == 1
+    t.apply(readd)
+    assert t.active_slots() == {0, 1, 2, 3}
+
+    t.store(kv)
+    t2 = MemberTable.load(kv, 5)
+    assert t2.active_slots() == t.active_slots()
+    assert t2.by_id[40].ip == "h"
+
+
+def test_member_table_no_free_slot():
+    t = MemberTable.bootstrap([1, 2], max_slots=2)
+    with pytest.raises(ValueError, match="no free node slot"):
+        t.assign(ConfChange(op=ADD, node_id=3))
+
+
+# ------------------------------------------------------- engine-level runs
+
+
+def _mk_engine(kv, fsm, ids_, self_id, threshold=None):
+    return RaftEngine(kv, ids_, self_id, groups=1, fsms={0: fsm},
+                      params=PARAMS, base_seed=self_id,
+                      snapshot_threshold=threshold, max_nodes=4)
+
+
+def _run(engines, n, down=()):
+    for _ in range(n):
+        for i, e in enumerate(engines):
+            if i in down or e is None:
+                continue
+            res = e.tick()
+            for m in res.outbound:
+                if m.dst < len(engines) and m.dst not in down and engines[m.dst] is not None:
+                    engines[m.dst].receive(m)
+
+
+def _leader(engines, down=(), max_ticks=100):
+    for _ in range(max_ticks):
+        _run(engines, 1, down=down)
+        leads = [i for i, e in enumerate(engines)
+                 if e is not None and i not in down and e.is_leader(0)]
+        if len(leads) == 1:
+            return leads[0]
+    raise AssertionError("no leader")
+
+
+def test_add_node_then_join_and_participate():
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(4)]
+        fsms = [SnapFsm() for _ in range(4)]
+        engines = [_mk_engine(kvs[i], fsms[i], ids3, ids3[i]) for i in range(3)]
+        engines.append(None)  # slot 3 empty until the new node starts
+        lead = _leader(engines, down=(3,))
+        f = engines[lead].propose(0, b"pre")
+        _run(engines, 8, down=(3,))
+        await f
+
+        # Commit the ADD of node 4 (slot 3).
+        cf = engines[lead].propose_conf(ConfChange(op=ADD, node_id=4, ip="x", port=1))
+        _run(engines, 8, down=(3,))
+        await cf
+        for i in range(3):
+            assert engines[i].members.active_slots() == {0, 1, 2, 3}
+            assert engines[i].node_ids[3] == 4
+            assert bool(engines[i].member[0, 3])
+
+        # Start node 4 with the full member list; it replays and joins.
+        engines[3] = _mk_engine(kvs[3], fsms[3], [1, 2, 3, 4], 4)
+        assert engines[3].me == 3
+        _run(engines, 25)
+        assert fsms[3].applied == [b"pre"]
+
+        # The 4-node cluster commits with quorum 3 even with one node down.
+        lead = _leader(engines)
+        victim = next(i for i in range(4) if i != lead)
+        f2 = engines[lead].propose(0, b"post")
+        _run(engines, 10, down=(victim,))
+        assert (await f2) == b"ok:post"
+
+    asyncio.run(main())
+
+
+def test_remove_node_shrinks_quorum_and_ignores_it():
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(3)]
+        fsms = [SnapFsm() for _ in range(3)]
+        engines = [_mk_engine(kvs[i], fsms[i], ids3, ids3[i]) for i in range(3)]
+        lead = _leader(engines)
+        victim = next(i for i in range(3) if i != lead)
+
+        cf = engines[lead].propose_conf(ConfChange(op=REMOVE, node_id=ids3[victim]))
+        _run(engines, 8)
+        await cf
+        for e in engines:
+            assert victim not in e.members.active_slots()
+
+        # Two members remain -> quorum 2; commits proceed WITHOUT the
+        # removed node even though it is still running and acking.
+        f = engines[lead].propose(0, b"after-remove")
+        _run(engines, 10, down=(victim,))
+        assert (await f) == b"ok:after-remove"
+
+        # The removed node's messages are invisible to consensus: its
+        # election attempts cannot bump member terms.
+        t_before = engines[lead].term(0)
+        _run(engines, 30)  # removed node keeps ticking/timing out
+        assert engines[lead].term(0) == t_before
+        assert engines[lead].is_leader(0)
+
+    asyncio.run(main())
+
+
+def test_membership_survives_restart_even_with_stale_config():
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(4)]
+        fsms = [SnapFsm() for _ in range(4)]
+        engines = [_mk_engine(kvs[i], fsms[i], ids3, ids3[i]) for i in range(3)]
+        engines.append(None)
+        lead = _leader(engines, down=(3,))
+        cf = engines[lead].propose_conf(ConfChange(op=ADD, node_id=4, ip="x", port=1))
+        _run(engines, 8, down=(3,))
+        await cf
+
+        # Restart node 1 with its ORIGINAL 3-node config: the durable member
+        # table overrides it.
+        revived = _mk_engine(kvs[0], SnapFsm(), ids3, 1)
+        assert revived.N == 4
+        assert revived.node_ids[3] == 4
+        assert revived.members.active_slots() == {0, 1, 2, 3}
+
+    asyncio.run(main())
+
+
+def test_single_conf_change_in_flight():
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(3)]
+        engines = [_mk_engine(kvs[i], SnapFsm(), ids3, ids3[i]) for i in range(3)]
+        lead = _leader(engines)
+        # Two changes offered in the same tick: the second is refused.
+        f1 = engines[lead].propose_conf(ConfChange(op=ADD, node_id=4, ip="x", port=1))
+        f2 = engines[lead].propose_conf(ConfChange(op=REMOVE, node_id=2))
+        _run(engines, 10)
+        await f1
+        with pytest.raises(ValueError, match="already in flight"):
+            await f2
+        # After the first commits, a new change is accepted.
+        f3 = engines[lead].propose_conf(ConfChange(op=REMOVE, node_id=2))
+        _run(engines, 10)
+        await f3
+
+    asyncio.run(main())
+
+
+def test_joiner_catches_up_via_snapshot_with_member_table():
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(4)]
+        fsms = [SnapFsm() for _ in range(4)]
+        engines = [_mk_engine(kvs[i], fsms[i], ids3, ids3[i], threshold=4)
+                   for i in range(3)]
+        engines.append(None)
+        lead = _leader(engines, down=(3,))
+
+        # Enough traffic to snapshot + truncate, THEN add node 4: the ADD
+        # conf block may itself end up below the next floor, so the joiner
+        # must learn membership from the snapshot aux.
+        for i in range(6):
+            f = engines[lead].propose(0, b"w%d" % i)
+            _run(engines, 6, down=(3,))
+            await f
+        cf = engines[lead].propose_conf(ConfChange(op=ADD, node_id=4, ip="x", port=1))
+        _run(engines, 8, down=(3,))
+        await cf
+        for i in range(3):
+            f = engines[lead].propose(0, b"z%d" % i)
+            _run(engines, 6, down=(3,))
+            await f
+        assert engines[lead].chains[0].floor > 0
+
+        engines[3] = _mk_engine(kvs[3], fsms[3], [1, 2, 3, 4], 4, threshold=4)
+        _run(engines, 50)
+        assert fsms[3].applied == fsms[lead].applied
+        assert engines[3].members.active_slots() == {0, 1, 2, 3}
+        assert engines[3].chains[0].committed == engines[lead].chains[0].committed
+
+    asyncio.run(main())
