@@ -180,8 +180,24 @@ class RaftEngine:
                 # engine construction precisely so they do not fire here.)
                 drv.fsm.restore(b"")
             if ch.committed > start:
-                drv.apply(ch.range(start, ch.committed))
+                # Conf blocks route to the member table, never the app FSM
+                # (same split as the live commit path at _apply_conf_block —
+                # replaying one into e.g. JosefineFsm would crash on the
+                # 0x00-tagged payload). Re-applying them to the member table
+                # is idempotent and closes the crash window between chain
+                # commit and member-table store.
+                app_blocks = []
+                for b in ch.range(start, ch.committed):
+                    if is_conf(b.data):
+                        self._safe_conf_apply(b)
+                    else:
+                        app_blocks.append(b)
+                drv.apply(app_blocks)
 
+        # The replay above may have re-applied conf blocks (crash window
+        # between chain commit and member-table store) — refresh the
+        # slot-to-id map derived from the table before it is used.
+        self.node_ids = [self.members.id_of(s) for s in range(self.N)]
         mask = self._member_mask()
         full, member = cr.init_state(groups, self.N, member=mask,
                                      base_seed=base_seed, params=self.params)
@@ -193,8 +209,9 @@ class RaftEngine:
         for g, ch in enumerate(self.chains):
             heads_t.append(id_term(ch.head)); heads_s.append(id_seq(ch.head))
             commits_t.append(id_term(ch.committed)); commits_s.append(id_seq(ch.committed))
-            terms.append(max(self._load_meta(g, b"term"), id_term(ch.head)))
-            voted.append(self._load_meta(g, b"voted", -1))
+            t, v = self._load_vol(g)
+            terms.append(max(t, id_term(ch.head)))
+            voted.append(v)
         self.state = st.replace(
             head=ids.Bid(jnp.asarray(heads_t, _I32), jnp.asarray(heads_s, _I32)),
             commit=ids.Bid(jnp.asarray(commits_t, _I32), jnp.asarray(commits_s, _I32)),
@@ -213,7 +230,11 @@ class RaftEngine:
         # single-in-flight guard (leader side), and conf notifications
         # produced outside tick() (snapshot install) for the next TickResult.
         self._conf_waiters: dict[int, asyncio.Future] = {}
-        self._conf_pending: int | None = None
+        # Seed the single-change-in-flight guard from the uncommitted suffix
+        # of group 0: a node that restarts (or later wins leadership) while a
+        # conf block is appended-but-uncommitted must not admit a second
+        # overlapping membership change (disjoint-quorum risk).
+        self._conf_pending: int | None = self._scan_conf_pending()
         self._conf_notify: list[ConfChange] = []
 
     # ------------------------------------------------------------ intake
@@ -294,6 +315,11 @@ class RaftEngine:
             if became[g]:
                 res.became_leader.append(g)
                 ch.append(int(n_term[g]), b"")  # the no-op liveness block
+                if g == 0:
+                    # A deposed leader's conf block may sit uncommitted in
+                    # our log and commit later under us — re-arm the
+                    # single-change-in-flight guard from the suffix.
+                    self._conf_pending = self._scan_conf_pending()
             was_leader = self._h_role[g] == LEADER
             if was_leader and n_role[g] != LEADER:
                 res.lost_leadership.append(g)
@@ -387,11 +413,12 @@ class RaftEngine:
                 if drv:
                     drv.apply(app_blocks)
 
-            # Durable volatile state (term / voted_for).
-            if n_term[g] != self._h_term[g]:
-                self._store_meta(g, b"term", int(n_term[g]))
-            if n_voted[g] != self._h_voted[g]:
-                self._store_meta(g, b"voted", int(n_voted[g]))
+            # Durable volatile state: (term, voted_for) is ONE record written
+            # in one put — a crash can never pair a new term with a stale
+            # vote, which would allow a second grant in the same term after
+            # restart (two leaders in one term).
+            if n_term[g] != self._h_term[g] or n_voted[g] != self._h_voted[g]:
+                self._store_vol(g, int(n_term[g]), int(n_voted[g]))
 
         self._h_term = n_term.astype(np.int64)
         self._h_voted = n_voted.astype(np.int64)
@@ -461,24 +488,52 @@ class RaftEngine:
             m[s] = True
         return jnp.broadcast_to(jnp.asarray(m)[None, :], (self.P, self.N))
 
+    def _safe_conf_apply(self, blk) -> ConfChange | None:
+        """Decode + apply one committed conf block to the member table.
+        Any malformed or invalid payload degrades to a logged no-op — a bad
+        *committed* block would otherwise crash every node on every restart
+        forever (a poison block)."""
+        try:
+            change = ConfChange.decode(blk.data)
+            self.members.apply(change)
+        except (ValueError, KeyError, TypeError) as e:
+            log.error("ignoring bad committed conf block %#x: %s", blk.id, e)
+            return None
+        self.members.store(self.kv)
+        return change
+
+    def _scan_conf_pending(self) -> int | None:
+        """Find an in-flight (appended, uncommitted) conf block on group 0's
+        live branch. Block ids strictly decrease walking parent pointers, so
+        the walk is bounded by the commit/floor ids even across forks."""
+        ch = self.chains[0]
+        pending = None
+        cur = ch.head
+        while cur > ch.committed and cur > ch.floor:
+            blk = ch.get(cur)
+            if blk is None:
+                break
+            if is_conf(blk.data):
+                pending = blk.id
+            cur = blk.parent
+        return pending
+
     def _apply_conf_block(self, g: int, blk, res: TickResult | None) -> None:
         """Commit-time application of a membership change (deterministic on
         every node: same committed block -> same member table)."""
         if g != 0:
             log.error("conf block committed on group %d ignored (group 0 only)", g)
             return
-        try:
-            change = ConfChange.decode(blk.data)
-        except ValueError:
-            log.exception("undecodable conf block %#x", blk.id)
-            return
-        self.members.apply(change)
-        self.members.store(self.kv)
-        self.node_ids = [self.members.id_of(s) for s in range(self.N)]
-        self.member = self._member_mask()
+        change = self._safe_conf_apply(blk)
         if self._conf_pending == blk.id:
             self._conf_pending = None
         fut = self._conf_waiters.pop(blk.id, None)
+        if change is None:
+            if fut is not None and not fut.done():
+                fut.set_exception(ValueError("invalid membership change"))
+            return
+        self.node_ids = [self.members.id_of(s) for s in range(self.N)]
+        self.member = self._member_mask()
         if fut is not None and not fut.done():
             fut.set_result(blk.data)
         if res is not None:
@@ -583,10 +638,15 @@ class RaftEngine:
         # at a lower term would mint a non-advancing block id.
         snap_term = id_term(msg.x)
         if snap_term > int(self._h_term[g]):
-            self._store_meta(g, b"term", snap_term)
+            # Same rule as every other higher-term adoption: voted_for resets
+            # with the term (a stale vote carried into the adopted term could
+            # wrongly deny votes there). One atomic (term, voted) record.
+            self._store_vol(g, snap_term, -1)
             self._h_term[g] = snap_term
+            self._h_voted[g] = -1
             self.state = self.state.replace(
-                term=self.state.term.at[g].set(jnp.asarray(snap_term, _I32)))
+                term=self.state.term.at[g].set(jnp.asarray(snap_term, _I32)),
+                voted_for=self.state.voted_for.at[g].set(jnp.asarray(-1, _I32)))
         # Re-point this node's device row at the snapshot: head = commit =
         # snap id. The next AE probe not rooted here is rejected with our
         # commit as the hint, re-rooting the leader in 2 ticks.
@@ -625,12 +685,24 @@ class RaftEngine:
 
     # ------------------------------------------------------------ helpers
 
-    def _load_meta(self, g: int, key: bytes, default: int = 0) -> int:
-        raw = self.kv.get(b"g%d:vol:%s" % (g, key))
-        return default if raw is None else int.from_bytes(raw, "big", signed=True)
+    def _load_vol(self, g: int) -> tuple[int, int]:
+        """(term, voted_for) — one record so the pair is crash-atomic."""
+        raw = self.kv.get(b"g%d:vol" % g)
+        if raw is not None:
+            return (int.from_bytes(raw[:8], "big", signed=True),
+                    int.from_bytes(raw[8:16], "big", signed=True))
+        # Migration from the pre-atomic split keys (term / voted_for as two
+        # records): read once here; the next vote/term change rewrites the
+        # pair as a single record.
+        t = self.kv.get(b"g%d:vol:term" % g)
+        v = self.kv.get(b"g%d:vol:voted" % g)
+        return (0 if t is None else int.from_bytes(t, "big", signed=True),
+                -1 if v is None else int.from_bytes(v, "big", signed=True))
 
-    def _store_meta(self, g: int, key: bytes, value: int) -> None:
-        self.kv.put(b"g%d:vol:%s" % (g, key), value.to_bytes(8, "big", signed=True))
+    def _store_vol(self, g: int, term: int, voted: int) -> None:
+        self.kv.put(b"g%d:vol" % g,
+                    term.to_bytes(8, "big", signed=True)
+                    + voted.to_bytes(8, "big", signed=True))
 
     def _build_inbox(self) -> tuple[Msgs, dict[int, list], list[rpc.WireMsg]]:
         """Pack queued wire messages into the (P, N_src) inbox; one message

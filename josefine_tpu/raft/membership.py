@@ -50,11 +50,25 @@ class ConfChange:
 
     @classmethod
     def decode(cls, data: bytes) -> "ConfChange":
+        """Strict decode: every failure mode is ValueError, so a malformed
+        payload can never crash commit-time application with an uncaught
+        KeyError/TypeError (it would be a poison block — committed, hence
+        re-raised on every node at every restart)."""
         if not is_conf(data):
             raise ValueError("not a conf-change payload")
-        d = json.loads(data[len(CONF_PREFIX):])
-        return cls(op=d["op"], node_id=d["id"], ip=d.get("ip", ""),
-                   port=d.get("port", 0), slot=d.get("slot", -1))
+        try:
+            d = json.loads(data[len(CONF_PREFIX):])
+            op, node_id = d["op"], d["id"]
+            ip = str(d.get("ip", ""))
+            port = int(d.get("port", 0))
+            slot = int(d.get("slot", -1))
+        except (ValueError, KeyError, TypeError) as e:
+            raise ValueError(f"malformed conf payload: {e!r}") from e
+        if op not in (ADD, REMOVE):
+            raise ValueError(f"unknown conf op {op!r}")
+        if not isinstance(node_id, int) or isinstance(node_id, bool):
+            raise ValueError(f"conf node id must be an int, got {node_id!r}")
+        return cls(op=op, node_id=node_id, ip=ip, port=port, slot=slot)
 
 
 def is_conf(data: bytes) -> bool:

@@ -245,3 +245,130 @@ def test_joiner_catches_up_via_snapshot_with_member_table():
         assert engines[3].chains[0].committed == engines[lead].chains[0].committed
 
     asyncio.run(main())
+
+
+# --------------------------------------------- round-2 regression coverage
+
+
+class StrictSnapFsm(SnapFsm):
+    """An FSM that (like JosefineFsm) rejects payloads it does not know —
+    a conf block leaking into it raises, as broker Transition.decode would."""
+
+    def transition(self, data: bytes) -> bytes:
+        if data.startswith(b"\x00"):
+            raise ValueError(f"unknown transition kind {data[0]}")
+        return super().transition(data)
+
+
+def test_restart_replay_skips_conf_blocks_with_strict_fsm():
+    """ADVICE r1 (high): restart recovery used to replay committed conf
+    blocks into the app FSM — any strict FSM then failed to boot after a
+    committed membership change."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+        kvs = [MemKV() for _ in range(3)]
+        fsms = [StrictSnapFsm() for _ in range(3)]
+        engines = [_mk_engine(kvs[i], fsms[i], ids3, ids3[i]) for i in range(3)]
+        lead = _leader(engines)
+        f = engines[lead].propose(0, b"a")
+        _run(engines, 8)
+        await f
+        victim = next(i for i in range(3) if i != lead)
+        cf = engines[lead].propose_conf(
+            ConfChange(op=REMOVE, node_id=ids3[victim]))
+        _run(engines, 8)
+        await cf
+        f2 = engines[lead].propose(0, b"b")
+        _run(engines, 8)
+        await f2
+
+        # Restart the leader from its durable KV with a strict FSM: must
+        # boot and replay exactly the app payloads.
+        revived = _mk_engine(kvs[lead], StrictSnapFsm(), ids3, ids3[lead])
+        assert revived.drivers[0].fsm.applied == [b"a", b"b"]
+        assert ids3[victim] not in [revived.node_ids[s]
+                                    for s in revived.members.active_slots()]
+
+    asyncio.run(main())
+
+
+def test_poison_conf_block_degrades_to_noop():
+    """ADVICE r1 (medium): a committed conf block with a bad op/shape must
+    be a logged no-op, not a crash recurring on every node forever."""
+    from josefine_tpu.raft.chain import Block, pack_id
+    from josefine_tpu.raft.membership import CONF_PREFIX
+
+    async def main():
+        kv = MemKV()
+        e = _mk_engine(kv, SnapFsm(), [1], 1)
+        before = dict(e.members.by_id)
+        for bad in (
+            CONF_PREFIX + b'{"op":"frob","id":9}',       # unknown op
+            CONF_PREFIX + b'{"op":"add"}',               # missing id
+            CONF_PREFIX + b'{"op":"add","id":"x"}',      # non-int id
+            CONF_PREFIX + b"not json",
+            CONF_PREFIX + b'{"op":"add","id":9,"slot":-1}',  # invalid slot
+        ):
+            blk = Block(id=pack_id(1, 99), parent=0, data=bad)
+            e._apply_conf_block(0, blk, None)            # must not raise
+        assert dict(e.members.by_id) == before
+
+    asyncio.run(main())
+
+
+def test_confchange_decode_validates():
+    from josefine_tpu.raft.membership import CONF_PREFIX
+
+    for bad in (b"plain", CONF_PREFIX + b"{}", CONF_PREFIX + b"[1,2]",
+                CONF_PREFIX + b'{"op":"frob","id":1}',
+                CONF_PREFIX + b'{"op":"add","id":true}'):
+        with pytest.raises(ValueError):
+            ConfChange.decode(bad)
+    ok = ConfChange.decode(ConfChange(op=ADD, node_id=7, ip="h", port=2).encode())
+    assert (ok.op, ok.node_id, ok.ip, ok.port) == (ADD, 7, "h", 2)
+
+
+def test_conf_pending_seeded_on_restart_and_failover():
+    """ADVICE r1 (medium): the single-change-in-flight guard must survive
+    leader restart/failover while the conf block is appended-uncommitted."""
+
+    async def main():
+        ids4 = [1, 2, 3, 4]
+        kvs = [MemKV() for _ in range(4)]
+        engines = [_mk_engine(kvs[i], SnapFsm(), ids4, ids4[i]) for i in range(4)]
+        lead = _leader(engines)
+        others = [i for i in range(4) if i != lead]
+        partner, down1, down2 = others[0], others[1], others[2]
+
+        # Leader mints a REMOVE with two nodes down: it replicates to the
+        # partner (2 acks < quorum 3) but cannot commit.
+        engines[lead].propose_conf(ConfChange(op=REMOVE, node_id=ids4[down2]))
+        _run(engines, 4, down=(down1, down2))
+        assert engines[lead]._conf_pending is not None
+        assert engines[partner].chains[0].head == engines[lead].chains[0].head
+        assert engines[partner].chains[0].committed < engines[partner].chains[0].head
+
+        # Restart the old leader from durable state: guard re-seeded.
+        revived = _mk_engine(kvs[lead], SnapFsm(), ids4, ids4[lead])
+        assert revived._conf_pending is not None
+
+        # Failover: old leader stays down; the partner (longest log) wins
+        # and must refuse a second overlapping change.
+        engines[lead] = None
+        new_lead = _leader(engines, down=(lead,))
+        assert new_lead == partner
+        assert engines[partner]._conf_pending is not None
+        f2 = engines[partner].propose_conf(ConfChange(op=REMOVE, node_id=ids4[down1]))
+        _run(engines, 6, down=(lead,))
+        with pytest.raises(ValueError, match="already in flight"):
+            await f2
+        # The ORIGINAL change (minted by the dead leader) commits under the
+        # new leader and clears the guard.
+        _run(engines, 10, down=(lead,))
+        assert engines[partner]._conf_pending is None
+        assert ids4[down2] not in [
+            engines[partner].node_ids[s]
+            for s in engines[partner].members.active_slots()]
+
+    asyncio.run(main())
