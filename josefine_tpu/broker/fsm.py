@@ -87,19 +87,38 @@ class JosefineFsm:
     """Applies committed transitions to the Store (deterministic: same
     committed sequence -> same store bytes on every node)."""
 
-    def __init__(self, store: Store, on_delete_topic=None):
+    def __init__(self, store: Store, on_delete_topic=None, group_pool: int = 0):
         self.store = store
-        # Node-local side-effect hook: every node applies the same committed
-        # DeleteTopic, and each drops its own on-disk replica logs through
-        # this callback (the replicated store stays deterministic).
+        # Node-local side-effect hooks: every node applies the same committed
+        # transition; each runs its own local effects through these callbacks
+        # (drop on-disk replica logs on DeleteTopic; wire a partition's
+        # consensus group on EnsurePartition). The replicated store itself
+        # stays deterministic.
         self.on_delete_topic = on_delete_topic
+        self.on_partition_assigned = None
+        self.on_partition_released = None
+        # Consensus-group rows available on the device tensor (engine P);
+        # pool <= 1 means only the metadata group exists and partitions run
+        # in legacy (group-less) mode.
+        self.group_pool = group_pool
 
     def transition(self, data: bytes) -> bytes:
         entity = Transition.decode(data)
         if isinstance(entity, Topic):
             applied = self.store.create_topic(entity)
         elif isinstance(entity, Partition):
+            existing = self.store.get_partition(entity.topic, entity.idx)
+            if existing is not None:
+                # Idempotent re-ensure keeps the original group claim.
+                entity.group = existing.group
+            elif entity.group < 0 and self.group_pool > 1:
+                # Deterministic commit-time allocation: every node computes
+                # the same row from the same replicated counter. -1 on pool
+                # exhaustion = legacy mode (leader-local log).
+                entity.group = self.store.claim_group(self.group_pool)
             applied = self.store.create_partition(entity)
+            if self.on_partition_assigned is not None:
+                self.on_partition_assigned(applied)
         elif isinstance(entity, Broker):
             applied = self.store.ensure_broker(entity)
         elif isinstance(entity, Group):
@@ -111,7 +130,12 @@ class JosefineFsm:
                 self.store.commit_offset(oc)
             applied = entity
         elif isinstance(entity, TopicTombstone):
+            released = self.store.get_partitions(entity.name)
             self.store.delete_topic(entity.name)
+            if self.on_partition_released is not None:
+                for p in released:
+                    if p.group >= 1:
+                        self.on_partition_released(p)
             if self.on_delete_topic is not None:
                 self.on_delete_topic(entity.name)
             applied = entity
@@ -132,15 +156,29 @@ class JosefineFsm:
 
         Topics that existed locally but are absent from the snapshot were
         deleted while we were behind — fire the same node-local side-effect
-        hook a live DeleteTopic commit would, so replica logs for them are
-        deregistered and purged rather than silently served forever.
+        hooks a live DeleteTopic commit would, so replica logs for them are
+        deregistered/purged and their consensus-group rows idled rather than
+        silently served forever. Partitions present in the snapshot re-fire
+        the assignment hook (idempotent) so their group wiring exists after
+        a snapshot-install catch-up.
         """
-        before = {t.name for t in self.store.get_topics()}
+        before_topics = {t.name for t in self.store.get_topics()}
+        before_parts = {(p.topic, p.idx): p
+                        for p in self.store.get_all_partitions() if p.group >= 1}
         self.store.load(data)
+        after_parts = {(p.topic, p.idx): p
+                       for p in self.store.get_all_partitions() if p.group >= 1}
+        if self.on_partition_released is not None:
+            for key, p in before_parts.items():
+                if key not in after_parts:
+                    self.on_partition_released(p)
         if self.on_delete_topic is not None:
-            after = {t.name for t in self.store.get_topics()}
-            for name in before - after:
+            after_topics = {t.name for t in self.store.get_topics()}
+            for name in before_topics - after_topics:
                 self.on_delete_topic(name)
+        if self.on_partition_assigned is not None:
+            for p in after_parts.values():
+                self.on_partition_assigned(p)
 
 
 def decode_result(data: bytes):

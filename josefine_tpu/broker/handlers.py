@@ -26,6 +26,7 @@ import re
 import uuid
 
 from josefine_tpu.broker import records
+from josefine_tpu.broker import partition_fsm
 from josefine_tpu.broker.fsm import Transition
 from josefine_tpu.broker.groups import GroupCoordinator
 from josefine_tpu.broker.replica import ReplicaRegistry
@@ -41,6 +42,7 @@ from josefine_tpu.broker.state import (
 from josefine_tpu.config import BrokerConfig
 from josefine_tpu.kafka import client as kafka_client
 from josefine_tpu.kafka.codec import ApiKey, ErrorCode, supported_apis
+from josefine_tpu.raft.engine import NotLeader
 from josefine_tpu.raft.server import ProposalTimeout
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.tracing import get_logger
@@ -130,7 +132,7 @@ class Broker:
             if api_key == ApiKey.LEADER_AND_ISR:
                 return self.leader_and_isr(api_version, body)
             if api_key == ApiKey.PRODUCE:
-                return self.produce(api_version, body)
+                return await self.produce(api_version, body)
             if api_key == ApiKey.FETCH:
                 return await self.fetch(api_version, body)
             if api_key == ApiKey.LIST_OFFSETS:
@@ -206,7 +208,7 @@ class Broker:
                 parts.append({
                     "error_code": ErrorCode.NONE,
                     "partition_index": p.idx,
-                    "leader_id": p.leader,
+                    "leader_id": self._partition_leader(p),
                     "replica_nodes": p.assigned_replicas,
                     "isr_nodes": p.isr,
                     "offline_replicas": [],
@@ -372,31 +374,107 @@ class Broker:
 
     # -------------------------------------------------------------- Produce
 
-    def produce(self, version: int, body: dict) -> dict | None:
-        """Append record batches to partition logs with offset assignment
-        (reference ``produce.rs:11-36`` writes raw bytes and assigns
-        nothing). acks=0 produces no response (Kafka semantics)."""
+    def _live_group(self, p: Partition) -> int | None:
+        """The partition's consensus group, if this process can actually
+        consult it: the raft client must expose group leadership AND the
+        engine must have the row (a store written under a larger
+        engine.partitions can reference rows this process lacks — those
+        partitions degrade to legacy static leadership, not a crash)."""
+        if p.group < 1:
+            return None
+        if getattr(self.client, "is_leader", None) is None:
+            return None
+        has = getattr(self.client, "has_group", None)
+        if has is not None and not has(p.group):
+            return None
+        return p.group
+
+    def _partition_leader(self, p: Partition) -> int:
+        """Live leader of a partition: for group-backed partitions this is
+        its consensus group's CURRENT Raft leader (leadership moves with
+        elections — the whole point of the P-axis wiring); for legacy
+        (group-less) partitions, the statically assigned broker."""
+        g = self._live_group(p)
+        if g is not None:
+            live = self.client.leader_id(g)
+            if live is not None:
+                return live
+        return p.leader
+
+    def _leads_partition(self, p: Partition) -> bool:
+        g = self._live_group(p)
+        if g is not None:
+            return bool(self.client.is_leader(g))
+        return p.leader == self.config.id
+
+    async def produce(self, version: int, body: dict) -> dict | None:
+        """Append record batches with offset assignment (reference
+        ``produce.rs:11-36`` writes raw bytes and assigns nothing). For
+        group-backed partitions the batch is REPLICATED: it rides the
+        partition's own consensus group and every replica's FSM appends it
+        to its local log with an identical base offset — the reference's
+        data plane is leader-local and write-only. acks=0 produces no
+        response (Kafka semantics); the proposal still commits in the
+        background."""
         topics_out = []
+        acks = body.get("acks")
         for t in body.get("topics") or []:
             parts_out = []
             for p in t.get("partitions") or []:
                 idx = p["index"]
                 err, base = ErrorCode.NONE, -1
-                rep = self._writable_replica(t["name"], idx)
-                if isinstance(rep, int):
-                    err = rep
+                got = self._writable_replica(t["name"], idx)
+                if isinstance(got, int):
+                    err = got
                 else:
+                    rep, part = got
                     batch = p.get("records") or b""
-                    count = records.record_count(batch)
-                    base = rep.log.next_offset()
-                    rep.log.append(records.set_base_offset(batch, base), count=count)
+                    group = self._live_group(part)
+                    if not batch:
+                        pass
+                    elif group is not None:
+                        err, base = await self._produce_replicated(
+                            group, batch, acks)
+                    else:
+                        count = records.record_count(batch)
+                        base = rep.log.next_offset()
+                        rep.log.append(records.set_base_offset(batch, base),
+                                       count=count)
                 parts_out.append({"index": idx, "error_code": err,
                                   "base_offset": base, "log_append_time_ms": -1,
                                   "log_start_offset": 0})
             topics_out.append({"name": t["name"], "partitions": parts_out})
-        if body.get("acks") == 0:
+        if acks == 0:
             return {"__no_response__": True}
         return {"responses": topics_out, "throttle_time_ms": 0}
+
+    async def _produce_replicated(self, group: int, batch: bytes,
+                                  acks) -> tuple[int, int]:
+        """One produced batch = one proposal on the partition's group."""
+        try:
+            if acks == 0:
+                # Fire-and-forget: commit proceeds, nobody awaits the offset.
+                # acks=0 means the client accepted silent loss — leadership
+                # churn mid-flight is logged, never raised.
+                async def fire():
+                    try:
+                        await self.client.propose_local(batch, group=group)
+                    except Exception as e:  # noqa: BLE001 - acks=0 contract
+                        log.warning("acks=0 produce dropped (group %d): %s",
+                                    group, e)
+                task = asyncio.get_running_loop().create_task(fire())
+                self._bg_tasks.add(task)
+                task.add_done_callback(self._bg_tasks.discard)
+                return int(ErrorCode.NONE), -1
+            result = await self.client.propose_local(batch, group=group)
+            return int(ErrorCode.NONE), partition_fsm.decode_base_offset(result)
+        except NotLeader:
+            return int(ErrorCode.NOT_LEADER_OR_FOLLOWER), -1
+        except (ProposalTimeout, asyncio.TimeoutError):
+            return int(ErrorCode.REQUEST_TIMED_OUT), -1
+        except Exception:  # noqa: BLE001 - surfaced to the client
+            log.exception("replicated produce failed (group %d)", group)
+            return int(ErrorCode.UNKNOWN_SERVER_ERROR), -1
 
     def _local_replica(self, topic: str, idx: int):
         """Replica this broker hosts, materialized from the replicated store
@@ -414,11 +492,16 @@ class Broker:
         return rep
 
     def _writable_replica(self, topic: str, idx: int):
-        """Replica if this broker leads (topic, idx), else an error code."""
+        """(replica, partition) if this broker leads (topic, idx), else an
+        error code. For group-backed partitions leadership is the consensus
+        group's live Raft leadership, not the statically stored assignment."""
         rep = self._local_replica(topic, idx)
-        if not isinstance(rep, int) and rep.leader != self.config.id:
+        if isinstance(rep, int):
+            return rep
+        part = self.store.get_partition(topic, idx) or rep.partition
+        if not self._leads_partition(part):
             return int(ErrorCode.NOT_LEADER_OR_FOLLOWER)
-        return rep
+        return rep, part
 
     # ---------------------------------------------------------------- Fetch
 

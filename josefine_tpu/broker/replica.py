@@ -45,7 +45,12 @@ class ReplicaRegistry:
             rep = Replica(self._data_dir, partition)
             self._replicas[key] = rep
         else:
-            rep.partition = partition  # refresh leader/isr on re-announce
+            # Refresh leader/isr on re-announce — but never let a groupless
+            # announcement (LeaderAndIsr carries no group field) stomp the
+            # consensus-group binding established by the replicated store.
+            if partition.group < 0 and rep.partition.group >= 0:
+                partition.group = rep.partition.group
+            rep.partition = partition
         return rep
 
     def get(self, topic: str, idx: int) -> Replica | None:

@@ -55,7 +55,14 @@ class Topic:
 @dataclass
 class Partition:
     """Parity: reference ``partition.rs:12-18`` (id, idx, topic, isr,
-    assigned replicas, leader)."""
+    assigned replicas, leader).
+
+    TPU-build addition: ``group`` is the consensus-group row this partition
+    claims on the device state tensor (the (partitions x nodes) P axis).
+    -1 = no group (legacy data-plane: leader-local log, no replication).
+    Assigned deterministically at EnsurePartition commit time, so every node
+    agrees on the mapping (reference partitions have no consensus at all —
+    ``create_topics.rs:27-61`` only picks replica broker ids)."""
 
     topic: str
     idx: int
@@ -63,6 +70,7 @@ class Partition:
     isr: list[int] = field(default_factory=list)
     assigned_replicas: list[int] = field(default_factory=list)
     leader: int = 0
+    group: int = -1
 
     def encode(self) -> bytes:
         return _dumps(asdict(self))
@@ -219,6 +227,12 @@ class Store:
         pfx = self._pfx + _PARTITION + topic.encode() + b":"
         return [Partition.decode(v) for _, v in self._kv.scan_prefix(pfx)]
 
+    def get_all_partitions(self) -> list[Partition]:
+        """Every partition of every topic (restart re-wiring of consensus
+        groups scans this once)."""
+        return [Partition.decode(v)
+                for _, v in self._kv.scan_prefix(self._pfx + _PARTITION)]
+
     # ------------------------------------------------------------ brokers
 
     def ensure_broker(self, broker: Broker) -> Broker:
@@ -245,6 +259,22 @@ class Store:
             body = k[len(self._pfx + _OFFSET):-9]
             if body.rsplit(b":", 1)[-1] == name.encode():
                 self._kv.delete(k)
+
+    # ------------------------------------------- consensus-group allocation
+
+    def claim_group(self, pool: int) -> int:
+        """Allocate the next consensus-group row in [1, pool), or -1 when
+        the pool is exhausted. Deterministic (pure function of store state),
+        so every node applying the same committed EnsurePartition assigns
+        the same row. Monotone: freed rows are NOT reused — a reused row
+        would inherit the dead topic's chain/log state (safe reuse needs a
+        replicated group reset, future work)."""
+        raw = self._kv.get(self._pfx + b"galloc:next")
+        nxt = int(raw) if raw else 1
+        if nxt >= pool:
+            return -1
+        self._kv.put(self._pfx + b"galloc:next", b"%d" % (nxt + 1))
+        return nxt
 
     # ------------------------------------------------------------- groups
 

@@ -132,6 +132,12 @@ class JosefineConfig:
         self.raft.validate()
         self.broker.validate()
         self.engine.validate()
+        if self.engine.partitions > 1 and self.raft.id != self.broker.id:
+            # Partition replica sets are broker ids; mapping them onto raft
+            # node slots (consensus-group membership) requires the two id
+            # spaces to coincide, as they do in every example config.
+            raise ValueError(
+                "engine.partitions > 1 requires raft.id == broker.id")
         return self
 
 

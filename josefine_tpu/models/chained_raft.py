@@ -210,6 +210,11 @@ def node_step(
     dstN = jnp.arange(N, dtype=_I32)
     st_in = st
     commit_s0 = st.commit.s
+    # Own membership gates candidacy and sends: a node outside a group's
+    # member set (an unclaimed partition row, or a node removed at runtime)
+    # must not campaign in it or push AEs into it. Messages FROM non-member
+    # slots are already masked per-src in _process_msg.
+    my_member = member[me]
 
     # ---- 1. inbox fold (sequential over srcs; N is small and static) ----
     reply = empty_msgs((N,))
@@ -226,7 +231,7 @@ def node_step(
     # :248-256 and candidate re-election) ----
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
-    timed_out = st.alive & ~is_leader & (elapsed >= st.timeout)
+    timed_out = st.alive & my_member & ~is_leader & (elapsed >= st.timeout)
     new_term = jnp.where(timed_out, st.term + 1, st.term)
     self_vote = dstN == me
     st = st.replace(
@@ -303,7 +308,7 @@ def node_step(
     # (leader.rs:44-51,124-174 unified); else per-src replies.
     is_peer = member & (dstN != me)
     hb_due = st.hb_elapsed >= params.hb_ticks
-    send_ae = is_leader & st.alive & is_peer & (hb_due | ids.lt(st.nxt, st.head))
+    send_ae = is_leader & st.alive & my_member & is_peer & (hb_due | ids.lt(st.nxt, st.head))
     st = st.replace(
         hb_elapsed=jnp.where(is_leader, jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
     )

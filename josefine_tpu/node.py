@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 
 from josefine_tpu.broker.fsm import JosefineFsm, Transition
+from josefine_tpu.broker.partition_fsm import PartitionFsm
 from josefine_tpu.broker.server import JosefineBroker
 from josefine_tpu.broker.state import Broker as BrokerInfo
 from josefine_tpu.broker.state import Store
@@ -38,7 +39,10 @@ class Node:
         self.shutdown = shutdown or Shutdown()
         self.kv = open_kv(None if in_memory else config.broker.state_file)
         self.store = Store(self.kv)
-        self.fsm = JosefineFsm(self.store)
+        # group_pool = engine.partitions: row 0 is the metadata group; rows
+        # [1, P) are claimable by topic partitions (one consensus group per
+        # partition — the P axis of the device state tensor).
+        self.fsm = JosefineFsm(self.store, group_pool=config.engine.partitions)
         self.raft = JosefineRaft(
             config.raft,
             self.kv,
@@ -59,6 +63,14 @@ class Node:
         # (later requests must see the topic gone); the rmtree runs in an
         # executor so FSM apply never stalls the raft event loop.
         self.fsm.on_delete_topic = self._drop_topic_local
+        # P-axis wiring (deliberately attached AFTER engine construction so
+        # the engine's own group-0 restart replay cannot fire them): when an
+        # EnsurePartition with a consensus group commits, every node claims
+        # the group row's member columns, and nodes hosting a replica attach
+        # the data-plane PartitionFsm. Startup re-wires from the store scan.
+        self.fsm.on_partition_assigned = self._wire_partition
+        self.fsm.on_partition_released = self._release_partition
+        self._rewire_partitions()
         self._register_task: asyncio.Task | None = None
         # Observability endpoint (TPU-build addition; the reference's only
         # runtime introspection is a debug file rewritten every tick).
@@ -70,6 +82,52 @@ class Node:
                 config.broker.ip, config.broker.metrics_port,
                 state_fn=lambda: self.raft.engine.debug_state(),
             )
+
+    def _rewire_partitions(self) -> None:
+        """Restart path: rebuild every partition's consensus-group wiring
+        from the replicated store — claim member columns for live groups,
+        idle every unclaimed row (no elections on unused device rows), and
+        re-attach data-plane FSMs for locally hosted replicas (their
+        registration replays any committed-but-unapplied suffix)."""
+        eng = self.raft.engine
+        claims: dict[int, set[int]] = {}
+        hosted: list = []
+        for p in self.store.get_all_partitions():
+            if p.group < 1 or p.group >= eng.P:
+                continue
+            slots = {eng.members.slot_of(b) for b in p.assigned_replicas}
+            slots.discard(None)
+            claims[p.group] = slots
+            if self.config.broker.id in p.assigned_replicas:
+                hosted.append(p)
+        eng.configure_groups(claims)
+        for p in hosted:
+            rep = self.broker.broker.replicas.ensure(p)
+            eng.register_fsm(p.group, PartitionFsm(self.kv, p.group, rep.log))
+
+    def _wire_partition(self, p) -> None:
+        """Commit-time hook: an EnsurePartition with a group claim applied.
+        Idempotent (snapshot restore re-fires it for every partition)."""
+        eng = self.raft.engine
+        if p.group < 1 or p.group >= eng.P:
+            return
+        slots = {eng.members.slot_of(b) for b in p.assigned_replicas}
+        slots.discard(None)
+        eng.set_group_members(p.group, slots)
+        if self.config.broker.id in p.assigned_replicas:
+            rep = self.broker.broker.replicas.ensure(p)
+            if p.group not in eng.drivers:
+                eng.register_fsm(p.group, PartitionFsm(self.kv, p.group, rep.log))
+
+    def _release_partition(self, p) -> None:
+        """Commit-time hook: the partition's topic was deleted — idle the
+        group row. The row is NOT reused (Store.claim_group is monotone), so
+        the dead chain/pfsm state cannot leak into a future topic."""
+        eng = self.raft.engine
+        if p.group < 1 or p.group >= eng.P:
+            return
+        eng.unregister_fsm(p.group)
+        eng.set_group_members(p.group, set())
 
     def _drop_topic_local(self, name: str) -> None:
         replicas = self.broker.broker.replicas

@@ -221,10 +221,11 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
         acc_blocks = acc_blocks + span
         acc_msgs = acc_msgs + jnp.where(accept, 1, 0)
 
-    # ---- 2. timers -> candidacy ----
+    # ---- 2. timers -> candidacy (own membership gates candidacy: mirrors
+    # node_step's ``my_member`` — non-members of a group never campaign) ----
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
-    timed_out = alive_b & ~is_leader & (elapsed >= st.timeout)
+    timed_out = alive_b & member_b & ~is_leader & (elapsed >= st.timeout)
     new_term = jnp.where(timed_out, st.term + 1, st.term)
     me2 = jax.lax.broadcasted_iota(_I32, (N, T), 0)
     st = st.replace(
@@ -297,7 +298,7 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
     # ---- 6. outbox ----
     is_peer = (member3 != 0) & ~eye3                              # [me, dst] i1
     hb_due = st.hb_elapsed >= params.hb_ticks
-    lead3 = (is_leader & alive_b)[:, None, :]
+    lead3 = (is_leader & alive_b & member_b)[:, None, :]
     send_ae = lead3 & is_peer & (hb_due[:, None, :] | ids.lt(st.nxt, head3))
     st = st.replace(
         hb_elapsed=jnp.where(is_leader,

@@ -16,3 +16,22 @@ class RaftClient:
         """Submit a state-machine transition; resolves with the FSM result
         once committed (routing through the current leader transparently)."""
         return await self._server.propose(payload, group=group, timeout=timeout)
+
+    async def propose_local(self, payload: bytes, group: int = 0,
+                            timeout: float = 5.0) -> bytes:
+        """Propose only if this node leads ``group`` (raises NotLeader
+        otherwise — Kafka data-plane semantics: the client re-routes)."""
+        return await self._server.propose_local(payload, group=group, timeout=timeout)
+
+    def has_group(self, group: int) -> bool:
+        """Whether the device tensor actually has this group row (a store
+        created under a larger engine.partitions may reference rows this
+        process does not have)."""
+        return self._server.engine.has_group(group)
+
+    def is_leader(self, group: int = 0) -> bool:
+        return self._server.engine.is_leader(group)
+
+    def leader_id(self, group: int = 0) -> int | None:
+        """Node id currently leading ``group`` (None = unknown/electing)."""
+        return self._server.engine.leader_id(group)

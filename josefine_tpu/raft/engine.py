@@ -134,6 +134,12 @@ class RaftEngine:
                 "configured with the full current member list")
         self.me = slot
         self.node_ids = [self.members.id_of(s) for s in range(self.N)]
+        # Per-group membership claims (the P-axis product wiring): group 0
+        # always spans all active members; a data group claimed by a topic
+        # partition is restricted to its replica set's slots; an explicitly
+        # idled group (empty claim) elects nobody. Groups without an entry
+        # default to full membership (bench / legacy behavior).
+        self._group_claims: dict[int, frozenset[int]] = {}
         self.params = params or step_params()
         if int(self.params.auto_proposals) != 0:
             # The auto-proposal lane is a bench-only device feature; the
@@ -441,11 +447,14 @@ class RaftEngine:
 
     # ------------------------------------------------------------ lookups
 
+    def has_group(self, group: int) -> bool:
+        return 0 <= group < self.P
+
     def is_leader(self, group: int = 0) -> bool:
-        return self._h_role[group] == LEADER
+        return self.has_group(group) and self._h_role[group] == LEADER
 
     def leader_index(self, group: int = 0) -> int:
-        return int(self._h_leader[group])
+        return int(self._h_leader[group]) if self.has_group(group) else -1
 
     def leader_id(self, group: int = 0) -> int | None:
         idx = self.leader_index(group)
@@ -482,11 +491,98 @@ class RaftEngine:
 
     # -------------------------------------------------------- membership
 
-    def _member_mask(self) -> jnp.ndarray:
-        m = np.zeros(self.N, bool)
+    def _active_vec(self) -> np.ndarray:
+        active = np.zeros(self.N, bool)
         for s in self.members.active_slots():
-            m[s] = True
-        return jnp.broadcast_to(jnp.asarray(m)[None, :], (self.P, self.N))
+            active[s] = True
+        return active
+
+    def _claim_row(self, g: int, active: np.ndarray) -> np.ndarray:
+        """One group's member columns: its claim set (if any) intersected
+        with the active cluster members. The single source of truth for both
+        the full rebuild and the incremental row update."""
+        slots = self._group_claims.get(g)
+        if slots is None:
+            return active
+        row = np.zeros(self.N, bool)
+        for s in slots:
+            if 0 <= s < self.N:
+                row[s] = True
+        return row & active
+
+    def _member_mask(self) -> jnp.ndarray:
+        """(P, N) membership: active-member columns, restricted per group by
+        its claim set (see _group_claims). Full rebuild — called at init and
+        on (rare) cluster-membership changes; per-partition claims use the
+        incremental row update in set_group_members."""
+        active = self._active_vec()
+        m = np.broadcast_to(active[None, :], (self.P, self.N)).copy()
+        for g in self._group_claims:
+            m[g] = self._claim_row(g, active)
+        self._mask_np = m
+        return jnp.asarray(m)
+
+    def set_group_members(self, g: int, slots) -> None:
+        """Claim (or idle, with an empty set) a data group's member columns.
+        ``slots=None`` reverts the group to default full membership."""
+        if g == 0 or not (0 < g < self.P):
+            raise ValueError(f"group {g} not a claimable data group (P={self.P})")
+        if slots is None:
+            self._group_claims.pop(g, None)
+        else:
+            self._group_claims[g] = frozenset(int(s) for s in slots)
+        # Incremental: rewrite only row g of the host mask, re-upload.
+        self._mask_np[g] = self._claim_row(g, self._active_vec())
+        self.member = jnp.asarray(self._mask_np)
+
+    def group_members(self, g: int) -> frozenset[int] | None:
+        return self._group_claims.get(g)
+
+    def configure_groups(self, claims: dict[int, frozenset[int] | set[int]]) -> None:
+        """Replace ALL data-group claims at once (startup re-wiring from the
+        replicated store): groups in ``claims`` get their slot sets, every
+        other data row is idled (empty claim — no elections, no traffic).
+        One mask rebuild instead of P incremental updates."""
+        self._group_claims = {
+            g: frozenset(int(s) for s in slots)
+            for g, slots in claims.items() if 0 < g < self.P
+        }
+        for g in range(1, self.P):
+            self._group_claims.setdefault(g, frozenset())
+        self.member = self._member_mask()
+
+    def register_fsm(self, g: int, fsm: Fsm) -> None:
+        """Attach an FSM to a data group at runtime (a topic partition
+        claiming its consensus row after EnsurePartition commits, or at
+        restart re-wiring). Replays the committed suffix the FSM has not yet
+        applied: positioned FSMs (``applied_id()``) resume exactly there;
+        snapshot FSMs restore + replay as in __init__; plain FSMs get no
+        replay (assumed durable in their own right)."""
+        if g == 0:
+            raise ValueError("group 0 is the metadata group (constructor-wired)")
+        drv = Driver(fsm)
+        self.drivers[g] = drv
+        ch = self.chains[g]
+        applied = getattr(fsm, "applied_id", None)
+        if callable(applied):
+            start = max(applied(), ch.floor)
+            if ch.committed > start:
+                drv.apply(ch.range(start, ch.committed))
+        elif supports_snapshot(fsm) and ch.committed != GENESIS:
+            snap_id, snap_data = self._load_snapshot(g)
+            start = GENESIS
+            if snap_id is not None:
+                fsm.restore(snap_data)
+                start = snap_id
+            else:
+                fsm.restore(b"")
+            if ch.committed > start:
+                drv.apply(ch.range(start, ch.committed))
+
+    def unregister_fsm(self, g: int) -> None:
+        drv = self.drivers.pop(g, None)
+        if drv is not None:
+            drv.drop_waiters(NotLeader(g, -1))
 
     def _safe_conf_apply(self, blk) -> ConfChange | None:
         """Decode + apply one committed conf block to the member table.
@@ -592,7 +688,15 @@ class RaftEngine:
     def _maybe_snapshot(self) -> None:
         if self.snapshot_threshold is None and self.snapshot_interval_ticks is None:
             return
-        for g in self.drivers:
+        for g, drv in self.drivers.items():
+            if not supports_snapshot(drv.fsm):
+                # Data-plane FSMs (PartitionFsm) have no snapshot pair yet:
+                # their chains are not compacted (future work: follower log
+                # sync from the leader's segmented log, Kafka-style, so the
+                # chain below commit can be truncated). Skipping here avoids
+                # a no-op take_snapshot retry every tick once the backlog
+                # crosses the threshold.
+                continue
             ch = self.chains[g]
             backlog = id_seq(ch.committed) - id_seq(ch.floor)
             if backlog <= 0:

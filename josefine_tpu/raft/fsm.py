@@ -77,9 +77,15 @@ class Driver:
         self._waiters.clear()
 
     def apply(self, blocks: list[Block]) -> None:
+        # FSMs that need the block identity for idempotent re-apply (the
+        # data-plane PartitionFsm's exact-once log append) expose
+        # transition_block(blk); plain FSMs get the payload only.
+        tb = getattr(self.fsm, "transition_block", None)
         for blk in blocks:
             if not blk.data:  # genesis / no-op blocks carry no payload
                 result = b""
+            elif tb is not None:
+                result = tb(blk)
             else:
                 result = self.fsm.transition(blk.data)
             fut = self._waiters.pop(blk.id, None)

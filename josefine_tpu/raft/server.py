@@ -151,6 +151,18 @@ class JosefineRaft:
                     await asyncio.sleep(self.config.tick_ms / 1000)
                     continue
 
+    async def propose_local(self, payload: bytes, group: int = 0,
+                            timeout: float = 5.0) -> bytes:
+        """Propose WITHOUT leader forwarding: raises NotLeader immediately if
+        this node cannot mint for ``group``. The Kafka data plane uses this —
+        a Produce landing on a non-leader must get NOT_LEADER_OR_FOLLOWER so
+        the client re-routes from metadata, not a silent server-side proxy."""
+        try:
+            fut = self.engine.propose(group, payload)
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            raise ProposalTimeout(f"propose timed out after {timeout}s")
+
     async def _forward(
         self, group: int, payload: bytes, leader_id: int, timeout: float, req_id: str
     ) -> bytes:

@@ -127,14 +127,14 @@ async def test_produce_fetch_roundtrip(broker):
     await create_topic(broker, partitions=1)
     batch1 = make_batch(b"records-one", n_records=3)
     batch2 = make_batch(b"records-two", n_records=2)
-    resp = broker.produce(3, {
+    resp = await broker.produce(3, {
         "acks": -1, "timeout_ms": 1000,
         "topics": [{"name": "events", "partitions": [
             {"index": 0, "records": batch1}]}],
     })
     p0 = resp["responses"][0]["partitions"][0]
     assert (p0["error_code"], p0["base_offset"]) == (ErrorCode.NONE, 0)
-    resp = broker.produce(3, {
+    resp = await broker.produce(3, {
         "acks": -1, "timeout_ms": 1000,
         "topics": [{"name": "events", "partitions": [
             {"index": 0, "records": batch2}]}],
@@ -160,9 +160,9 @@ async def test_produce_fetch_roundtrip(broker):
 @pytest.mark.asyncio
 async def test_fetch_from_middle_offset(broker):
     await create_topic(broker, partitions=1)
-    broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
+    await broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
         {"index": 0, "records": make_batch(b"a", 2)}]}]})
-    broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
+    await broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
         {"index": 0, "records": make_batch(b"b", 2)}]}]})
     fetched = await broker.fetch(4, {
         "max_wait_ms": 0,
@@ -179,7 +179,7 @@ async def test_fetch_after_restart_materializes_replica(broker, tmp_path):
     # A restarted broker has an empty in-memory registry but the partition
     # in its replicated store and the log on disk: Fetch must come back.
     await create_topic(broker, partitions=1)
-    broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
+    await broker.produce(3, {"acks": -1, "topics": [{"name": "events", "partitions": [
         {"index": 0, "records": make_batch(b"durable", 1)}]}]})
     broker.replicas.close()  # simulate process restart (registry wiped)
     fetched = await broker.fetch(4, {
@@ -192,8 +192,9 @@ async def test_fetch_after_restart_materializes_replica(broker, tmp_path):
     assert fp["records"].endswith(b"durable")
 
 
-def test_produce_unknown_partition(broker):
-    resp = broker.produce(3, {"acks": -1, "topics": [{"name": "ghost", "partitions": [
+@pytest.mark.asyncio
+async def test_produce_unknown_partition(broker):
+    resp = await broker.produce(3, {"acks": -1, "topics": [{"name": "ghost", "partitions": [
         {"index": 0, "records": make_batch(b"x")}]}]})
     assert (resp["responses"][0]["partitions"][0]["error_code"]
             == ErrorCode.UNKNOWN_TOPIC_OR_PARTITION)
@@ -205,7 +206,7 @@ async def test_produce_not_leader(broker):
     from josefine_tpu.broker.state import Partition
     broker.store.create_partition(
         Partition(topic="t", idx=0, isr=[2], assigned_replicas=[2], leader=2))
-    resp = broker.produce(3, {"acks": -1, "topics": [{"name": "t", "partitions": [
+    resp = await broker.produce(3, {"acks": -1, "topics": [{"name": "t", "partitions": [
         {"index": 0, "records": make_batch(b"x")}]}]})
     assert (resp["responses"][0]["partitions"][0]["error_code"]
             == ErrorCode.NOT_LEADER_OR_FOLLOWER)
@@ -214,7 +215,7 @@ async def test_produce_not_leader(broker):
 @pytest.mark.asyncio
 async def test_produce_acks_zero_no_response(broker):
     await create_topic(broker, partitions=1)
-    resp = broker.produce(3, {"acks": 0, "topics": [{"name": "events", "partitions": [
+    resp = await broker.produce(3, {"acks": 0, "topics": [{"name": "events", "partitions": [
         {"index": 0, "records": make_batch(b"fire-and-forget")}]}]})
     assert resp == {"__no_response__": True}
     assert broker.replicas.get("events", 0).log.next_offset() == 1

@@ -287,7 +287,7 @@ async def test_offset_commit_unknown_partition_and_generation(broker):
 async def test_list_offsets(broker):
     await create_topic(broker, "t", partitions=1)
     batch = records.build_batch(b"hello", 3)
-    broker.produce(3, {"acks": 1, "timeout_ms": 1000, "topics": [
+    await broker.produce(3, {"acks": 1, "timeout_ms": 1000, "topics": [
         {"name": "t", "partitions": [{"index": 0, "records": batch}]}]})
     lo = broker.list_offsets(1, {"replica_id": -1, "topics": [
         {"name": "t", "partitions": [
@@ -308,7 +308,7 @@ async def test_list_offsets(broker):
 async def test_delete_topics_removes_everything(broker, tmp_path):
     await create_topic(broker, "doomed", partitions=2)
     batch = records.build_batch(b"payload", 1)
-    broker.produce(3, {"acks": 1, "timeout_ms": 1000, "topics": [
+    await broker.produce(3, {"acks": 1, "timeout_ms": 1000, "topics": [
         {"name": "doomed", "partitions": [{"index": 0, "records": batch}]}]})
     await broker.offset_commit(2, {
         "group_id": "g1", "generation_id": -1, "member_id": "",

@@ -36,10 +36,12 @@ def free_ports(n):
 class NodeManager:
     """N full nodes in one event loop (reference tests/josefine.rs:13-99)."""
 
-    def __init__(self, n, tmp_path, tick_ms=30):
+    def __init__(self, n, tmp_path, tick_ms=30, partitions=1, in_memory=True):
         raft_ports = free_ports(n)
         broker_ports = free_ports(n)
         self.nodes = []
+        self.configs = []
+        self.in_memory = in_memory
         for i in range(n):
             node_id = i + 1
             peers = [NodeAddr(id=j + 1, ip="127.0.0.1", port=raft_ports[j])
@@ -55,9 +57,10 @@ class NodeManager:
                                     port=broker_ports[i],
                                     state_file=str(tmp_path / f"node-{node_id}/state.db"),
                                     data_directory=str(tmp_path / f"node-{node_id}/data")),
-                engine=EngineConfig(partitions=1),
+                engine=EngineConfig(partitions=partitions),
             )
-            self.nodes.append(Node(cfg, in_memory=True))
+            self.configs.append(cfg)
+            self.nodes.append(Node(cfg, in_memory=in_memory))
         self.broker_ports = broker_ports
 
     async def __aenter__(self):
