@@ -50,7 +50,10 @@ class RaftConfig:
     heartbeat_timeout_ms: int = 100
     election_timeout_min_ms: int = 500
     election_timeout_max_ms: int = 1000
-    commit_timeout_ms: int = 50
+    # Flow-control cap: blocks per AppendEntries frame (honored by the
+    # engine's outbox — the reference carries this knob but never reads it,
+    # SURVEY.md quirk 9; its hot path hardcodes MAX_INFLIGHT=5).
+    # The reference's commit_timeout_ms knob (also dead there) is dropped.
     max_append_entries: int = 64
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.

@@ -44,9 +44,12 @@ from josefine_tpu.models.types import (
     CANDIDATE,
     FOLLOWER,
     LEADER,
+    PRECANDIDATE,
     MSG_APPEND,
     MSG_APPEND_RESP,
     MSG_NONE,
+    MSG_PREVOTE_REQ,
+    MSG_PREVOTE_RESP,
     MSG_VOTE_REQ,
     MSG_VOTE_RESP,
     Metrics,
@@ -87,10 +90,21 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
     src_i = jnp.asarray(src, _I32)
     valid = (m.kind != MSG_NONE) & st.alive & src_member
 
+    # -- leader-lease stickiness (pre-vote mode): while we heard from a live
+    # leader within the minimum election window, vote/pre-vote requests are
+    # ignored outright — a partitioned-and-returned (or removed) node must
+    # not be able to disrupt a healthy group. Computed from PRE-adoption
+    # state, and gated so VOTE_REQs inside the lease cannot even bump terms.
+    sticky = (params.prevote == 1) & (st.leader != -1) & (st.elapsed < params.timeout_min)
+
     # -- universal term catch-up: any message from a higher term demotes us.
     # (Strictly-greater only: fixes the reference's unconditional heartbeat
     # term adoption, src/raft/follower.rs:178-187 / mod.rs:360-365.)
-    higher = valid & (m.term > st.term)
+    # PREVOTE_REQ carries a PROPOSED term and never adopts — the point of
+    # the pre-vote round is that no state moves until a quorum agrees.
+    higher = (valid & (m.term > st.term)
+              & (m.kind != MSG_PREVOTE_REQ)
+              & ~(sticky & (m.kind == MSG_VOTE_REQ)))
     new_term = jnp.where(higher, m.term, st.term)
     st = st.replace(
         term=new_term,
@@ -112,16 +126,26 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
         & (st.role == FOLLOWER)
         & ((st.voted_for == -1) | (st.voted_for == src_i))
         & ids.ge(m.x, st.head)
+        & ~sticky
     )
     st = st.replace(
         voted_for=jnp.where(grant, src_i, st.voted_for),
         elapsed=jnp.where(grant, 0, st.elapsed),
     )
 
-    # -- VoteResponse (reference candidate.rs:91-98).
+    # -- PreVoteRequest: would we grant a vote at the proposed term? No
+    # state moves either way (Raft thesis §9.6). The lease covers leaders
+    # (their own heartbeat keeps leader != -1 and elapsed == 0).
+    is_pvr = valid & (m.kind == MSG_PREVOTE_REQ)
+    pv_grant = is_pvr & (m.term > st.term) & ids.ge(m.x, st.head) & ~sticky
+
+    # -- VoteResponse (reference candidate.rs:91-98); PreVoteResponse tallies
+    # into the same votes row while pre-candidate (cleared on promotion).
     is_vresp = cur & (m.kind == MSG_VOTE_RESP) & (st.role == CANDIDATE)
+    is_pvresp = valid & (m.kind == MSG_PREVOTE_RESP) & (st.role == PRECANDIDATE)
+    got_vote = (is_vresp | is_pvresp) & (m.ok == 1)
     st = st.replace(
-        votes=ids.set_row(st.votes, src, st.votes[src] | (is_vresp & (m.ok == 1)))
+        votes=ids.set_row(st.votes, src, st.votes[src] | got_vote)
     )
 
     # -- AppendEntries / heartbeat (reference follower.rs:130-217).
@@ -170,7 +194,9 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
 
     # -- reply (at most one per src per tick; responses only).
     rep_kind = jnp.where(
-        is_vr, MSG_VOTE_RESP, jnp.where(is_ae_kind, MSG_APPEND_RESP, MSG_NONE)
+        is_vr, MSG_VOTE_RESP,
+        jnp.where(is_ae_kind, MSG_APPEND_RESP,
+                  jnp.where(is_pvr, MSG_PREVOTE_RESP, MSG_NONE))
     )
     zero = ids.full(())
     rep = Msgs(
@@ -181,7 +207,7 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
         x=ids.where(accept, st.head, st.commit),
         y=zero,
         z=zero,
-        ok=(grant | accept).astype(_I32),
+        ok=(grant | accept | pv_grant).astype(_I32),
     )
     return st, rep, span, accept.astype(_I32)
 
@@ -227,28 +253,45 @@ def node_step(
         acc_blocks = acc_blocks + span
         acc_msgs = acc_msgs + acc
 
-    # ---- 2. timers: election timeout -> candidacy (follower.rs:103-128,
-    # :248-256 and candidate re-election) ----
+    # ---- 2. timers: election timeout -> (pre-)candidacy (follower.rs:
+    # 103-128, :248-256; pre-vote from the Raft thesis §9.6: no term bump,
+    # no voted_for change until a pre-vote quorum agrees) ----
+    pv = params.prevote == 1
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
     timed_out = st.alive & my_member & ~is_leader & (elapsed >= st.timeout)
-    new_term = jnp.where(timed_out, st.term + 1, st.term)
+    new_term = jnp.where(timed_out & ~pv, st.term + 1, st.term)
     self_vote = dstN == me
     st = st.replace(
         term=new_term,
         elapsed=jnp.where(timed_out, 0, elapsed),
-        role=jnp.where(timed_out, CANDIDATE, st.role),
-        voted_for=jnp.where(timed_out, me, st.voted_for),
+        role=jnp.where(timed_out, jnp.where(pv, PRECANDIDATE, CANDIDATE), st.role),
+        voted_for=jnp.where(timed_out & ~pv, me, st.voted_for),
         leader=jnp.where(timed_out, -1, st.leader),
         votes=jnp.where(timed_out, self_vote, st.votes),
-        timeout=jnp.where(timed_out, _draw_timeout(st.seed, new_term, params), st.timeout),
+        # Redraw with term+1 in both modes (classic: the new term; pre-vote:
+        # the proposed term) so competing campaigners decorrelate.
+        timeout=jnp.where(timed_out, _draw_timeout(st.seed, st.term + 1, params), st.timeout),
     )
-    just_cand = timed_out
+    just_cand = timed_out & ~pv
+    just_precand = timed_out & pv
 
     # ---- 3. election tally (election.rs:37-73; quorum = n//2 + 1; the
     # single-node case needs no special 0-quorum hack — self vote suffices).
+    # Pre-vote promotion first: a pre-vote quorum starts the REAL candidacy
+    # (term bump, self vote, fresh ballot box) in the same tick.
     nvotes = jnp.sum(st.votes & member).astype(_I32)
     quorum = (jnp.sum(member).astype(_I32) // 2) + 1
+    pre_elected = st.alive & (st.role == PRECANDIDATE) & (nvotes >= quorum)
+    st = st.replace(
+        role=jnp.where(pre_elected, CANDIDATE, st.role),
+        term=jnp.where(pre_elected, st.term + 1, st.term),
+        voted_for=jnp.where(pre_elected, me, st.voted_for),
+        votes=jnp.where(pre_elected, self_vote, st.votes),
+        elapsed=jnp.where(pre_elected, 0, st.elapsed),
+        timeout=jnp.where(pre_elected, _draw_timeout(st.seed, st.term + 1, params), st.timeout),
+    )
+    nvotes = jnp.sum(st.votes & member).astype(_I32)
     elected = st.alive & (st.role == CANDIDATE) & (nvotes >= quorum)
     # Mint a no-op block at the new term (commit-liveness fix).
     noop = ids.Bid(t=st.term, s=st.head.s + 1)
@@ -312,17 +355,22 @@ def node_step(
     st = st.replace(
         hb_elapsed=jnp.where(is_leader, jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
     )
-    bc_vr = just_cand & st.alive & is_peer & ~is_leader
+    bc_vr = (just_cand | pre_elected) & st.alive & is_peer & ~is_leader
+    bc_pvr = just_precand & st.alive & is_peer & ~is_leader & ~bc_vr
 
     kind = jnp.where(
-        send_ae, MSG_APPEND, jnp.where(bc_vr, MSG_VOTE_REQ, reply.kind)
+        send_ae, MSG_APPEND,
+        jnp.where(bc_vr, MSG_VOTE_REQ,
+                  jnp.where(bc_pvr, MSG_PREVOTE_REQ, reply.kind))
     )
     headN = ids.broadcast_to(st.head, (N,))
     commitN = ids.broadcast_to(st.commit, (N,))
     out = Msgs(
         kind=jnp.where(st.alive, kind, MSG_NONE).astype(_I32),
-        term=jnp.where(send_ae | bc_vr, st.term, reply.term),
-        x=ids.where(send_ae, st.nxt, ids.where(bc_vr, headN, reply.x)),
+        # PREVOTE_REQ carries the PROPOSED term (current + 1), never bumped.
+        term=jnp.where(send_ae | bc_vr, st.term,
+                       jnp.where(bc_pvr, st.term + 1, reply.term)),
+        x=ids.where(send_ae, st.nxt, ids.where(bc_vr | bc_pvr, headN, reply.x)),
         y=ids.where(send_ae, headN, reply.y),
         z=ids.where(send_ae, commitN, reply.z),
         ok=reply.ok,

@@ -30,11 +30,18 @@ MSG_APPEND = 3      # x = prev, y = leader head, z = leader commit
                     #   (reference AppendEntries + Heartbeat, unified)
 MSG_APPEND_RESP = 4 # ok = success, x = acked head (or follower commit on reject)
                     #   (reference AppendResponse + HeartbeatResponse, unified)
+MSG_PREVOTE_REQ = 5  # pre-vote round: term = PROPOSED term (current + 1),
+                     # x = candidate head. Never adopts/bumps terms — the
+                     # point of pre-vote (no reference analog; the reference
+                     # has no membership change so less need for it).
+MSG_PREVOTE_RESP = 6 # ok = would-grant; term = voter's ACTUAL term.
 
-# Roles (reference typestate Raft<Follower|Candidate|Leader>, src/raft/mod.rs:326-401).
+# Roles (reference typestate Raft<Follower|Candidate|Leader>, src/raft/mod.rs:326-401;
+# PRECANDIDATE is the pre-vote extension from the Raft thesis §9.6).
 FOLLOWER = 0
 CANDIDATE = 1
 LEADER = 2
+PRECANDIDATE = 3
 
 
 @struct.dataclass
@@ -98,18 +105,24 @@ class StepParams:
     at a 100 ms tick -> 5..10, ``src/raft/mod.rs:318-319``,
     ``src/raft/server.rs:25``). hb_ticks: broadcast cadence (reference
     heartbeat_timeout 100 ms = 1 tick). auto_proposals: blocks minted per
-    leader per tick (the bench's client-load lane).
+    leader per tick (the bench's client-load lane). prevote: 1 = two-phase
+    elections (pre-vote round before any term bump — a partitioned or
+    removed node can never inflate cluster terms) plus leader-lease
+    stickiness on real VoteRequests; 0 = classic single-round elections.
     """
 
     timeout_min: jnp.ndarray  # i32
     timeout_max: jnp.ndarray  # i32
     hb_ticks: jnp.ndarray     # i32
     auto_proposals: jnp.ndarray  # i32
+    prevote: jnp.ndarray      # i32 (0/1)
 
 
-def step_params(timeout_min=5, timeout_max=10, hb_ticks=1, auto_proposals=0) -> StepParams:
+def step_params(timeout_min=5, timeout_max=10, hb_ticks=1, auto_proposals=0,
+                prevote=1) -> StepParams:
     a = lambda v: jnp.asarray(v, jnp.int32)
-    return StepParams(a(timeout_min), a(timeout_max), a(hb_ticks), a(auto_proposals))
+    return StepParams(a(timeout_min), a(timeout_max), a(hb_ticks),
+                      a(auto_proposals), a(prevote))
 
 
 @struct.dataclass

@@ -49,6 +49,7 @@ class Node:
             fsms={0: self.fsm},
             groups=config.engine.partitions,
             shutdown=self.shutdown.clone(),
+            backend=config.engine.backend,
         )
         self.client = RaftClient(self.raft)
         self.broker = JosefineBroker(

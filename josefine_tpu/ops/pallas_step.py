@@ -51,9 +51,12 @@ from josefine_tpu.models.types import (
     CANDIDATE,
     FOLLOWER,
     LEADER,
+    PRECANDIDATE,
     MSG_APPEND,
     MSG_APPEND_RESP,
     MSG_NONE,
+    MSG_PREVOTE_REQ,
+    MSG_PREVOTE_RESP,
     MSG_VOTE_REQ,
     MSG_VOTE_RESP,
     Msgs,
@@ -65,7 +68,7 @@ from josefine_tpu.ops import ids
 _I32 = jnp.int32
 
 # Number of scalar params packed into the SMEM params row.
-_N_PARAMS = 4
+_N_PARAMS = 5
 # Number of metric scalars per tile (5 used; padded to 8 lanes).
 _N_METRICS = 8
 _METRIC_FIELDS = ("accepted_blocks", "accepted_msgs", "minted",
@@ -139,9 +142,15 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
         # Non-member srcs are masked out (runtime membership; mirrors
         # node_step's src_member parameter).
         valid = (m.kind != MSG_NONE) & alive_b & member_b[src][None, :]
+        # leader-lease stickiness (pre-vote mode; node_step's ``sticky``).
+        sticky = ((params.prevote == 1) & (st.leader != -1)
+                  & (st.elapsed < params.timeout_min))
         # universal term catch-up (strictly greater only; reference quirk 1
-        # fixed — node_step ``_process_msg`` step 2).
-        higher = valid & (m.term > st.term)
+        # fixed — node_step ``_process_msg`` step 2). PREVOTE_REQ never
+        # adopts; leased voters ignore VOTE_REQ terms.
+        higher = (valid & (m.term > st.term)
+                  & (m.kind != MSG_PREVOTE_REQ)
+                  & ~(sticky & (m.kind == MSG_VOTE_REQ)))
         new_term = jnp.where(higher, m.term, st.term)
         st = st.replace(
             term=new_term,
@@ -161,17 +170,25 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
             cur & (m.kind == MSG_VOTE_REQ) & (st.role == FOLLOWER)
             & ((st.voted_for == -1) | (st.voted_for == src))
             & ids.ge(m.x, st.head)
+            & ~sticky
         )
         st = st.replace(
             voted_for=jnp.where(grant, src, st.voted_for),
             elapsed=jnp.where(grant, 0, st.elapsed),
         )
 
-        # VoteResponse.
+        # PreVoteRequest: would-grant at the proposed term; no state moves.
+        is_pvr = valid & (m.kind == MSG_PREVOTE_REQ)
+        pv_grant = is_pvr & (m.term > st.term) & ids.ge(m.x, st.head) & ~sticky
+
+        # VoteResponse / PreVoteResponse (same ballot row; cleared on
+        # promotion).
         is_vresp = cur & (m.kind == MSG_VOTE_RESP) & (st.role == CANDIDATE)
+        is_pvresp = valid & (m.kind == MSG_PREVOTE_RESP) & (st.role == PRECANDIDATE)
+        got_vote = (is_vresp | is_pvresp) & (m.ok == 1)
         st = st.replace(
             votes=_set_col(st.votes, src,
-                           jnp.where(is_vresp & (m.ok == 1), 1, st.votes[:, src, :]))
+                           jnp.where(got_vote, 1, st.votes[:, src, :]))
         )
 
         # AppendEntries / heartbeat.
@@ -207,7 +224,9 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
 
         # Reply (addressed to dst=src).
         rep_kind = jnp.where(is_vr, MSG_VOTE_RESP,
-                             jnp.where(is_ae_kind, MSG_APPEND_RESP, MSG_NONE))
+                             jnp.where(is_ae_kind, MSG_APPEND_RESP,
+                                       jnp.where(is_pvr, MSG_PREVOTE_RESP,
+                                                 MSG_NONE)))
         zero = jnp.zeros((N, T), _I32)
         rep = Msgs(
             kind=rep_kind.astype(_I32),
@@ -215,35 +234,48 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
             x=ids.where(accept, st.head, st.commit),
             y=ids.Bid(zero, zero),
             z=ids.Bid(zero, zero),
-            ok=jnp.where(grant | accept, 1, 0).astype(_I32),
+            ok=jnp.where(grant | accept | pv_grant, 1, 0).astype(_I32),
         )
         reply = jax.tree.map(lambda R, r: _set_col(R, src, r), reply, rep)
         acc_blocks = acc_blocks + span
         acc_msgs = acc_msgs + jnp.where(accept, 1, 0)
 
-    # ---- 2. timers -> candidacy (own membership gates candidacy: mirrors
-    # node_step's ``my_member`` — non-members of a group never campaign) ----
+    # ---- 2. timers -> (pre-)candidacy (own membership gates candidacy:
+    # mirrors node_step's ``my_member``; pre-vote mode bumps no term) ----
+    pv = params.prevote == 1
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
     timed_out = alive_b & member_b & ~is_leader & (elapsed >= st.timeout)
-    new_term = jnp.where(timed_out, st.term + 1, st.term)
+    new_term = jnp.where(timed_out & ~pv, st.term + 1, st.term)
     me2 = jax.lax.broadcasted_iota(_I32, (N, T), 0)
     st = st.replace(
         term=new_term,
         elapsed=jnp.where(timed_out, 0, elapsed),
-        role=jnp.where(timed_out, CANDIDATE, st.role),
-        voted_for=jnp.where(timed_out, me2, st.voted_for),
+        role=jnp.where(timed_out, jnp.where(pv, PRECANDIDATE, CANDIDATE), st.role),
+        voted_for=jnp.where(timed_out & ~pv, me2, st.voted_for),
         leader=jnp.where(timed_out, -1, st.leader),
         votes=jnp.where(timed_out[:, None, :], eyei, st.votes),
-        timeout=jnp.where(timed_out, cr._draw_timeout(st.seed, new_term, params),
+        timeout=jnp.where(timed_out, cr._draw_timeout(st.seed, st.term + 1, params),
                           st.timeout),
     )
-    just_cand = timed_out
+    just_cand = timed_out & ~pv
+    just_precand = timed_out & pv
 
-    # ---- 3. election tally ----
+    # ---- 3. election tally (pre-vote promotion first) ----
     member3 = member[None, :, :]                                  # i32 0/1
     nvotes = jnp.sum(st.votes * member3, axis=1)                  # (N, T)
     quorum = (jnp.sum(member, axis=0) // 2) + 1                   # (T,)
+    pre_elected = alive_b & (st.role == PRECANDIDATE) & (nvotes >= quorum[None, :])
+    st = st.replace(
+        role=jnp.where(pre_elected, CANDIDATE, st.role),
+        term=jnp.where(pre_elected, st.term + 1, st.term),
+        voted_for=jnp.where(pre_elected, me2, st.voted_for),
+        votes=jnp.where(pre_elected[:, None, :], eyei, st.votes),
+        elapsed=jnp.where(pre_elected, 0, st.elapsed),
+        timeout=jnp.where(pre_elected, cr._draw_timeout(st.seed, st.term + 1, params),
+                          st.timeout),
+    )
+    nvotes = jnp.sum(st.votes * member3, axis=1)
     elected = alive_b & (st.role == CANDIDATE) & (nvotes >= quorum[None, :])
     noop = ids.Bid(t=st.term, s=st.head.s + 1)
     head_after = ids.where(elected, noop, st.head)
@@ -304,16 +336,22 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
         hb_elapsed=jnp.where(is_leader,
                              jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
     )
-    bc_vr = (just_cand & alive_b & ~is_leader)[:, None, :] & is_peer
+    bc_vr = ((just_cand | pre_elected) & alive_b & ~is_leader)[:, None, :] & is_peer
+    bc_pvr = ((just_precand & alive_b & ~is_leader)[:, None, :] & is_peer
+              & ~bc_vr)
 
     commit3 = ids.Bid(t=jnp.broadcast_to(st.commit.t[:, None, :], (N, N, T)),
                       s=jnp.broadcast_to(st.commit.s[:, None, :], (N, N, T)))
     term3 = jnp.broadcast_to(st.term[:, None, :], (N, N, T))
-    kind = jnp.where(send_ae, MSG_APPEND, jnp.where(bc_vr, MSG_VOTE_REQ, reply.kind))
+    kind = jnp.where(send_ae, MSG_APPEND,
+                     jnp.where(bc_vr, MSG_VOTE_REQ,
+                               jnp.where(bc_pvr, MSG_PREVOTE_REQ, reply.kind)))
     out = Msgs(
         kind=jnp.where(alive_b[:, None, :], kind, MSG_NONE).astype(_I32),
-        term=jnp.where(send_ae | bc_vr, term3, reply.term),
-        x=ids.where(send_ae, st.nxt, ids.where(bc_vr, head3, reply.x)),
+        # PREVOTE_REQ carries the PROPOSED term (current + 1).
+        term=jnp.where(send_ae | bc_vr, term3,
+                       jnp.where(bc_pvr, term3 + 1, reply.term)),
+        x=ids.where(send_ae, st.nxt, ids.where(bc_vr | bc_pvr, head3, reply.x)),
         y=ids.where(send_ae, head3, reply.y),
         z=ids.where(send_ae, commit3, reply.z),
         ok=reply.ok,
@@ -406,7 +444,7 @@ def _run_window(params, member, state, inbox, proposals, *, ticks: int,
     inbox_io = [l.astype(_I32) for l in inbox_leaves]
 
     pk = jnp.stack([params.timeout_min, params.timeout_max, params.hb_ticks,
-                    params.auto_proposals]).reshape(1, _N_PARAMS)
+                    params.auto_proposals, params.prevote]).reshape(1, _N_PARAMS)
 
     def vspec(a):
         nd = a.ndim

@@ -111,6 +111,8 @@ class RaftEngine:
         snapshot_threshold: int | None = None,
         snapshot_interval_ticks: int | None = None,
         max_nodes: int | None = None,
+        backend: str = "jax",
+        max_append_entries: int | None = 64,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -140,6 +142,16 @@ class RaftEngine:
         # idled group (empty claim) elects nobody. Groups without an entry
         # default to full membership (bench / legacy behavior).
         self._group_claims: dict[int, frozenset[int]] = {}
+        # Step executor: the jitted vmapped device kernel, or the scalar
+        # Python reference engine (engine.backend = "python" — device-free
+        # debugging and the differential-testing seam, SURVEY.md §7 step 1).
+        if backend == "python":
+            from josefine_tpu.models.py_step import py_node_over_groups
+            self._step = py_node_over_groups
+        elif backend == "jax":
+            self._step = _node_over_groups
+        else:
+            raise ValueError(f"unknown engine backend {backend!r}")
         self.params = params or step_params()
         if int(self.params.auto_proposals) != 0:
             # The auto-proposal lane is a bench-only device feature; the
@@ -156,6 +168,11 @@ class RaftEngine:
         # or every snapshot_interval_ticks ticks if it made any progress.
         self.snapshot_threshold = snapshot_threshold
         self.snapshot_interval_ticks = snapshot_interval_ticks
+        # Replication flow control: at most this many blocks per AE frame
+        # (reference MAX_INFLIGHT=5 per replicate round, progress.rs:117;
+        # the reference's own max_append_entries knob is dead — quirk 9).
+        # None = unbounded (bench/simulated modes with no wire frames).
+        self.max_append_entries = max_append_entries
         self._ticks = 0
         self._last_snap_tick: dict[int, int] = {}
         self._snap_sent_tick: dict[tuple[int, int], int] = {}
@@ -252,7 +269,9 @@ class RaftEngine:
         if msg.kind == rpc.MSG_SNAPSHOT:
             self._install_snapshot(msg)
             return
-        if msg.kind not in (rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND, rpc.MSG_APPEND_RESP):
+        if msg.kind not in (rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND,
+                            rpc.MSG_APPEND_RESP, rpc.MSG_PREVOTE_REQ,
+                            rpc.MSG_PREVOTE_RESP):
             raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
         if not msg.span_is_valid():
             log.warning("dropping AE with invalid span g=%d src=%d", msg.group, msg.src)
@@ -293,7 +312,7 @@ class RaftEngine:
 
         old_head = {g: ch.head for g, ch in enumerate(self.chains)}
 
-        new_state, outbox, metrics = _node_over_groups(
+        new_state, outbox, metrics = self._step(
             self.params,
             self.member,
             jnp.asarray(self.me, _I32),
@@ -851,6 +870,7 @@ class RaftEngine:
         yt = h(outbox.y.t); ys = h(outbox.y.s)
         zt = h(outbox.z.t); zs = h(outbox.z.s)
         out: list[rpc.WireMsg] = []
+        nxt_fixups: list[tuple[int, int, int]] = []
         for g, dst in zip(*np.nonzero(kind)):
             g, dst = int(g), int(dst)
             m = rpc.WireMsg(
@@ -888,7 +908,28 @@ class RaftEngine:
                     log.warning("span (%#x, %#x] unavailable g=%d; heartbeat only", m.x, m.y, g)
                     m.y = m.x
                     m.z = min(m.z, m.x)
+                else:
+                    # Flow control: cap the frame at max_append_entries
+                    # blocks (a follower 1M blocks behind must catch up in
+                    # bounded frames, not one giant message). The device's
+                    # optimistic send pointer is re-rooted at the capped top
+                    # so the NEXT tick continues from there — a pipelined
+                    # chunked catch-up, no reject round-trips needed.
+                    cap = self.max_append_entries
+                    if cap is not None and len(m.blocks) > cap:
+                        m.blocks = m.blocks[:cap]
+                        m.y = m.blocks[-1].id
+                        m.z = min(m.z, m.y)
+                        nxt_fixups.append((g, dst, m.y))
             out.append(m)
+        if nxt_fixups:
+            nt = np.array(self.state.nxt.t)
+            ns = np.array(self.state.nxt.s)
+            for g, dst, top in nxt_fixups:
+                nt[g, dst] = id_term(top)
+                ns[g, dst] = id_seq(top)
+            self.state = self.state.replace(
+                nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))
         return out
 
     def _snapshot_msg(self, g: int, dst: int, ae: rpc.WireMsg) -> rpc.WireMsg | None:

@@ -18,9 +18,11 @@ This module makes membership a replicated, durable part of cluster state:
   a restarted node recovers the current cluster shape even if its TOML is
   stale.
 
-Caveat (documented, standard): a removed node that does not know it was
-removed can still disrupt elections with higher-term VoteRequests until it
-is shut down; pre-vote/check-quorum mitigation is future work.
+Disruption-proofing (round 2): messages from non-member slots are masked on
+device, and the kernel's pre-vote mode (``StepParams.prevote``, default on)
+means a node that cannot reach a quorum never bumps any term — so neither a
+removed node nor a long-partitioned member can disrupt a healthy group on
+rejoin (``tests/test_membership.py::test_partitioned_member_cannot_disrupt_on_rejoin``).
 """
 
 from __future__ import annotations

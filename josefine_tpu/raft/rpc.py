@@ -27,6 +27,8 @@ from josefine_tpu.models.types import (  # noqa: E402
     MSG_VOTE_RESP,
     MSG_APPEND,
     MSG_APPEND_RESP,
+    MSG_PREVOTE_REQ,
+    MSG_PREVOTE_RESP,
 )
 
 # Host-only kinds (never enter the device inbox).

@@ -48,6 +48,7 @@ class JosefineRaft:
         groups: int = 1,
         params: StepParams | None = None,
         shutdown: Shutdown | None = None,
+        backend: str = "jax",
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -70,6 +71,8 @@ class JosefineRaft:
                 1, config.snapshot_interval_s * 1000 // config.tick_ms
             ),
             max_nodes=config.max_nodes,
+            backend=backend,
+            max_append_entries=config.max_append_entries,
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

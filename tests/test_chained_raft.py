@@ -112,12 +112,70 @@ def test_vote_idempotent_regrant_same_candidate():
 # ------------------------------------------------------------ role machine
 
 def test_follower_times_out_to_candidate_and_broadcasts():
+    # Classic single-round elections (prevote=0): timeout bumps the term and
+    # broadcasts real VoteRequests at once.
     st = make_node(timeout=jnp.int32(1))
-    st2, out, _ = step(st)
+    st2, out, _ = step(st, prevote=0)
     assert int(st2.role) == CANDIDATE
     assert int(st2.term) == 1
     assert int(st2.voted_for) == 0
     np.testing.assert_array_equal(np.array(out.kind), [MSG_NONE, MSG_VOTE_REQ, MSG_VOTE_REQ])
+
+
+def test_follower_times_out_to_precandidate_under_prevote():
+    # Default mode: timeout starts a PRE-vote round — no term bump, no vote
+    # cast, PREVOTE_REQ broadcast carrying the PROPOSED term.
+    from josefine_tpu.models.types import MSG_PREVOTE_REQ, PRECANDIDATE
+
+    st = make_node(timeout=jnp.int32(1))
+    st2, out, _ = step(st)
+    assert int(st2.role) == PRECANDIDATE
+    assert int(st2.term) == 0
+    assert int(st2.voted_for) == -1
+    np.testing.assert_array_equal(np.array(out.kind),
+                                  [MSG_NONE, MSG_PREVOTE_REQ, MSG_PREVOTE_REQ])
+    assert int(out.term[1]) == 1  # proposed term, not adopted anywhere
+
+
+def test_prevote_quorum_promotes_to_real_candidacy():
+    from josefine_tpu.models.types import (MSG_PREVOTE_RESP, MSG_VOTE_REQ,
+                                           PRECANDIDATE)
+
+    st = make_node(role=jnp.int32(PRECANDIDATE),
+                   votes=jnp.array([True, False, False]))
+    inbox = msg_at(3, 1, MSG_PREVOTE_RESP, term=0, ok=1)
+    st2, out, _ = step(st, inbox)
+    assert int(st2.role) == CANDIDATE
+    assert int(st2.term) == 1          # term bumps only now
+    assert int(st2.voted_for) == 0
+    assert int(out.kind[1]) == MSG_VOTE_REQ and int(out.kind[2]) == MSG_VOTE_REQ
+
+
+def test_prevote_request_never_bumps_terms():
+    # The disruption-proofing: a (removed/partitioned) node proposing term
+    # 100 moves NO state on the receiver, which simply reports would-grant.
+    from josefine_tpu.models.types import MSG_PREVOTE_REQ, MSG_PREVOTE_RESP
+
+    st = make_node(term=jnp.int32(2))
+    inbox = msg_at(3, 1, MSG_PREVOTE_REQ, term=100, x=(2, 9))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.term) == 2
+    assert int(st2.voted_for) == -1
+    assert int(out.kind[1]) == MSG_PREVOTE_RESP and int(out.ok[1]) == 1
+
+
+def test_leased_follower_ignores_votes_and_prevotes():
+    # Leader-lease stickiness: a follower that heard from its leader within
+    # timeout_min refuses (pre-)votes and does NOT adopt the intruder term.
+    from josefine_tpu.models.types import MSG_PREVOTE_REQ
+
+    st = make_node(term=jnp.int32(2), leader=jnp.int32(2))  # fresh lease
+    inbox = msg_at(3, 1, MSG_VOTE_REQ, term=9, x=(9, 9))
+    st2, out, _ = step(st, inbox)
+    assert int(st2.term) == 2 and int(st2.voted_for) == -1
+    assert int(out.ok[1]) == 0
+    st3, out3, _ = step(st, msg_at(3, 1, MSG_PREVOTE_REQ, term=9, x=(9, 9)))
+    assert int(st3.term) == 2 and int(out3.ok[1]) == 0
 
 
 def test_candidate_elected_on_quorum():
@@ -146,11 +204,21 @@ def test_candidate_steps_down_on_current_term_append():
 
 
 def test_leader_steps_down_on_higher_term():
+    # Classic mode: any higher-term VoteRequest dethrones. In pre-vote mode
+    # the leader's own lease shields it — a bare VoteRequest (which a
+    # correct pre-vote peer would never send without a pre-quorum) is
+    # ignored; higher-term APPEND still dethrones in both modes.
     st = make_node(role=jnp.int32(LEADER), term=jnp.int32(2), leader=jnp.int32(0))
     inbox = msg_at(3, 1, MSG_VOTE_REQ, term=5, x=(2, 9))
-    st2, _, _ = step(st, inbox)
+    st2, _, _ = step(st, inbox, prevote=0)
     assert int(st2.role) == FOLLOWER
     assert int(st2.term) == 5
+
+    st3, _, _ = step(st, msg_at(3, 1, MSG_VOTE_REQ, term=5, x=(2, 9)))
+    assert int(st3.role) == LEADER and int(st3.term) == 2
+
+    st4, _, _ = step(st, msg_at(3, 1, MSG_APPEND, term=5, x=(2, 9), y=(2, 9)))
+    assert int(st4.role) == FOLLOWER and int(st4.term) == 5
 
 
 def test_no_term_regression_from_stale_leader():
