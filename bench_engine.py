@@ -1,0 +1,126 @@
+"""Host-bridge benchmark: RaftEngine.tick() throughput at P consensus groups.
+
+The headline bench (bench.py) drives the bare device kernel and deliberately
+bypasses the host bridge; this bench measures the bridge itself — the path
+the *product* runs: inbox packing, kernel dispatch, device→host mirroring,
+chain append/commit, outbox decode, in-process wire routing.
+
+Topology: one full 3-node cluster (three RaftEngine instances in-process,
+slot i = node i), P groups each spanning all 3 nodes, messages routed
+engine→engine every tick, and a live proposal lane submitting payloads to
+leader groups each tick.
+
+Reference anchor: the reference's event loop handles ONE group per process
+(``src/raft/server.rs:103-165``); its tick path is measured by BASELINE
+config 1-2. Here one host process drives P groups per tick.
+
+Usage: python bench_engine.py [--sizes 1000,10000,100000] [--ticks 200]
+Writes BENCH_engine.json and prints one JSON line per size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+_pre = argparse.ArgumentParser(add_help=False)
+_pre.add_argument("--platform", default=None, help="jax platform override (e.g. cpu)")
+_platform = _pre.parse_known_args()[0].platform
+if _platform:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+N = 3
+PROPOSALS_PER_TICK = 256  # distinct groups offered one payload each tick
+PAYLOAD = b"x" * 64
+
+
+async def bench_one(P: int, ticks: int, warmup: int) -> dict:
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+    t0 = time.perf_counter()
+    engines = [
+        RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params)
+        for i in range(N)
+    ]
+    init_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    proposed = committed = 0
+
+    def one_tick(live: bool):
+        nonlocal proposed, committed
+        outbound = []
+        for e in engines:
+            res = e.tick()
+            outbound.extend(res.outbound)
+            committed += len(res.committed)
+        for m in outbound:
+            engines[m.dst].receive(m)
+        if live:
+            groups = rng.integers(0, P, PROPOSALS_PER_TICK)
+            for g in set(int(g) for g in groups):
+                for e in engines:
+                    if e.is_leader(g):
+                        e.propose(g, PAYLOAD)
+                        proposed += 1
+                        break
+
+    for _ in range(warmup):
+        one_tick(live=False)
+    leaders = sum(int((e._h_role == 2).sum()) for e in engines)
+
+    proposed = committed = 0
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        one_tick(live=True)
+    dt = time.perf_counter() - t0
+
+    # Let in-flight commits drain so the commit count is meaningful.
+    for _ in range(20):
+        one_tick(live=False)
+
+    return {
+        "P": P,
+        "nodes": N,
+        "init_s": round(init_s, 2),
+        "leaders_after_warmup": leaders,
+        "ticks": ticks,
+        "ticks_per_sec": round(ticks / dt, 2),
+        "ms_per_tick": round(1000 * dt / ticks, 2),
+        "proposed": proposed,
+        "committed_group_advances": committed,
+        "proposals_per_sec": round(proposed / dt, 1),
+    }
+
+
+async def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--sizes", default="1000,10000,100000")
+    ap.add_argument("--ticks", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=40)
+    args = ap.parse_args()
+
+    results = []
+    for P in (int(s) for s in args.sizes.split(",")):
+        ticks = min(args.ticks, max(30, 3_000_000 // P))  # bound wall time at big P
+        r = await bench_one(P, ticks, args.warmup)
+        results.append(r)
+        print(json.dumps(r))
+
+    with open("BENCH_engine.json", "w") as f:
+        json.dump({"bench": "engine_host_bridge", "results": results}, f, indent=1)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -114,7 +114,8 @@ def make_sharded_cluster_step(mesh: Mesh, N: int):
         ok=P("p", "n", None),
     )
     params_spec = StepParams(
-        timeout_min=P(), timeout_max=P(), hb_ticks=P(), auto_proposals=P()
+        timeout_min=P(), timeout_max=P(), hb_ticks=P(), auto_proposals=P(),
+        prevote=P(),
     )
     met_specs = jax.tree.map(lambda _: pn, cr.Metrics(
         accepted_blocks=0, accepted_msgs=0, minted=0, commit_delta=0, became_leader=0))

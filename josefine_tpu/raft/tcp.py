@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 from typing import Callable
 
-from josefine_tpu.raft.rpc import WireMsg
+from josefine_tpu.raft.rpc import WireMsg, decode_frame
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.shutdown import Shutdown
 from josefine_tpu.utils.tracing import get_logger
@@ -138,7 +138,7 @@ class Transport:
             while not self.shutdown.is_shutdown:
                 body = await read_frame(reader)
                 try:
-                    msg = WireMsg.decode(body)
+                    msg = decode_frame(body)
                 except Exception:
                     log.warning("undecodable frame (%d bytes); closing conn", len(body))
                     break

@@ -36,7 +36,24 @@ class Counter:
     def get(self, **labels) -> float:
         return self.values.get(tuple(sorted(labels.items())), 0)
 
+    def bind(self, **labels) -> "BoundCounter":
+        """Pre-resolve the label key for hot paths (one dict op per inc
+        instead of kwargs + sort per call)."""
+        return BoundCounter(self, tuple(sorted(labels.items())))
+
     _TYPE = "counter"
+
+
+class BoundCounter:
+    __slots__ = ("_c", "_k")
+
+    def __init__(self, counter: "Counter", key: tuple):
+        self._c = counter
+        self._k = key
+
+    def inc(self, n: float = 1) -> None:
+        v = self._c.values
+        v[self._k] = v.get(self._k, 0) + n
 
 
 class Gauge(Counter):

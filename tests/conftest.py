@@ -21,6 +21,21 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 
+def expand_outbound(outbound):
+    """Flatten TickResult.outbound to per-message WireMsgs: columnar
+    MsgBatches expand via .messages(); WireMsgs pass through. Lets tests
+    inspect/fault-inject at single-message granularity."""
+    from josefine_tpu.raft import rpc
+
+    out = []
+    for m in outbound:
+        if isinstance(m, rpc.MsgBatch):
+            out.extend(m.messages())
+        else:
+            out.append(m)
+    return out
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: coroutine test (run via asyncio.run)")
 

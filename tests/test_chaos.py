@@ -21,6 +21,8 @@ import random
 
 import pytest
 
+from conftest import expand_outbound
+
 from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import RaftEngine
 from josefine_tpu.utils.kv import MemKV
@@ -126,7 +128,7 @@ class Chaos:
             if i in self.down:
                 continue
             res = e.tick()
-            for m in res.outbound:
+            for m in expand_outbound(res.outbound):
                 for _ in range(2 if self.rng.random() < 0.05 else 1):  # dup
                     r = self.rng.random()
                     if r < 0.10:

@@ -13,6 +13,7 @@ import pytest
 from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import NotLeader, RaftEngine
 from josefine_tpu.utils.kv import MemKV, SqliteKV
+from conftest import expand_outbound
 
 
 class ListFsm:
@@ -198,7 +199,8 @@ def test_vote_is_crash_atomic_single_record():
         e.receive(rpc.WireMsg(kind=rpc.MSG_VOTE_REQ, group=0, src=1, dst=0,
                               term=5, x=0))
         res = e.tick()
-        grants = [m for m in res.outbound if m.kind == rpc.MSG_VOTE_RESP]
+        grants = [m for m in expand_outbound(res.outbound)
+                  if m.kind == rpc.MSG_VOTE_RESP]
         assert grants and grants[0].ok == 1 and grants[0].dst == 1
         # The durable pair is one record; the old split keys must be gone.
         assert kv.get(b"g0:vol") is not None
@@ -212,7 +214,7 @@ def test_vote_is_crash_atomic_single_record():
         e2.receive(rpc.WireMsg(kind=rpc.MSG_VOTE_REQ, group=0, src=2, dst=0,
                                term=5, x=0))
         res2 = e2.tick()
-        resp = [m for m in res2.outbound
+        resp = [m for m in expand_outbound(res2.outbound)
                 if m.kind == rpc.MSG_VOTE_RESP and m.dst == 2]
         assert resp and resp[0].ok == 0
 
@@ -242,7 +244,7 @@ def test_catchup_is_chunked_by_max_append_entries():
                     if i in down:
                         continue
                     res = e.tick()
-                    for m in res.outbound:
+                    for m in expand_outbound(res.outbound):
                         if watch is not None and m.kind == rpc.MSG_APPEND:
                             watch.append(len(m.blocks))
                         if m.dst not in down:

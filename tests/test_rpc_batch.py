@@ -1,0 +1,256 @@
+"""Wire-format and intake tests for the columnar consensus batch
+(``rpc.MsgBatch``) — the binary per-peer-per-tick frame that replaced
+per-message JSON on the consensus hot path.
+
+Parity anchor: the reference sends one serde-JSON frame per message
+(``src/raft/tcp.rs:143-156``); the batch is the (9, P, N) device outbox's
+dst-column shipped whole. WireMsg JSON remains for host-only kinds
+(CLIENT_*/SNAPSHOT) and single-message intake."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.chain import Block, pack_id
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+from conftest import expand_outbound
+
+
+def _mk_batch(src=1, dst=0, entries=None, blocks=None):
+    entries = entries or []
+    n = len(entries)
+    cols = {k: [e[k] for e in entries]
+            for k in ("group", "kind", "term", "x", "y", "z", "ok")}
+    return rpc.MsgBatch(
+        src, dst,
+        np.asarray(cols["group"], np.intp),
+        np.asarray(cols["kind"], np.int32),
+        np.asarray(cols["term"], np.int64),
+        np.asarray(cols["x"], np.int64),
+        np.asarray(cols["y"], np.int64),
+        np.asarray(cols["z"], np.int64),
+        np.asarray(cols["ok"], np.int32),
+        blocks or {},
+    )
+
+
+def _e(group, kind, term=1, x=0, y=0, z=0, ok=0):
+    return dict(group=group, kind=kind, term=term, x=x, y=y, z=z, ok=ok)
+
+
+def test_batch_roundtrip_binary():
+    b1 = pack_id(1, 1)
+    b2 = pack_id(1, 2)
+    batch = _mk_batch(
+        src=2, dst=1,
+        entries=[
+            _e(0, rpc.MSG_APPEND, term=3, x=0, y=b2, z=b1),
+            _e(4, rpc.MSG_VOTE_REQ, term=7, x=b1),
+            _e(9, rpc.MSG_APPEND_RESP, term=3, x=b2, ok=1),
+        ],
+        blocks={0: [Block(id=b1, parent=0, data=b"alpha"),
+                    Block(id=b2, parent=b1, data=b"\x00\xffbin")]},
+    )
+    raw = batch.encode()
+    assert raw[0] == 0x01  # binary frame, not JSON
+    got = rpc.decode_frame(raw)
+    assert isinstance(got, rpc.MsgBatch)
+    assert got.src == 2 and got.dst == 1 and len(got) == 3
+    for a, b in zip(batch.messages(), got.messages()):
+        assert (a.kind, a.group, a.term, a.x, a.y, a.z, a.ok) == \
+               (b.kind, b.group, b.term, b.x, b.y, b.z, b.ok)
+        assert [(blk.id, blk.parent, blk.data) for blk in a.blocks] == \
+               [(blk.id, blk.parent, blk.data) for blk in b.blocks]
+
+
+def test_decode_frame_dispatches_json_wiremsg():
+    m = rpc.WireMsg(kind=rpc.MSG_VOTE_REQ, group=1, src=0, dst=2, term=9,
+                    x=pack_id(2, 5))
+    got = rpc.decode_frame(m.encode())
+    assert isinstance(got, rpc.WireMsg)
+    assert (got.kind, got.group, got.term, got.x) == (m.kind, m.group, m.term, m.x)
+
+
+def test_batch_take_slices_columns_and_spans():
+    b1 = pack_id(1, 1)
+    batch = _mk_batch(entries=[_e(0, rpc.MSG_APPEND, x=0, y=b1),
+                               _e(3, rpc.MSG_VOTE_REQ),
+                               _e(7, rpc.MSG_VOTE_RESP, ok=1)],
+                      blocks={0: [Block(id=b1, parent=0, data=b"d")]})
+    kept = batch.take(np.asarray([False, True, True]))
+    assert list(kept.group) == [3, 7]
+    assert kept.blocks == {}  # group 0's span went with its entry
+    assert len(list(kept.messages())) == 2
+
+
+def test_engine_drops_invalid_span_entry_only():
+    """A bad AE span kills that entry, not the whole batch (message-level
+    parity with WireMsg intake)."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=8,
+                       params=step_params(timeout_min=3, timeout_max=8))
+        b1 = pack_id(1, 1)
+        bogus = Block(id=pack_id(1, 9), parent=pack_id(1, 7), data=b"zz")
+        batch = _mk_batch(
+            src=1, dst=0,
+            entries=[_e(2, rpc.MSG_APPEND, x=0, y=b1),      # valid span
+                     _e(5, rpc.MSG_APPEND, x=0, y=b1)],     # broken span
+            blocks={2: [Block(id=b1, parent=0, data=b"ok")],
+                    5: [bogus]},
+        )
+        e.receive(batch)
+        assert len(e._pending_batches) == 1
+        kept = e._pending_batches[0]
+        assert list(kept.group) == [2]
+        assert 5 not in kept.blocks
+
+        # Out-of-range groups are dropped entry-wise too.
+        oob = _mk_batch(src=1, dst=0,
+                        entries=[_e(1, rpc.MSG_VOTE_REQ), _e(99, rpc.MSG_VOTE_REQ)])
+        e.receive(oob)
+        assert list(e._pending_batches[1].group) == [1]
+
+    asyncio.run(main())
+
+
+def test_forged_heartbeat_span_is_dropped():
+    """An AE entry with x == y (pure heartbeat) carrying blocks is the
+    poison-block vector: its forged blocks could shadow legitimately staged
+    ones in the head-reconcile walk. Must be dropped at intake, exactly as
+    WireMsg.span_is_valid does for single messages."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=8,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        forged = Block(id=pack_id(1, 1), parent=pack_id(9, 9), data=b"evil")
+        batch = _mk_batch(
+            src=1, dst=0,
+            entries=[_e(2, rpc.MSG_APPEND, x=pack_id(1, 1), y=pack_id(1, 1)),
+                     _e(4, rpc.MSG_VOTE_REQ, term=1)],
+            blocks={2: [forged]},
+        )
+        e.receive(batch)
+        kept = e._pending_batches[0]
+        assert list(kept.group) == [4]  # heartbeat-with-blocks entry dropped
+        assert not kept.blocks
+
+    asyncio.run(main())
+
+
+def test_non_consensus_kinds_rejected_from_batch():
+    """Batch entries must pass the same kind whitelist as receive():
+    SNAPSHOT/CLIENT_* are host-side messages and never enter the inbox."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=8,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        batch = _mk_batch(src=1, dst=0,
+                          entries=[_e(0, rpc.MSG_SNAPSHOT),
+                                   _e(1, rpc.MSG_CLIENT_REQ),
+                                   _e(2, rpc.MSG_VOTE_REQ, term=1)])
+        e.receive(batch)
+        kept = e._pending_batches[0]
+        assert list(kept.group) == [2]
+
+    asyncio.run(main())
+
+
+def test_json_frame_claiming_batch_kind_raises_valueerror():
+    """A JSON WireMsg with kind=MSG_BATCH must hit the consensus-kind
+    whitelist (ValueError, handled by the transport), not be duck-typed into
+    the batch path (TypeError escaping the connection task)."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=4,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        liar = rpc.WireMsg(kind=rpc.MSG_BATCH, group=0, src=1, dst=0)
+        with pytest.raises(ValueError, match="not a consensus message"):
+            e.receive(liar)
+
+    asyncio.run(main())
+
+
+def test_batch_slot_conflict_carries_over():
+    """Two batches from the same src in one tick: second one's conflicting
+    entries defer to the next tick (bounded per-(group,src) inbox slots)."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=4,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        first = _mk_batch(src=1, dst=0, entries=[_e(0, rpc.MSG_VOTE_REQ, term=5)])
+        second = _mk_batch(src=1, dst=0,
+                           entries=[_e(0, rpc.MSG_VOTE_REQ, term=6),
+                                    _e(1, rpc.MSG_VOTE_REQ, term=6)])
+        e.receive(first)
+        e.receive(second)
+        e.tick()
+        # Entry (g=0) of the second batch deferred; g=1 went through.
+        assert len(e._pending_batches) == 1
+        assert list(e._pending_batches[0].group) == [0]
+        assert int(e._pending_batches[0].term[0]) == 6
+        e.tick()
+        assert not e._pending_batches
+
+    asyncio.run(main())
+
+
+def test_sorted_normalization_of_foreign_batches():
+    """A frame with unsorted/duplicate groups (not producible by our encoder
+    but legal on the wire) is normalized at intake."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=8,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        b = _mk_batch(src=1, dst=0,
+                      entries=[_e(5, rpc.MSG_VOTE_REQ, term=2),
+                               _e(1, rpc.MSG_VOTE_REQ, term=2),
+                               _e(5, rpc.MSG_VOTE_REQ, term=3)])
+        e.receive(b)
+        kept = e._pending_batches[0]
+        assert list(kept.group) == [1, 5]
+        assert int(kept.term[np.searchsorted(kept.group, 5)]) == 2  # first wins
+
+    asyncio.run(main())
+
+
+def test_cluster_converges_over_batch_frames():
+    """End-to-end: 3 engines exchanging ONLY encoded batch frames (bytes on
+    the wire) elect and commit across multiple groups."""
+
+    async def main():
+        P = 4
+        engines = [
+            RaftEngine(MemKV(), [1, 2, 3], nid, groups=P,
+                       params=step_params(timeout_min=3, timeout_max=8),
+                       base_seed=i)
+            for i, nid in enumerate([1, 2, 3])
+        ]
+        futs = []
+        for t in range(80):
+            wires = []
+            for e in engines:
+                for m in e.tick().outbound:
+                    wires.append(m.encode())  # force the wire path
+            for raw in wires:
+                m = rpc.decode_frame(raw)
+                engines[m.dst].receive(m)
+            if t == 40:
+                for g in range(P):
+                    for e in engines:
+                        if e.is_leader(g):
+                            futs.append(e.propose(g, b"payload-%d" % g))
+        assert len(futs) == P
+        for f in futs:
+            assert f.done() and not f.exception()
+        heads = [[e.chains[g].head for g in range(P)] for e in engines]
+        assert heads[0] == heads[1] == heads[2]
+
+    asyncio.run(main())

@@ -1,0 +1,205 @@
+"""CI coverage for the shard_map'd cluster step (``parallel/sharded.py``).
+
+The driver's ``dryrun_multichip`` proves the sharded path compiles and
+converges; these tests go further and prove it is *bit-identical* to the
+unsharded reference step on an 8-virtual-device CPU mesh, across every mesh
+factorization of 8 — including node-axis sharding where per-tick message
+delivery rides ``lax.all_to_all``.
+
+Parity anchor: the reference has no device mesh at all (its transport is
+full-mesh TCP, ``src/raft/tcp.rs``); the equivalence target here is our own
+single-device ``cluster_step``, which the differential suite
+(``tests/test_differential.py``) in turn checks against the host Python
+engine. Together: host python == single-device XLA == sharded multi-device.
+
+The pod-sim toward BASELINE config 5 (1M partitions, 64-device mesh) runs in
+a subprocess (JAX device count is fixed at first init) and is marked
+``slow`` — enable with ``RUN_SLOW=1``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import LEADER, step_params
+from josefine_tpu.parallel import make_mesh, make_sharded_cluster_step, place
+
+slow = pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW"), reason="pod-sim; set RUN_SLOW=1"
+)
+
+
+def _snap(tree):
+    """Host-side numpy copy of a pytree (donation-safe snapshot)."""
+    return jax.tree.map(np.asarray, tree)
+
+
+def _assert_tree_equal(a, b, msg):
+    la = jax.tree.leaves(a)
+    lb = jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=msg)
+
+
+def _run_unsharded(P, N, params, ticks):
+    """Tick-by-tick trajectory of the single-device step, as numpy."""
+    state, member = cr.init_state(P, N, base_seed=7, params=params)
+    inbox = cr.empty_inbox(P, N)
+    proposals = jnp.zeros((P, N), jnp.int32)
+    step = jax.jit(cr.cluster_step_impl)  # no donation: we snapshot each tick
+    traj = []
+    for _ in range(ticks):
+        state, inbox, met = step(params, member, state, inbox, proposals)
+        traj.append((_snap(state), _snap(inbox), _snap(met)))
+    return traj
+
+
+def _run_sharded(P, N, params, ticks, p_shards, n_shards):
+    mesh = make_mesh(p_shards, n_shards)
+    state, member = cr.init_state(P, N, base_seed=7, params=params)
+    inbox = cr.empty_inbox(P, N)
+    proposals = jnp.zeros((P, N), jnp.int32)
+    step = make_sharded_cluster_step(mesh, N)
+    state = place(state, mesh)
+    inbox = place(inbox, mesh)
+    member = jax.device_put(member, NamedSharding(mesh, PS("p", None)))
+    proposals = jax.device_put(proposals, NamedSharding(mesh, PS("p", "n")))
+    traj = []
+    for _ in range(ticks):
+        state, inbox, met = step(params, member, state, inbox, proposals)
+        traj.append((_snap(state), _snap(inbox), _snap(met)))
+    return traj
+
+
+@pytest.mark.parametrize(
+    "p_shards,n_shards,N",
+    [
+        (8, 1, 3),  # pure partition data-parallelism
+        (4, 2, 4),  # groups split 2-way across chips (all_to_all delivery)
+        (2, 4, 4),  # one node per chip within each p-shard
+        # (1, 8, 8) — fully node-sharded — is excluded: XLA's CPU backend
+        # wedges compiling/running an 8-party all_to_all on 8 virtual
+        # devices (hangs >5 min; (2,4) and (4,2) compile in seconds). The
+        # cross-chip delivery path is fully covered by the 2- and 4-way
+        # node shardings above.
+    ],
+)
+def test_sharded_equals_unsharded(p_shards, n_shards, N):
+    """Sharded step == unsharded step, exactly, every tick, every leaf.
+
+    Covers state, the delivered inbox (i.e. the all_to_all transport), and
+    per-node metrics over enough ticks for elections + commits to happen.
+    """
+    P = 2 * p_shards
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=2)
+    ticks = 40
+    ref = _run_unsharded(P, N, params, ticks)
+    got = _run_sharded(P, N, params, ticks, p_shards, n_shards)
+    for t, ((rs, ri, rm), (gs, gi, gm)) in enumerate(zip(ref, got)):
+        _assert_tree_equal(rs, gs, f"state diverged at tick {t}")
+        _assert_tree_equal(ri, gi, f"delivered inbox diverged at tick {t}")
+        _assert_tree_equal(rm, gm, f"metrics diverged at tick {t}")
+    # The trajectory actually did something (not vacuous equality).
+    roles = ref[-1][0].role
+    assert ((roles == LEADER).sum(axis=1) == 1).all(), "no leaders elected"
+    assert ref[-1][0].commit.s.max() > 0, "nothing committed"
+
+
+def test_sharded_live_proposals_equal():
+    """Same equivalence under an active proposal load lane (every node offers
+    proposals each tick; only leaders mint)."""
+    P, N, p_shards, n_shards = 8, 4, 4, 2
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=0)
+
+    def run(sharded: bool):
+        state, member = cr.init_state(P, N, base_seed=11, params=params)
+        inbox = cr.empty_inbox(P, N)
+        proposals = jnp.ones((P, N), jnp.int32) * 3
+        if sharded:
+            mesh = make_mesh(p_shards, n_shards)
+            step = make_sharded_cluster_step(mesh, N)
+            state = place(state, mesh)
+            inbox = place(inbox, mesh)
+            member = jax.device_put(member, NamedSharding(mesh, PS("p", None)))
+            proposals = jax.device_put(
+                proposals, NamedSharding(mesh, PS("p", "n")))
+        else:
+            step = jax.jit(cr.cluster_step_impl)
+        traj = []
+        for _ in range(30):
+            state, inbox, met = step(params, member, state, inbox, proposals)
+            traj.append((_snap(state), _snap(met)))
+        return traj
+
+    ref, got = run(False), run(True)
+    for t, ((rs, rm), (gs, gm)) in enumerate(zip(ref, got)):
+        _assert_tree_equal(rs, gs, f"state diverged at tick {t}")
+        _assert_tree_equal(rm, gm, f"metrics diverged at tick {t}")
+    assert sum(int(m.minted.sum()) for _, m in ref) > 0, "no blocks minted"
+
+
+_PODSIM = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=64")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import LEADER, step_params
+from josefine_tpu.parallel import make_mesh, make_sharded_cluster_step, place
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+P, N = 1_048_576, 3   # BASELINE config 5 scale: >=1M consensus groups
+mesh = make_mesh(64, 1)
+params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=1)
+state, member = cr.init_state(P, N, base_seed=3, params=params)
+inbox = cr.empty_inbox(P, N)
+proposals = jnp.zeros((P, N), jnp.int32)
+step = make_sharded_cluster_step(mesh, N)
+state = place(state, mesh)
+inbox = place(inbox, mesh)
+member = jax.device_put(member, NamedSharding(mesh, PS("p", None)))
+proposals = jax.device_put(proposals, NamedSharding(mesh, PS("p", "n")))
+t0 = time.time()
+for _ in range(24):
+    state, inbox, met = step(params, member, state, inbox, proposals)
+jax.block_until_ready(state.commit.s)
+dt = time.time() - t0
+roles = np.asarray(state.role)
+elected = int(((roles == LEADER).sum(axis=1) == 1).sum())
+committed = int((np.asarray(state.commit.s).max(axis=1) > 0).sum())
+assert elected == P, f"only {elected}/{P} groups elected a leader"
+assert committed == P, f"only {committed}/{P} groups committed"
+print(f"podsim OK: P={P} N={N} mesh=64x1 24 ticks in {dt:.1f}s "
+      f"({24*P/dt:,.0f} group-ticks/s)")
+"""
+
+
+@slow
+def test_podsim_1m_partitions_64dev():
+    """BASELINE config 5 pod-sim: 1M partitions on a forced 64-virtual-device
+    host mesh. Runs in a subprocess (JAX device count is fixed per process)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _PODSIM],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert r.returncode == 0, f"podsim failed:\n{r.stdout}\n{r.stderr}"
+    assert "podsim OK" in r.stdout
