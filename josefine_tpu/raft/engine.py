@@ -63,11 +63,13 @@ _m_led = REGISTRY.gauge("raft_groups_led", "Groups this node currently leads")
 
 _I32 = jnp.int32
 
-# Kinds allowed into the device inbox (see RaftEngine.receive's whitelist).
-_CONSENSUS_KINDS = np.asarray([
+# Kinds allowed into the device inbox — single source of truth for both the
+# single-message whitelist (receive) and the batch intake (_receive_batch).
+_CONSENSUS_KIND_SET = frozenset((
     rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND, rpc.MSG_APPEND_RESP,
     rpc.MSG_PREVOTE_REQ, rpc.MSG_PREVOTE_RESP,
-], np.int32)
+))
+_CONSENSUS_KINDS = np.asarray(sorted(_CONSENSUS_KIND_SET), np.int32)
 
 
 class NotLeader(Exception):
@@ -341,9 +343,7 @@ class RaftEngine:
         if msg.kind == rpc.MSG_SNAPSHOT:
             self._install_snapshot(msg)
             return
-        if msg.kind not in (rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP, rpc.MSG_APPEND,
-                            rpc.MSG_APPEND_RESP, rpc.MSG_PREVOTE_REQ,
-                            rpc.MSG_PREVOTE_RESP):
+        if msg.kind not in _CONSENSUS_KIND_SET:
             raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
         if not msg.span_is_valid():
             log.warning("dropping AE with invalid span g=%d src=%d", msg.group, msg.src)
@@ -609,7 +609,10 @@ class RaftEngine:
         if res.became_leader:
             _m_elections.inc(len(res.became_leader), node=self.self_id)
         if res.outbound:
-            _m_out.inc(len(res.outbound), node=self.self_id)
+            # Count per-entry messages (a MsgBatch is many), keeping the
+            # out/in counters symmetric with _receive_batch's inc(len(b)).
+            _m_out.inc(sum(len(m) if isinstance(m, rpc.MsgBatch) else 1
+                           for m in res.outbound), node=self.self_id)
         _m_led.set(int((self._h_role == LEADER).sum()), node=self.self_id)
         return res
 

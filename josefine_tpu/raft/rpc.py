@@ -203,9 +203,19 @@ class MsgBatch:
             for _ in range(nb):
                 bid, parent, ln = _BLOCK_HDR.unpack_from(raw, o)
                 o += _BLOCK_HDR.size
+                if o + ln > len(raw):
+                    # A short slice would yield a block whose ids still pass
+                    # span validation but whose payload is silently cut —
+                    # replica divergence. Fail loudly like the JSON path.
+                    raise ValueError(
+                        f"truncated block payload in batch frame "
+                        f"(need {ln} bytes at {o}, have {len(raw) - o})")
                 lst.append(Block(id=bid, parent=parent, data=raw[o:o + ln]))
                 o += ln
             blocks[g] = lst
+        if o != len(raw):
+            raise ValueError(
+                f"batch frame has {len(raw) - o} trailing bytes")
         return cls(src, dst, group, kind_col, term, x, y, z, ok, blocks)
 
     def take(self, mask: np.ndarray) -> "MsgBatch":

@@ -70,6 +70,24 @@ def test_batch_roundtrip_binary():
                [(blk.id, blk.parent, blk.data) for blk in b.blocks]
 
 
+def test_truncated_batch_frame_fails_loudly():
+    """A frame cut mid-payload must raise, not yield a block whose ids pass
+    span validation with silently-truncated data (replica divergence)."""
+    b1 = pack_id(1, 1)
+    batch = _mk_batch(src=1, dst=0,
+                      entries=[_e(0, rpc.MSG_APPEND, x=0, y=b1)],
+                      blocks={0: [Block(id=b1, parent=0, data=b"hello world")]})
+    raw = batch.encode()
+    with pytest.raises(ValueError, match="truncated"):
+        rpc.MsgBatch.decode(raw[:-5])
+    with pytest.raises(ValueError, match="trailing"):
+        rpc.MsgBatch.decode(raw + b"\x00\x00")
+    # struct header truncation raises too (struct.error is fine to surface
+    # through decode_frame's try/except at the transport).
+    with pytest.raises(Exception):
+        rpc.MsgBatch.decode(raw[:10])
+
+
 def test_decode_frame_dispatches_json_wiremsg():
     m = rpc.WireMsg(kind=rpc.MSG_VOTE_REQ, group=1, src=0, dst=2, term=9,
                     x=pack_id(2, 5))
