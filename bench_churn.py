@@ -125,6 +125,10 @@ def main():
         },
     }
     print(json.dumps(out))
+    # Round artifact (VERDICT r1 #10: the driver only captures bench.py's
+    # stdout; the churn numbers must survive as a file).
+    with open("BENCH_churn.json", "w") as f:
+        json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":

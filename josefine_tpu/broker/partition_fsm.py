@@ -38,10 +38,14 @@ log = get_logger("broker.partition_fsm")
 class PartitionFsm:
     """Applies committed record batches of one consensus group to a Log."""
 
-    def __init__(self, kv: KV, group: int, plog: Log):
+    def __init__(self, kv: KV, group: int, plog: Log, on_append=None):
         self.kv = kv
         self.group = group
         self.log = plog
+        # Fired after each applied batch: the broker's fetch long-poll
+        # wakeup (consumers blocked in Fetch re-check instead of sleeping
+        # out their max_wait_ms).
+        self.on_append = on_append
         self._key = b"pfsm:%d" % group
         raw = kv.get(self._key)
         self._applied = 0
@@ -79,6 +83,8 @@ class PartitionFsm:
         self._applied = blk.id
         self.kv.put(self._key,
                     struct.pack(">QQ", blk.id, self.log.next_offset()))
+        if self.on_append is not None:
+            self.on_append()
         return struct.pack(">q", base)
 
     def close(self) -> None:

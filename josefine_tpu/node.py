@@ -79,9 +79,13 @@ class Node:
         if config.broker.metrics_port:
             from josefine_tpu.utils.metrics import MetricsServer
 
+            # Scope by the RAFT id: every node-labelled metric series
+            # (engine/tcp) is labelled with engine.self_id == raft.id;
+            # broker.id may legally differ at partitions=1.
             self.metrics_server = MetricsServer(
                 config.broker.ip, config.broker.metrics_port,
                 state_fn=lambda: self.raft.engine.debug_state(),
+                node=config.raft.id,
             )
 
     def _rewire_partitions(self) -> None:
@@ -104,7 +108,9 @@ class Node:
         eng.configure_groups(claims)
         for p in hosted:
             rep = self.broker.broker.replicas.ensure(p)
-            eng.register_fsm(p.group, PartitionFsm(self.kv, p.group, rep.log))
+            eng.register_fsm(p.group, PartitionFsm(
+                self.kv, p.group, rep.log,
+                on_append=self.broker.broker.signal_append))
 
     def _wire_partition(self, p) -> None:
         """Commit-time hook: an EnsurePartition with a group claim applied.
@@ -118,7 +124,9 @@ class Node:
         if self.config.broker.id in p.assigned_replicas:
             rep = self.broker.broker.replicas.ensure(p)
             if p.group not in eng.drivers:
-                eng.register_fsm(p.group, PartitionFsm(self.kv, p.group, rep.log))
+                eng.register_fsm(p.group, PartitionFsm(
+                    self.kv, p.group, rep.log,
+                    on_append=self.broker.broker.signal_append))
 
     def _release_partition(self, p) -> None:
         """Commit-time hook: the partition's topic was deleted — idle the

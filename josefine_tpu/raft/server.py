@@ -36,6 +36,13 @@ class ProposalTimeout(Exception):
     pass
 
 
+# Forwarded-request dedup window: must cover a client's retry loop (propose
+# timeout default 5 s + forward round trips), after which a req_id is
+# forgotten and a re-forward is served fresh.
+SERVED_TTL_S = 30.0
+SERVED_SOFT_CAP = 4096
+
+
 class JosefineRaft:
     """One node's Raft runtime (reference ``JosefineRaft::new + run``,
     ``src/raft/mod.rs:78-133``)."""
@@ -91,10 +98,14 @@ class JosefineRaft:
         )
         self._inbound_client: list[rpc.WireMsg] = []
         self._forwarded: dict[str, asyncio.Future] = {}
-        # Leader-side dedup of forwarded requests: req_id -> in-flight future
-        # or cached result, so a follower's re-forward of the same request
-        # (after a response was lost/slow) does not mint a second block.
-        self._served: dict[str, asyncio.Future] = {}
+        # Leader-side dedup of forwarded requests: req_id -> (future, born),
+        # so a follower's re-forward of the same request (after a response
+        # was lost/slow) does not mint a second block. Entries age out after
+        # SERVED_TTL_S (the client retry window) — a cached result can never
+        # answer a re-forward from a later leadership era — and the map is
+        # hard-bounded (oldest evicted first) so slow proposals cannot grow
+        # it without limit.
+        self._served: dict[str, tuple[asyncio.Future, float]] = {}
         self._bg_tasks: set[asyncio.Task] = set()
         self._tick_task: asyncio.Task | None = None
         self.bound_addr: tuple[str, int] | None = None
@@ -224,19 +235,40 @@ class JosefineRaft:
         else:
             self.engine.receive(msg)
 
+    def _evict_served(self, now: float) -> None:
+        """Bound the dedup map: age out expired/failed entries; if a burst
+        of live in-flight entries still exceeds the cap, drop oldest first
+        (losing dedup for them, never correctness — a re-forward would just
+        propose again)."""
+        if len(self._served) <= SERVED_SOFT_CAP:
+            return
+        for k, (f, born) in list(self._served.items()):
+            if (now - born > SERVED_TTL_S
+                    or (f.done() and (f.cancelled() or f.exception()))):
+                del self._served[k]
+        excess = len(self._served) - SERVED_SOFT_CAP
+        if excess > 0:
+            oldest = sorted(self._served.items(), key=lambda kv: kv[1][1])
+            for k, _ in oldest[:excess]:
+                del self._served[k]
+
     async def _serve_forwarded(self, msg: rpc.WireMsg) -> None:
         """Leader side of the proxy: mint, await commit, answer the origin.
         Dedups on req_id so a re-forwarded request shares the original block
         instead of minting a new one."""
         try:
-            fut = self._served.get(msg.req_id)
-            if fut is None or (fut.done() and (fut.cancelled() or fut.exception())):
+            now = asyncio.get_running_loop().time()
+            ent = self._served.get(msg.req_id)
+            fut = None
+            if ent is not None:
+                fut, born = ent
+                if (now - born > SERVED_TTL_S
+                        or (fut.done() and (fut.cancelled() or fut.exception()))):
+                    fut = None  # expired or failed: serve fresh
+            if fut is None:
                 fut = self.engine.propose(msg.group, msg.payload)
-                self._served[msg.req_id] = fut
-                if len(self._served) > 4096:  # bounded dedup memory
-                    for k in list(self._served)[:2048]:
-                        if self._served[k].done():
-                            del self._served[k]
+                self._served[msg.req_id] = (fut, now)
+                self._evict_served(now)
             result = await asyncio.wait_for(asyncio.shield(fut), 5.0)
             ok, payload = 1, result
         except Exception:

@@ -102,7 +102,19 @@ class Registry:
             raise ValueError(f"{name} is not a gauge")
         return m
 
-    def dump(self) -> dict:
+    @staticmethod
+    def _visible(key: tuple, node) -> bool:
+        """Series visibility under a node scope: unlabelled series and
+        series without a ``node`` label are shared; node-labelled series
+        belong to that node's endpoint only."""
+        if node is None:
+            return True
+        for k, v in key:
+            if k == "node":
+                return v == node
+        return True
+
+    def dump(self, node=None) -> dict:
         out = {}
         for name, m in sorted(self._metrics.items()):
             if isinstance(m, Gauge) and m._fn is not None:
@@ -113,10 +125,16 @@ class Registry:
                 out[name] = {
                     ",".join(f"{k}={v}" for k, v in key): val
                     for key, val in sorted(m.values.items())
+                    if self._visible(key, node)
                 }
         return out
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, node=None) -> str:
+        """Prometheus text exposition, optionally scoped to one node's
+        series. The registry is process-global (metric objects are
+        module-level), so a process hosting several Nodes — the multi-node
+        example does — must filter each endpoint to its own node label or
+        every /metrics answer reports every node's series."""
         lines = []
         for name, m in sorted(self._metrics.items()):
             if m.help:
@@ -125,15 +143,18 @@ class Registry:
             if isinstance(m, Gauge) and m._fn is not None:
                 lines.append(f"{name} {m.get()}")
                 continue
-            if not m.values:
-                lines.append(f"{name} 0")
-                continue
+            emitted = False
             for key, val in sorted(m.values.items()):
+                if not self._visible(key, node):
+                    continue
+                emitted = True
                 if key:
                     lbl = ",".join(f'{k}="{v}"' for k, v in key)
                     lines.append(f"{name}{{{lbl}}} {val}")
                 else:
                     lines.append(f"{name} {val}")
+            if not emitted:
+                lines.append(f"{name} 0")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
@@ -153,11 +174,15 @@ class MetricsServer:
 
     def __init__(self, host: str, port: int,
                  state_fn: Callable[[], dict] | None = None,
-                 registry: Registry | None = None):
+                 registry: Registry | None = None,
+                 node: int | None = None):
         self.host = host
         self.port = port
         self.state_fn = state_fn
         self.registry = registry or REGISTRY
+        # Scope the exposition to this node's series (multi-node-per-process
+        # deployments share the module-global registry).
+        self.node = node
         self._server: asyncio.AbstractServer | None = None
         self.bound_port: int | None = None
 
@@ -182,7 +207,7 @@ class MetricsServer:
                 if line in (b"\r\n", b"\n", b""):
                     break
             if path == "/metrics":
-                body = self.registry.render_prometheus().encode()
+                body = self.registry.render_prometheus(node=self.node).encode()
                 ctype = "text/plain; version=0.0.4"
                 status = "200 OK"
             elif path == "/state":

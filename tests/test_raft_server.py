@@ -143,3 +143,39 @@ def test_single_node_over_socket():
             await nodes[0].stop()
 
     asyncio.run(main())
+
+
+def test_served_dedup_cache_ttl_and_bound():
+    """VERDICT r1 weak 5: the forwarded-request dedup map is bounded by
+    time and size — expired/failed entries age out on lookup-path eviction,
+    and a burst of live in-flight futures cannot grow it unboundedly."""
+    import types
+
+    from josefine_tpu.raft import server as rs
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        ns = types.SimpleNamespace(_served={})
+        now = loop.time()
+
+        # Overfill with live (not-done) futures: oldest dropped to the cap.
+        for i in range(rs.SERVED_SOFT_CAP + 100):
+            ns._served[f"r{i}"] = (loop.create_future(), now + i * 1e-6)
+        JosefineRaft._evict_served(ns, now)
+        assert len(ns._served) == rs.SERVED_SOFT_CAP
+        assert "r0" not in ns._served          # oldest went first
+        assert f"r{rs.SERVED_SOFT_CAP + 99}" in ns._served
+
+        # Expired and failed entries are evicted outright when over cap.
+        ns._served.clear()
+        old = now - rs.SERVED_TTL_S - 1
+        for i in range(rs.SERVED_SOFT_CAP + 1):
+            ns._served[f"x{i}"] = (loop.create_future(), old)
+        bad = loop.create_future()
+        bad.set_exception(RuntimeError("boom"))
+        bad.exception()  # consume so the loop doesn't warn
+        ns._served["failed"] = (bad, now)
+        JosefineRaft._evict_served(ns, now)
+        assert not ns._served
+
+    asyncio.run(main())
