@@ -1,0 +1,100 @@
+"""Storage-engine benchmark: the native segmented partition log.
+
+Measures the C++ seglog (mmap index, binary-search lookup — the TPU build's
+equivalent of the reference's ``src/broker/log/`` Rust engine, which
+linear-scans its index and publishes no numbers): sequential append
+throughput, sequential read-back, and random offset lookups.
+
+Usage: python bench_log.py [--records 200000] [--batch 64] [--size 512]
+Writes BENCH_log.json and prints one JSON line per phase.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import shutil
+import tempfile
+import time
+
+from josefine_tpu.broker.log import Log
+from josefine_tpu.broker.records import build_batch, set_base_offset
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--records", type=int, default=200_000)
+    ap.add_argument("--batch", type=int, default=64, help="records per batch")
+    ap.add_argument("--size", type=int, default=512, help="payload bytes per record")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="benchlog-")
+    results = []
+    try:
+        log = Log(tmp)
+        # One v2 batch claiming `--batch` offsets with `--size` bytes per
+        # record of body (the builder wraps one opaque blob).
+        payload = b"x" * (args.size * args.batch)
+        batch = build_batch(payload, args.batch)
+        n_batches = args.records // args.batch
+        batch_bytes = len(batch)
+
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            base = log.next_offset()
+            log.append(set_base_offset(batch, base), count=args.batch)
+        log.flush()
+        dt = time.perf_counter() - t0
+        total_records = n_batches * args.batch
+        total_mb = n_batches * batch_bytes / 1e6
+        results.append({
+            "phase": "append",
+            "records_per_sec": round(total_records / dt),
+            "mb_per_sec": round(total_mb / dt, 1),
+            "batches": n_batches,
+            "records": total_records,
+            "wall_s": round(dt, 3),
+        })
+
+        t0 = time.perf_counter()
+        off, read_bytes = 0, 0
+        while off < total_records:
+            blobs = log.read_from(off, 1 << 20)
+            if not blobs:
+                break
+            for base, count, data in blobs:
+                read_bytes += len(data)
+                off = base + count
+        dt = time.perf_counter() - t0
+        results.append({
+            "phase": "sequential_read",
+            "records_per_sec": round(total_records / dt),
+            "mb_per_sec": round(read_bytes / 1e6 / dt, 1),
+            "wall_s": round(dt, 3),
+        })
+
+        rng = random.Random(0)
+        lookups = 20_000
+        t0 = time.perf_counter()
+        for _ in range(lookups):
+            log.read(rng.randrange(total_records))
+        dt = time.perf_counter() - t0
+        results.append({
+            "phase": "random_lookup",
+            "lookups_per_sec": round(lookups / dt),
+            "wall_s": round(dt, 3),
+        })
+
+        log.close()
+        for r in results:
+            print(json.dumps(r))
+        with open("BENCH_log.json", "w") as f:
+            json.dump({"bench": "seglog", "config": vars(args),
+                       "results": results}, f, indent=1)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -212,14 +212,21 @@ class Broker:
                     "name": name, "is_internal": False, "partitions": [],
                 })
                 continue
+            store_parts = self.store.get_partitions(name)
+            # Live ISR for all group-backed partitions we lead, in ONE
+            # engine fetch for the whole request (per-partition calls would
+            # cost two device transfers each).
+            isr_map = self.client.in_sync_ids_map(
+                [g for g in (self._live_group(p) for p in store_parts)
+                 if g is not None])
             parts = []
-            for p in self.store.get_partitions(name):
+            for p in store_parts:
                 parts.append({
                     "error_code": ErrorCode.NONE,
                     "partition_index": p.idx,
                     "leader_id": self._partition_leader(p),
                     "replica_nodes": p.assigned_replicas,
-                    "isr_nodes": p.isr,
+                    "isr_nodes": self._partition_isr(p, isr_map),
                     "offline_replicas": [],
                 })
             out_topics.append({
@@ -415,6 +422,17 @@ class Broker:
         if g is not None:
             return bool(self.client.is_leader(g))
         return p.leader == self.config.id
+
+    def _partition_isr(self, p: Partition, isr_map: dict[int, list[int]]) -> list[int]:
+        """Live ISR when this broker leads the partition's consensus group
+        (derived from Raft match pointers + ack liveness — replicas actually
+        keeping up); the stored creation-time ISR otherwise (the reference's
+        only view: written once, never maintained, ``src/broker/state.rs``).
+        ``isr_map`` is the request-scoped bulk fetch."""
+        g = self._live_group(p)
+        if g is not None and g in isr_map:
+            return [b for b in isr_map[g] if b in p.assigned_replicas]
+        return p.isr
 
     async def produce(self, version: int, body: dict) -> dict | None:
         """Append record batches with offset assignment (reference

@@ -35,3 +35,14 @@ class RaftClient:
     def leader_id(self, group: int = 0) -> int | None:
         """Node id currently leading ``group`` (None = unknown/electing)."""
         return self._server.engine.leader_id(group)
+
+    def in_sync_ids(self, group: int = 0) -> list[int] | None:
+        """Node ids currently in sync with the group leader's log (live ISR
+        from Raft match pointers); None if this node is not the leader."""
+        return self._server.engine.in_sync_ids(group)
+
+    def in_sync_ids_map(self, groups) -> dict[int, list[int]]:
+        """Bulk form of :meth:`in_sync_ids` — ONE device fetch for all
+        requested groups (use for Metadata requests spanning many
+        partitions); groups this node does not lead are absent."""
+        return self._server.engine.in_sync_ids_map(groups)

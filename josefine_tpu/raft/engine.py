@@ -315,6 +315,10 @@ class RaftEngine:
         self._me_dev = jnp.asarray(self.me, _I32)
         # Hot-path counters with the label key pre-resolved.
         self._c_in = _m_in.bind(node=self.self_id)
+        # Per-(group, src) tick of the last delivered consensus message —
+        # the liveness half of the derived ISR (in_sync_map). Updated with
+        # one vectorized mask per tick from the inbox the host itself built.
+        self._h_last_seen = np.zeros((groups, self.N), np.int64)
 
         self._pending_msgs: list[rpc.WireMsg] = []
         self._pending_batches: list[rpc.MsgBatch] = []
@@ -438,6 +442,8 @@ class RaftEngine:
         prop_counts.fill(0)
         for g, lst in self._proposals.items():
             prop_counts[g] = len(lst)
+
+        self._h_last_seen[inbox9[0] != rpc.MSG_NONE] = self._ticks
 
         new_state, sv, ov = self._step(
             self.params,
@@ -633,6 +639,52 @@ class RaftEngine:
 
     def term(self, group: int = 0) -> int:
         return int(self._h_term[group])
+
+    def in_sync_map(self, groups, max_lag: int = 64,
+                    liveness_ticks: int = 30) -> dict[int, set[int]]:
+        """Live ISR for every requested group this node leads, in ONE bulk
+        device fetch: member slots whose confirmed ``match`` pointer is
+        within ``max_lag`` blocks of the leader's head AND that have sent us
+        any consensus traffic within ``liveness_ticks`` (a live follower
+        acks heartbeats every hb_ticks, so a crashed replica falls out even
+        on a quiet partition where block lag never grows). Self is always
+        included. Groups this node does not lead are absent from the result.
+
+        This is the view the reference never maintains (its Partition.isr
+        is written once at creation, ``src/broker/state.rs``); here the Bid
+        match rows on device ARE the replication state, so ISR is derived,
+        not bookkept. Cost: two full-array transfers per CALL (not per
+        group) — batch all partitions of a Metadata request into one call;
+        on a tunneled TPU transfer count sets the latency floor."""
+        led = [g for g in groups if self.is_leader(g)]
+        if not led:
+            return {}
+        ms = np.asarray(self.state.match.s)   # (P, N), one transfer
+        mask = np.asarray(self.member)        # (P, N), one transfer
+        recent = (self._ticks - self._h_last_seen) <= liveness_ticks
+        out: dict[int, set[int]] = {}
+        for g in led:
+            head_s = id_seq(self.chains[g].head)
+            ok = mask[g] & (head_s - ms[g] <= max_lag) & recent[g]
+            slots = set(np.nonzero(ok)[0].tolist())
+            slots.add(self.me)
+            out[g] = slots
+        return out
+
+    def in_sync_slots(self, group: int, max_lag: int = 64) -> set[int] | None:
+        """Single-group view of :meth:`in_sync_map`; None when not leader."""
+        return self.in_sync_map([group], max_lag).get(group)
+
+    def in_sync_ids_map(self, groups, max_lag: int = 64) -> dict[int, list[int]]:
+        """node-id form of :meth:`in_sync_map` (one bulk fetch)."""
+        return {
+            g: [i for i in (self.node_ids[s] for s in sorted(slots))
+                if i is not None]
+            for g, slots in self.in_sync_map(groups, max_lag).items()
+        }
+
+    def in_sync_ids(self, group: int, max_lag: int = 64) -> list[int] | None:
+        return self.in_sync_ids_map([group], max_lag).get(group)
 
     def debug_state(self) -> dict:
         """Cluster-state view for the /state endpoint — replaces the

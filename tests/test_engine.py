@@ -283,3 +283,71 @@ def test_catchup_is_chunked_by_max_append_entries():
             assert (await f).startswith(b"ok:")
 
     asyncio.run(main())
+
+
+def test_live_isr_from_match_pointers():
+    """ISR is derived from the leader's Raft replication progress: a
+    follower that stops receiving falls out once it lags > max_lag blocks,
+    and rejoins after catching up. (The reference's Partition.isr is
+    written once at creation and never maintained.)"""
+    from josefine_tpu.raft import rpc
+
+    async def main():
+        ids3 = [1, 2, 3]
+        engines = [
+            RaftEngine(MemKV(), ids3, ids3[i], groups=1, fsms={0: ListFsm()},
+                       params=PARAMS, base_seed=i)
+            for i in range(3)
+        ]
+
+        def run(n, down=()):
+            for _ in range(n):
+                for i, e in enumerate(engines):
+                    if i in down:
+                        continue
+                    for m in e.tick().outbound:
+                        if m.dst not in down:
+                            engines[m.dst].receive(m)
+
+        lead = None
+        for _ in range(60):
+            run(1)
+            leads = [i for i, e in enumerate(engines) if e.is_leader(0)]
+            if leads:
+                lead = leads[0]
+                break
+        assert lead is not None
+        run(5)
+        # Everyone fresh: all three in sync; non-leaders answer None.
+        assert engines[lead].in_sync_slots(0) == {0, 1, 2}
+        follower = next(i for i in range(3) if i != lead)
+        assert engines[follower].in_sync_slots(0) is None
+
+        # Partition the follower and mint past the lag threshold.
+        futs = []
+        for _ in range(40):
+            for _ in range(2):
+                futs.append(engines[lead].propose(0, b"x"))
+            run(1, down=(follower,))
+        assert engines[lead].in_sync_slots(0, max_lag=64) == (
+            {0, 1, 2} - {follower})
+        ids_ = engines[lead].in_sync_ids(0)
+        assert ids3[follower] not in ids_
+
+        # Heal: chunked catch-up restores the match pointer and the ISR.
+        run(60)
+        assert engines[lead].in_sync_slots(0) == {0, 1, 2}
+
+        # Quiet-partition liveness: with NO traffic, block lag never grows —
+        # a crashed replica must still fall out once it stops acking
+        # heartbeats (liveness window), not linger in ISR forever.
+        run(40, down=(follower,))
+        assert engines[lead].chains[0].head == engines[follower].chains[0].head
+        assert engines[lead].in_sync_slots(0) == {0, 1, 2} - {follower}
+        run(10)
+        assert engines[lead].in_sync_slots(0) == {0, 1, 2}
+        for f in futs:
+            if f.done() and not f.cancelled():
+                f.exception()
+
+    asyncio.run(main())

@@ -1,0 +1,65 @@
+"""Adversarial-input fuzz for the C++ Kafka codec.
+
+The decoder runs in-process (raw CPython C API, no interpreter guard rails)
+— an out-of-bounds read is a broker segfault and a huge claimed length is
+an allocation bomb, so malformed frames must fail as Python exceptions in
+bounded time/memory. The reference delegates this surface to the
+kafka-protocol crate; here it is our own C++ and must be pinned.
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent / "fixtures"))
+
+import kafka_golden as G  # noqa: E402
+import pytest  # noqa: E402
+
+from josefine_tpu.kafka import codec  # noqa: E402
+
+
+def _try(fn, *a):
+    try:
+        fn(*a)
+    except Exception:
+        pass  # any Python exception is fine; a crash/hang is not
+
+
+def test_random_garbage_never_crashes():
+    rng = random.Random(0)
+    for _ in range(1500):
+        raw = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 64)))
+        _try(codec.decode_request, raw)
+
+
+@pytest.mark.parametrize(
+    "fx", G.FIXTURES,
+    ids=[f"api{f['api_key']}v{f['api_version']}" for f in G.FIXTURES])
+def test_truncations_and_bitflips_never_crash(fx):
+    rng = random.Random(fx["api_key"] * 31 + fx["api_version"])
+    req, resp = fx["request_frame"], fx["response_frame"]
+    for cut in range(len(req)):
+        _try(codec.decode_request, req[:cut])
+    for cut in range(len(resp)):
+        _try(codec.decode_response, fx["api_key"], fx["api_version"],
+             resp[:cut])
+    for _ in range(200):
+        b = bytearray(req)
+        b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+        _try(codec.decode_request, bytes(b))
+
+
+def test_huge_claimed_lengths_rejected_without_allocation():
+    """Array counts / string lengths beyond the remaining buffer must be
+    rejected by bounds checks, not attempted (allocation bomb)."""
+    hdr = G.req_header(3, 1, 1, "fz")
+    with pytest.raises(Exception, match="exceeds buffer|underflow|malformed"):
+        codec.decode_request(hdr + G.i32(0x7FFFFFFF))  # metadata topics count
+    with pytest.raises(Exception, match="exceeds buffer|underflow|malformed"):
+        codec.decode_request(
+            G.req_header(19, 1, 2, "fz") + G.i32(0x7FFFFFFF) + G.string("t"))
+    with pytest.raises(Exception, match="underflow|malformed"):
+        codec.decode_request(G.req_header(10, 0, 3, "fz") + G.i16(0x7FFF))
