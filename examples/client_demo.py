@@ -6,9 +6,16 @@ real wire protocol (the reference could only do the CreateTopics leg;
 SURVEY.md quirk 8).
 """
 
+import os
+import sys
+
+# Runnable as documented (python examples/...): when invoked by path,
+# sys.path[0] is this file's dir, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
 import asyncio
 import struct
-import sys
 
 from josefine_tpu.broker import records
 from josefine_tpu.kafka import client as kafka_client

@@ -4,8 +4,15 @@ Parity: reference ``examples/single-node/main.rs``. Start it, then talk
 Kafka to 127.0.0.1:8844 (e.g. ``python ../client_demo.py``).
 """
 
-import asyncio
 import os
+import sys
+
+# Runnable as documented (python examples/...): when invoked by path,
+# sys.path[0] is this file's dir, not the repo root the package lives in.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+import asyncio
 import signal
 
 from josefine_tpu import josefine
