@@ -60,6 +60,9 @@ _m_in = REGISTRY.counter("raft_msgs_in_total", "Consensus wire messages accepted
 _m_snapshots = REGISTRY.counter("raft_snapshots_total", "Snapshots taken (log compactions)")
 _m_installs = REGISTRY.counter("raft_snapshot_installs_total", "Snapshots installed from a leader")
 _m_led = REGISTRY.gauge("raft_groups_led", "Groups this node currently leads")
+_m_backlog_dropped = REGISTRY.counter(
+    "raft_batch_backlog_dropped_total",
+    "Consensus batch entries dropped by the per-src intake backlog cap")
 
 _I32 = jnp.int32
 
@@ -413,6 +416,18 @@ class RaftEngine:
         if len(b):
             self._c_in.inc(len(b))
             self._pending_batches.append(b)
+            # Backlog cap per src: a peer that floods stale per-tick
+            # snapshots (e.g. a transport without batch coalescing) must
+            # not buy itself minutes of carry-over chew-through — beyond 4
+            # pending frames, the OLDEST from that src is dropped; Raft's
+            # retry covers whatever it carried.
+            from_src = [i for i, pb in enumerate(self._pending_batches)
+                        if pb.src == b.src]
+            if len(from_src) > 4:
+                dropped = self._pending_batches.pop(from_src[0])
+                _m_backlog_dropped.inc(len(dropped), node=self.self_id)
+                log.warning("dropping stale batch backlog src=%d (%d entries)",
+                            b.src, len(dropped))
 
     def propose(self, group: int, payload: bytes) -> asyncio.Future:
         """Submit a client payload; resolves with the FSM result once the

@@ -17,7 +17,10 @@ from __future__ import annotations
 import asyncio
 from typing import Callable
 
-from josefine_tpu.raft.rpc import WireMsg, decode_frame
+from josefine_tpu.raft.rpc import MSG_BATCH, MsgBatch, WireMsg, decode_frame
+
+# Queue sentinel: "deliver whatever is newest in the batch mailbox".
+_BATCH_TOKEN = object()
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.shutdown import Shutdown
 from josefine_tpu.utils.tracing import get_logger
@@ -65,9 +68,19 @@ class Transport:
         self.peers = peers
         self.on_message = on_message
         self.shutdown = shutdown
-        self._queues: dict[int, asyncio.Queue[WireMsg]] = {
+        self._queues: dict[int, asyncio.Queue] = {
             nid: asyncio.Queue(SEND_QUEUE_DEPTH) for nid in peers
         }
+        # Per-peer 1-slot mailbox for consensus batches. A batch is this
+        # tick's snapshot of everything we owe the peer — queueing history
+        # to a dead peer only makes its recovery slower: on reconnect the
+        # receiver would chew through N stale frames at one inbox slot per
+        # tick (carry-over) before any fresh AE lands, adding N ticks of
+        # replication latency per outage. Newest-wins instead; Raft's own
+        # retry covers anything a dropped frame carried. The queue carries
+        # the _BATCH_TOKEN sentinel (resolved by _materialize) in the
+        # batch's original position.
+        self._latest_batch: dict[int, MsgBatch] = {}
         self._peer_tasks: dict[int, asyncio.Task] = {}
         self._conn_tasks: set[asyncio.Task] = set()
         self._server: asyncio.Server | None = None
@@ -104,18 +117,32 @@ class Transport:
         if task is not None:
             task.cancel()
         self._queues.pop(peer_id, None)
+        # The dropped queue may hold this mailbox's token; clearing the
+        # mailbox too keeps the token<->mailbox invariant, else a re-added
+        # peer would never be sent another consensus batch (send() would
+        # see stale content and skip the token forever).
+        self._latest_batch.pop(peer_id, None)
         self.peers.pop(peer_id, None)
 
-    def send(self, peer_id: int, msg: WireMsg) -> None:
+    def send(self, peer_id: int, msg: WireMsg | MsgBatch) -> None:
         """Enqueue; full queue drops the message (reference tcp.rs:90-96 —
-        Raft tolerates loss, retry comes from the protocol itself)."""
+        Raft tolerates loss, retry comes from the protocol itself).
+        Consensus batches coalesce into a 1-slot newest-wins mailbox."""
         q = self._queues.get(peer_id)
         if q is None:
             log.warning("send to unknown peer %d", peer_id)
             return
+        if msg.kind == MSG_BATCH:
+            had = self._latest_batch.get(peer_id) is not None
+            self._latest_batch[peer_id] = msg
+            if had:
+                return  # a token is already queued; newest content wins
+            msg = _BATCH_TOKEN
         try:
             q.put_nowait(msg)
         except asyncio.QueueFull:
+            if msg is _BATCH_TOKEN:
+                self._latest_batch.pop(peer_id, None)
             self.dropped += 1
             _m_dropped.inc(node=self.self_id)
 
@@ -153,6 +180,15 @@ class Transport:
                 self._conn_tasks.discard(task)
             writer.close()
 
+    def _materialize(self, peer_id: int, msg) -> bytes | None:
+        """Resolve a queue item to frame bytes: a batch token takes the
+        newest mailbox content (None if already taken)."""
+        if msg is _BATCH_TOKEN:
+            msg = self._latest_batch.pop(peer_id, None)
+            if msg is None:
+                return None
+        return msg.encode()
+
     async def _send_loop(self, peer_id: int):
         """Connect-with-backoff loop draining this peer's queue
         (reference tcp.rs:110-137)."""
@@ -167,10 +203,14 @@ class Transport:
                 log.debug("node %d connected to peer %d", self.self_id, peer_id)
                 while True:
                     msg = await q.get()
-                    write_frame(writer, msg.encode())
+                    body = self._materialize(peer_id, msg)
+                    if body is not None:
+                        write_frame(writer, body)
                     # Coalesce whatever else is queued into one flush.
                     while not q.empty():
-                        write_frame(writer, q.get_nowait().encode())
+                        body = self._materialize(peer_id, q.get_nowait())
+                        if body is not None:
+                            write_frame(writer, body)
                     await writer.drain()
             except asyncio.CancelledError:
                 if writer is not None:

