@@ -18,12 +18,12 @@ import asyncio
 from typing import Callable
 
 from josefine_tpu.raft.rpc import MSG_BATCH, MsgBatch, WireMsg, decode_frame
-
-# Queue sentinel: "deliver whatever is newest in the batch mailbox".
-_BATCH_TOKEN = object()
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.shutdown import Shutdown
 from josefine_tpu.utils.tracing import get_logger
+
+# Queue sentinel: "deliver whatever is newest in the batch mailbox".
+_BATCH_TOKEN = object()
 
 log = get_logger("raft.tcp")
 

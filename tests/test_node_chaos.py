@@ -90,8 +90,9 @@ async def _produce_one(mgr, part: int, payload: bytes, down: set[int]) -> bool:
 
 
 @pytest.mark.asyncio
-async def test_node_crash_restart_acked_records_survive(tmp_path):
-    rng = random.Random(5)
+@pytest.mark.parametrize("seed", [5, 17])
+async def test_node_crash_restart_acked_records_survive(tmp_path, seed):
+    rng = random.Random(seed)
     async with NodeManager(3, tmp_path, partitions=4, tick_ms=30,
                            in_memory=False) as mgr:
         await mgr.wait_registered(3)

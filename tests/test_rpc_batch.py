@@ -220,6 +220,36 @@ def test_batch_slot_conflict_carries_over():
     asyncio.run(main())
 
 
+def test_intake_backlog_cap_drops_oldest_per_src():
+    """Beyond 4 pending frames from one src, the OLDEST is dropped (and
+    counted); other srcs' backlogs are untouched. Defense-in-depth for
+    transports without batch coalescing."""
+
+    async def main():
+        from josefine_tpu.raft.engine import _m_backlog_dropped
+
+        e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=64,
+                       params=step_params(timeout_min=50, timeout_max=60))
+        before = _m_backlog_dropped.get(node=1)
+        # 7 frames from src 1 (distinct groups so none are slot conflicts),
+        # 2 from src 2.
+        for t in range(7):
+            e.receive(_mk_batch(src=1, dst=0,
+                                entries=[_e(t, rpc.MSG_VOTE_REQ, term=t + 1)]))
+        for t in range(2):
+            e.receive(_mk_batch(src=2, dst=0,
+                                entries=[_e(t, rpc.MSG_VOTE_REQ, term=1)]))
+        from_src1 = [b for b in e._pending_batches if b.src == 1]
+        from_src2 = [b for b in e._pending_batches if b.src == 2]
+        # Insert-then-trim keeps at most 4 per src at rest, newest wins.
+        assert len(from_src1) == 4
+        assert [int(b.term[0]) for b in from_src1] == [4, 5, 6, 7]
+        assert len(from_src2) == 2  # other srcs untouched
+        assert _m_backlog_dropped.get(node=1) - before == 3
+
+    asyncio.run(main())
+
+
 def test_sorted_normalization_of_foreign_batches():
     """A frame with unsorted/duplicate groups (not producible by our encoder
     but legal on the wire) is normalized at intake."""
