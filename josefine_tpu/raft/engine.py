@@ -103,11 +103,13 @@ def _node_view(state: NodeState, me: int) -> NodeState:
 # Packed-IO step. On a tunneled TPU every individual host<->device transfer
 # is a full network round trip, so the bridge's tick floor is set by the
 # *number* of transfers, not their bytes. The step therefore takes ONE packed
-# (9, P, N) inbox tensor in and returns TWO packed tensors out — the (10, P)
-# scalar mirror (term/voted/role/leader/head/commit/minted/became) and the
-# (9, P, N) outbox — instead of fetching ~27 pytree leaves per tick.
+# (10, P, N) input tensor (nine message rows + a proposal-count row) and
+# returns ONE flat int32 output holding both the (10, P) scalar mirror
+# (term/voted/role/leader/head/commit/minted/became) and the (9, P, N)
+# outbox — one transfer each way per tick, instead of ~27 pytree leaves.
 # Packed message row order (both directions):
 #   0=kind 1=term 2=x.t 3=x.s 4=y.t 5=y.s 6=z.t 7=z.s 8=ok
+# Input row 9: proposal counts in column 0 (the (P,) lane, node-axis-padded).
 
 
 def _msgs_from_packed(m9) -> Msgs:
@@ -118,8 +120,9 @@ def _msgs_from_packed(m9) -> Msgs:
     )
 
 
-def _jax_packed_step(params, member, me, state, inbox9, props):
-    inbox = _msgs_from_packed(inbox9)
+def _jax_packed_step(params, member, me, state, in10):
+    inbox = _msgs_from_packed(in10)
+    props = in10[9, :, 0]
     st, out, met = jax.vmap(cr.node_step, in_axes=(None, 0, None, 0, 0, 0))(
         params, member, me, state, inbox, props)
     sv = jnp.stack([
@@ -131,17 +134,23 @@ def _jax_packed_step(params, member, me, state, inbox9, props):
         out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
         out.z.t, out.z.s, out.ok,
     ])
-    return st, sv, ov
+    # One flat output = ONE device->host fetch per tick. The concatenate
+    # costs a device-side copy of the outbox (HBM-bandwidth trivial); on a
+    # tunneled TPU a second fetch costs a full network round trip (~65 ms
+    # observed), which dominates by orders of magnitude.
+    return st, jnp.concatenate([sv.reshape(-1), ov.reshape(-1)])
 
 
 _packed_over_groups = jax.jit(_jax_packed_step, donate_argnums=(3,))
 
 
-def _py_packed_step(params, member, me, state, inbox9, props):
+def _py_packed_step(params, member, me, state, in10):
     """The scalar host engine behind the same packed-IO contract."""
     from josefine_tpu.models.py_step import py_node_over_groups
 
-    inbox = _msgs_from_packed(np.asarray(inbox9))
+    in10 = np.asarray(in10)
+    inbox = _msgs_from_packed(in10)
+    props = in10[9, :, 0]
     st, out, met = py_node_over_groups(params, member, me, state, inbox, props)
     h = np.asarray
     sv = np.stack([
@@ -153,7 +162,7 @@ def _py_packed_step(params, member, me, state, inbox9, props):
         h(out.kind), h(out.term), h(out.x.t), h(out.x.s), h(out.y.t),
         h(out.y.s), h(out.z.t), h(out.z.s), h(out.ok),
     ])
-    return st, sv, ov
+    return st, np.concatenate([sv.reshape(-1), ov.reshape(-1)])
 
 
 class RaftEngine:
@@ -311,10 +320,9 @@ class RaftEngine:
             (ch.head for ch in self.chains), np.int64, count=groups)
         self._h_commit = np.fromiter(
             (ch.committed for ch in self.chains), np.int64, count=groups)
-        # Reused per-tick buffers: the packed (9, P, N) inbox and the (P,)
-        # proposal counts (zeroed in place each tick, transferred once).
-        self._inbox9 = np.zeros((9, groups, self.N), np.int32)
-        self._prop_counts = np.zeros(groups, np.int32)
+        # Reused per-tick input buffer: nine packed message rows + the
+        # proposal-count row (zeroed in place each tick, transferred once).
+        self._in10 = np.zeros((10, groups, self.N), np.int32)
         self._me_dev = jnp.asarray(self.me, _I32)
         # Hot-path counters with the label key pre-resolved.
         self._c_in = _m_in.bind(node=self.self_id)
@@ -452,28 +460,29 @@ class RaftEngine:
     # -------------------------------------------------------------- tick
 
     def tick(self) -> TickResult:
-        inbox9, staged, deferred, deferred_b = self._build_inbox()
-        prop_counts = self._prop_counts
-        prop_counts.fill(0)
+        in10, staged, deferred, deferred_b = self._build_inbox()
         for g, lst in self._proposals.items():
-            prop_counts[g] = len(lst)
+            in10[9, g, 0] = len(lst)
 
-        self._h_last_seen[inbox9[0] != rpc.MSG_NONE] = self._ticks
+        self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
 
-        new_state, sv, ov = self._step(
+        new_state, flat = self._step(
             self.params,
             self.member,
             self._me_dev,
             self.state,
-            inbox9,
-            prop_counts,
+            in10,
         )
         self.state = new_state
         self._pending_msgs = deferred
         self._pending_batches = deferred_b
 
-        # Host-side mirror of device decisions: ONE (10, P) fetch.
-        sv = np.asarray(sv).astype(np.int64, copy=False)
+        # Host-side mirror of device decisions: ONE flat fetch holding the
+        # (10, P) scalar mirror and the (9, P, N) outbox.
+        flat = np.asarray(flat)
+        cut = 10 * self.P
+        sv = flat[:cut].reshape(10, self.P).astype(np.int64, copy=False)
+        ov = flat[cut:].reshape(9, self.P, self.N)
         (n_term, n_voted, n_role, n_leader,
          n_head_t, n_head_s, n_commit_t, n_commit_s, minted, became) = sv
         head_new = (n_head_t << 32) | n_head_s
@@ -1051,12 +1060,13 @@ class RaftEngine:
     def _build_inbox(self) -> tuple[
             np.ndarray, dict[int, list], list[rpc.WireMsg], list[rpc.MsgBatch]]:
         """Pack queued batches + stray wire messages into the persistent
-        (9, P, N_src) inbox buffer; one message per (group, src) slot per
-        tick (the reference's bounded per-peer queue with carry-over instead
-        of silent drop, src/raft/tcp.rs:63). Returns (inbox, staged blocks,
-        deferred msgs, deferred batches); the buffer is transferred to
-        device in ONE copy by the packed step."""
-        m9 = self._inbox9
+        (10, P, N_src) input buffer — rows 0-8 are message fields, row 9 is
+        the proposal-count lane written by tick() after this returns. One
+        message per (group, src) slot per tick (the reference's bounded
+        per-peer queue with carry-over instead of silent drop,
+        src/raft/tcp.rs:63). Returns (input buffer, staged blocks, deferred
+        msgs, deferred batches); the buffer reaches the device in ONE copy."""
+        m9 = self._in10
         m9.fill(0)
         staged: dict[int, list] = {}
         deferred: list[rpc.WireMsg] = []
@@ -1124,7 +1134,7 @@ class RaftEngine:
         consensus traffic to a peer is a single binary frame end to end; the
         only per-entry Python work left is for AEs that carry payload spans.
         """
-        ov = np.asarray(ov)  # ONE device->host fetch of the (9, P, N) outbox
+        # ov is the host-side (9, P, N) slice of the tick's single flat fetch.
         kind = ov[0]
         if not kind.any():
             return []
