@@ -203,22 +203,25 @@ class Broker:
             names = [t.name for t in topics]
         else:
             names = [t["name"] for t in requested]
+        found = [(name, topic,
+                  self.store.get_partitions(name) if topic is not None else [])
+                 for name, topic in ((n, self.store.get_topic(n)) for n in names)]
+        # Live ISR for every group-backed partition we lead, across ALL
+        # requested topics, in ONE engine fetch per request (per-partition
+        # or per-topic calls would each cost two device transfers).
+        isr_map = self.client.in_sync_ids_map(
+            [g for g in (self._live_group(p)
+                         for _, _, store_parts in found
+                         for p in store_parts)
+             if g is not None])
         out_topics = []
-        for name in names:
-            topic = self.store.get_topic(name)
+        for name, topic, store_parts in found:
             if topic is None:
                 out_topics.append({
                     "error_code": ErrorCode.UNKNOWN_TOPIC_OR_PARTITION,
                     "name": name, "is_internal": False, "partitions": [],
                 })
                 continue
-            store_parts = self.store.get_partitions(name)
-            # Live ISR for all group-backed partitions we lead, in ONE
-            # engine fetch for the whole request (per-partition calls would
-            # cost two device transfers each).
-            isr_map = self.client.in_sync_ids_map(
-                [g for g in (self._live_group(p) for p in store_parts)
-                 if g is not None])
             parts = []
             for p in store_parts:
                 parts.append({

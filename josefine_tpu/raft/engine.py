@@ -120,25 +120,31 @@ def _msgs_from_packed(m9) -> Msgs:
     )
 
 
+def _flat_outputs(xp, st, out, met):
+    """The single definition of the flat-output row order (both backends):
+    the (10, P) scalar mirror followed by the (9, P, N) outbox. One flat
+    buffer = ONE device->host fetch per tick; the concatenate costs a
+    device-side copy of the outbox (HBM-bandwidth trivial) while a second
+    fetch on a tunneled TPU costs a full network round trip (~65 ms
+    observed), which dominates by orders of magnitude."""
+    sv = xp.stack([
+        st.term, st.voted_for, st.role, st.leader,
+        st.head.t, st.head.s, st.commit.t, st.commit.s,
+        met.minted, met.became_leader,
+    ])
+    ov = xp.stack([
+        out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
+        out.z.t, out.z.s, out.ok,
+    ])
+    return xp.concatenate([sv.reshape(-1), ov.reshape(-1)])
+
+
 def _jax_packed_step(params, member, me, state, in10):
     inbox = _msgs_from_packed(in10)
     props = in10[9, :, 0]
     st, out, met = jax.vmap(cr.node_step, in_axes=(None, 0, None, 0, 0, 0))(
         params, member, me, state, inbox, props)
-    sv = jnp.stack([
-        st.term, st.voted_for, st.role, st.leader,
-        st.head.t, st.head.s, st.commit.t, st.commit.s,
-        met.minted, met.became_leader,
-    ])
-    ov = jnp.stack([
-        out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
-        out.z.t, out.z.s, out.ok,
-    ])
-    # One flat output = ONE device->host fetch per tick. The concatenate
-    # costs a device-side copy of the outbox (HBM-bandwidth trivial); on a
-    # tunneled TPU a second fetch costs a full network round trip (~65 ms
-    # observed), which dominates by orders of magnitude.
-    return st, jnp.concatenate([sv.reshape(-1), ov.reshape(-1)])
+    return st, _flat_outputs(jnp, st, out, met)
 
 
 _packed_over_groups = jax.jit(_jax_packed_step, donate_argnums=(3,))
@@ -152,17 +158,7 @@ def _py_packed_step(params, member, me, state, in10):
     inbox = _msgs_from_packed(in10)
     props = in10[9, :, 0]
     st, out, met = py_node_over_groups(params, member, me, state, inbox, props)
-    h = np.asarray
-    sv = np.stack([
-        h(st.term), h(st.voted_for), h(st.role), h(st.leader),
-        h(st.head.t), h(st.head.s), h(st.commit.t), h(st.commit.s),
-        h(met.minted), h(met.became_leader),
-    ])
-    ov = np.stack([
-        h(out.kind), h(out.term), h(out.x.t), h(out.x.s), h(out.y.t),
-        h(out.y.s), h(out.z.t), h(out.z.s), h(out.ok),
-    ])
-    return st, np.concatenate([sv.reshape(-1), ov.reshape(-1)])
+    return st, _flat_outputs(np, st, out, met)
 
 
 class RaftEngine:
@@ -496,10 +492,9 @@ class RaftEngine:
         active |= head_new != self._h_head
         active |= commit_new != self._h_commit
         active |= (self._h_role == LEADER) & (n_role != LEADER)
-        if self._proposals:
-            for g, lst in self._proposals.items():
-                if lst:
-                    active[g] = True
+        for g, lst in self._proposals.items():
+            if lst:
+                active[g] = True
 
         res = TickResult()
         for g in np.nonzero(active)[0]:
@@ -1066,8 +1061,8 @@ class RaftEngine:
         per-peer queue with carry-over instead of silent drop,
         src/raft/tcp.rs:63). Returns (input buffer, staged blocks, deferred
         msgs, deferred batches); the buffer reaches the device in ONE copy."""
-        m9 = self._in10
-        m9.fill(0)
+        in10 = self._in10
+        in10.fill(0)
         staged: dict[int, list] = {}
         deferred: list[rpc.WireMsg] = []
         deferred_b: list[rpc.MsgBatch] = []
@@ -1076,27 +1071,27 @@ class RaftEngine:
         # the remainder to the next tick.
         for b in self._pending_batches:
             g, src = b.group, b.src
-            free = m9[0, g, src] == 0
+            free = in10[0, g, src] == 0
             if not free.all():
                 deferred_b.append(b.take(~free))
                 b = b.take(free)
                 g = b.group
                 if not len(b):
                     continue
-            m9[0, g, src] = b.kind_col
-            m9[1, g, src] = b.term
-            m9[2, g, src] = b.x >> 32
-            m9[3, g, src] = b.x & 0xFFFFFFFF
-            m9[4, g, src] = b.y >> 32
-            m9[5, g, src] = b.y & 0xFFFFFFFF
-            m9[6, g, src] = b.z >> 32
-            m9[7, g, src] = b.z & 0xFFFFFFFF
-            m9[8, g, src] = b.ok
+            in10[0, g, src] = b.kind_col
+            in10[1, g, src] = b.term
+            in10[2, g, src] = b.x >> 32
+            in10[3, g, src] = b.x & 0xFFFFFFFF
+            in10[4, g, src] = b.y >> 32
+            in10[5, g, src] = b.y & 0xFFFFFFFF
+            in10[6, g, src] = b.z >> 32
+            in10[7, g, src] = b.z & 0xFFFFFFFF
+            in10[8, g, src] = b.ok
             for grp, blks in b.blocks.items():
                 staged.setdefault(grp, []).extend(blks)
         msgs = self._pending_msgs
         if not msgs:
-            return m9, staged, deferred, deferred_b
+            return in10, staged, deferred, deferred_b
         # First message per (group, src) slot wins; extras carry over. The
         # slot scan runs on a Python set (cheap), the field writes as nine
         # vectorized scatters (numpy scalar indexing is ~30x slower per cell).
@@ -1104,7 +1099,7 @@ class RaftEngine:
         seen: set[tuple[int, int]] = set()
         for m in msgs:
             key = (m.group, m.src)
-            if key in seen or m9[0, m.group, m.src] != rpc.MSG_NONE:
+            if key in seen or in10[0, m.group, m.src] != rpc.MSG_NONE:
                 deferred.append(m)
                 continue
             seen.add(key)
@@ -1117,16 +1112,16 @@ class RaftEngine:
         x = np.fromiter((m.x for m in keep), np.int64, k)
         y = np.fromiter((m.y for m in keep), np.int64, k)
         z = np.fromiter((m.z for m in keep), np.int64, k)
-        m9[0, gi, si] = np.fromiter((m.kind for m in keep), np.int32, k)
-        m9[1, gi, si] = np.fromiter((m.term for m in keep), np.int32, k)
-        m9[2, gi, si] = x >> 32
-        m9[3, gi, si] = x & 0xFFFFFFFF
-        m9[4, gi, si] = y >> 32
-        m9[5, gi, si] = y & 0xFFFFFFFF
-        m9[6, gi, si] = z >> 32
-        m9[7, gi, si] = z & 0xFFFFFFFF
-        m9[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
-        return m9, staged, deferred, deferred_b
+        in10[0, gi, si] = np.fromiter((m.kind for m in keep), np.int32, k)
+        in10[1, gi, si] = np.fromiter((m.term for m in keep), np.int32, k)
+        in10[2, gi, si] = x >> 32
+        in10[3, gi, si] = x & 0xFFFFFFFF
+        in10[4, gi, si] = y >> 32
+        in10[5, gi, si] = y & 0xFFFFFFFF
+        in10[6, gi, si] = z >> 32
+        in10[7, gi, si] = z & 0xFFFFFFFF
+        in10[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
+        return in10, staged, deferred, deferred_b
 
     def _decode_outbox(self, ov) -> list:
         """Decode the packed outbox into ONE columnar MsgBatch per peer (plus
