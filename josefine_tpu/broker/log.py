@@ -27,8 +27,16 @@ class Log:
         index_bytes: int = INDEX_BYTES,
     ):
         os.makedirs(directory, exist_ok=True)
+        self._dir = str(directory)
+        self._max_segment_bytes = max_segment_bytes
+        self._index_bytes = index_bytes
+        self._open()
+
+    def _open(self) -> None:
         self._log = native.load("seglog").open(
-            str(directory), max_segment_bytes=max_segment_bytes, index_bytes=index_bytes
+            self._dir,
+            max_segment_bytes=self._max_segment_bytes,
+            index_bytes=self._index_bytes,
         )
 
     def append(self, data: bytes, count: int = 1) -> int:
@@ -50,6 +58,16 @@ class Log:
 
     def segment_count(self) -> int:
         return self._log.segment_count()
+
+    def wipe(self) -> None:
+        """Reset to an empty log: close, delete every segment + index file,
+        reopen at offset 0. Used by snapshot restore (follower log sync) —
+        the restored prefix replaces whatever divergent local tail existed."""
+        self._log.close()
+        for f in os.listdir(self._dir):
+            if f.endswith(".log") or f.endswith(".index"):
+                os.remove(os.path.join(self._dir, f))
+        self._open()
 
     def flush(self) -> None:
         self._log.flush()

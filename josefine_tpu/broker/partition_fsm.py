@@ -21,6 +21,17 @@ local segmented log on every replica:
 
 The FSM implements ``transition_block`` (not plain ``transition``) because
 idempotence needs the block id; the Driver prefers it when present.
+
+Log compaction (chain side): the segmented log IS this FSM's durable state,
+so its "snapshot" needs no second copy of the data — ``snapshot()`` returns
+a 16-byte manifest ``(applied_id, log_end_offset)`` that the engine stores
+as the group's snapshot record and uses to truncate the chain (the record
+batches below the floor already live in the seglog). When a follower falls
+below the truncation floor, the engine materializes the wire payload
+lazily via ``snapshot_export`` — manifest + the framed log prefix — and the
+follower's ``restore`` rebuilds its log byte-for-byte (Kafka-style replica
+log sync, which the reference has no analog of: its followers hold empty
+logs forever, ``src/broker/handler/produce.rs:11-36``).
 """
 
 from __future__ import annotations
@@ -47,13 +58,36 @@ class PartitionFsm:
         # out their max_wait_ms).
         self.on_append = on_append
         self._key = b"pfsm:%d" % group
-        raw = kv.get(self._key)
+        self._rkey = b"pfsm:r:%d" % group
         self._applied = 0
         self._skip_torn = False
+        if kv.get(self._rkey) is not None:
+            # Crash mid-restore: the log was wiped/partially rebuilt while
+            # the position record still describes the pre-restore state.
+            # Neither is trustworthy — reset to empty (a far-behind replica)
+            # and let the leader re-send the snapshot.
+            log.warning("g=%d interrupted snapshot restore detected; "
+                        "resetting replica log", group)
+            self.log.wipe()
+            kv.put(self._key, struct.pack(">QQ", 0, 0))
+            kv.delete(self._rkey)
+            return
+        raw = kv.get(self._key)
         if raw is not None:
             self._applied, recorded_end = struct.unpack(">QQ", raw)
             actual_end = self.log.next_offset()
-            if actual_end > recorded_end:
+            if actual_end < recorded_end:
+                # The log is SHORTER than the position record claims — e.g.
+                # a restore's wipe hit disk but the restore-intent marker's
+                # KV commit was lost to power failure. The missing prefix is
+                # unrecoverable locally; reset like the marker path.
+                log.warning(
+                    "g=%d log end %d < recorded %d (lost prefix); "
+                    "resetting replica log", group, actual_end, recorded_end)
+                self.log.wipe()
+                self._applied = 0
+                kv.put(self._key, struct.pack(">QQ", 0, 0))
+            elif actual_end > recorded_end:
                 # Crash after log.append but before the position record: the
                 # block right after _applied is already in the log. Exactly
                 # one append can be torn (appends are sequential), so one
@@ -86,6 +120,95 @@ class PartitionFsm:
         if self.on_append is not None:
             self.on_append()
         return struct.pack(">q", base)
+
+    # ------------------------------------------------- snapshot / log sync
+
+    def snapshot(self) -> bytes:
+        """Tiny manifest: the data already sits in the seglog; a snapshot
+        only needs to pin (applied block id, log end) so the chain below it
+        can be truncated and a restore knows what prefix to expect."""
+        return struct.pack(">QQ", self._applied, self.log.next_offset())
+
+    def snapshot_export(self, record: bytes) -> bytes:
+        """Materialize the wire payload for InstallSnapshot from a stored
+        manifest: the manifest followed by ``(base, count, len, bytes)``
+        frames covering the log prefix ``[0, log_end)``. Called lazily at
+        ship time (engine ``_snapshot_msg``) so the big payload is never
+        stored twice."""
+        if len(record) != 16:
+            raise ValueError(
+                f"g={self.group} snapshot record is {len(record)} bytes, "
+                "expected a 16-byte manifest")
+        applied, end = struct.unpack(">QQ", record)
+        out = [struct.pack(">QQ", applied, end)]
+        off = 0
+        done = False
+        while off < end and not done:
+            blobs = self.log.read_from(off, 4 << 20)
+            if not blobs:
+                raise ValueError(
+                    f"g={self.group} log hole at offset {off} "
+                    f"(manifest end {end}) exporting snapshot")
+            for base, count, payload in blobs:
+                if base >= end:
+                    done = True
+                    break
+                out.append(struct.pack(">QII", base, count, len(payload)))
+                out.append(payload)
+                off = base + (count or 1)
+        return b"".join(out)
+
+    def restore(self, data: bytes) -> None:
+        """Replace the local log with a snapshot payload (or reset it with
+        ``b""``). Frames are fully validated BEFORE the wipe so a malformed
+        payload from the wire rejects without touching durable state."""
+        if not data:
+            self.kv.put(self._rkey, b"1")
+            self.log.wipe()
+            self._applied = 0
+            self._skip_torn = False
+            self.kv.put(self._key, struct.pack(">QQ", 0, 0))
+            self.kv.delete(self._rkey)
+            return
+        if len(data) < 16:
+            raise ValueError("partition snapshot shorter than its manifest")
+        applied, end = struct.unpack_from(">QQ", data)
+        frames: list[tuple[int, bytes]] = []
+        pos, off = 16, 0
+        while pos < len(data):
+            if pos + 16 > len(data):
+                raise ValueError("truncated snapshot frame header")
+            base, count, ln = struct.unpack_from(">QII", data, pos)
+            pos += 16
+            if pos + ln > len(data):
+                raise ValueError("truncated snapshot frame payload")
+            if count < 1:
+                # The seglog rejects count < 1 at append time; catching it
+                # here keeps the validate-before-wipe contract honest.
+                raise ValueError(f"snapshot frame at {base} has count 0")
+            if base != off:
+                raise ValueError(
+                    f"non-contiguous snapshot frame base {base} != {off}")
+            frames.append((count, data[pos:pos + ln]))
+            pos += ln
+            off = base + (count or 1)
+        if off != end:
+            raise ValueError(
+                f"snapshot frames end at {off}, manifest claims {end}")
+        # Restore-intent marker: the wipe-to-position-record window is not
+        # crash-atomic (the torn-append detector covers exactly one trailing
+        # append, not a rebuild). A crash inside it is detected at boot and
+        # degrades to an empty replica the leader re-syncs.
+        self.kv.put(self._rkey, b"1")
+        self.log.wipe()
+        for count, payload in frames:
+            self.log.append(payload, count=count)
+        self._applied = applied
+        self._skip_torn = False
+        self.kv.put(self._key, struct.pack(">QQ", applied, end))
+        self.kv.delete(self._rkey)
+        if self.on_append is not None:
+            self.on_append()
 
     def close(self) -> None:
         pass  # the Log is owned by the Replica registry

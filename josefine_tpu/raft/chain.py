@@ -271,6 +271,22 @@ class Chain:
         self._kv.put(self._pfx + _FLOOR_KEY, struct.pack(">Q", snap_id))
         self._set_head(snap_id)
 
+    def reset(self) -> None:
+        """Wipe the group back to genesis — a brand-new replica. Used when
+        local durable state is unrecoverable (e.g. the data-plane log lost
+        its prefix below the truncation floor): presenting as empty makes
+        the leader re-sync us from scratch instead of trusting pointers the
+        data no longer backs."""
+        for k, _ in list(self._kv.scan_prefix(self._pfx + _BLOCK_PREFIX)):
+            self._kv.delete(k)
+        genesis = Block(id=GENESIS, parent=GENESIS)
+        self._kv.put(self._pfx + _block_key(GENESIS), _encode_block(genesis))
+        self.committed = GENESIS
+        self._kv.put(self._pfx + _COMMIT_KEY, struct.pack(">Q", GENESIS))
+        self.floor = GENESIS
+        self._kv.put(self._pfx + _FLOOR_KEY, struct.pack(">Q", GENESIS))
+        self._set_head(GENESIS)
+
     def force_head(self, bid: int) -> None:
         """Point head at a stored block (engine reconciliation after the
         device adopts a branch whose blocks were already present)."""

@@ -241,6 +241,10 @@ class RaftEngine:
         self._last_snap_tick: dict[int, int] = {}
         self._snap_sent_tick: dict[tuple[int, int], int] = {}
         self._snap_cache: dict[int, tuple[int, bytes]] = {}
+        # Materialized export payloads (one per group, replaced when the
+        # snapshot id moves) so resends to lagging followers don't rebuild
+        # the log prefix every interval.
+        self._export_cache: dict[int, tuple[int, bytes]] = {}
 
         # Restart recovery for snapshot-capable FSMs: restore the latest
         # snapshot, then replay the committed suffix (snap, commit] — the
@@ -807,6 +811,19 @@ class RaftEngine:
         ch = self.chains[g]
         applied = getattr(fsm, "applied_id", None)
         if callable(applied):
+            if applied() < ch.floor:
+                # The FSM lost state below the chain's truncation floor
+                # (e.g. an interrupted snapshot restore reset the replica
+                # log) — blocks below the floor are gone, so the gap cannot
+                # be replayed, and replaying only (floor, committed] would
+                # apply batches at wrong base offsets (cluster-divergent
+                # data). Reset the whole group to a brand-new replica; the
+                # leader re-syncs it from scratch via snapshot install.
+                log.warning("g=%d FSM applied %#x below chain floor %#x; "
+                            "resetting group for full re-sync",
+                            g, applied(), ch.floor)
+                self._reset_group(g)
+                return
             start = max(applied(), ch.floor)
             if ch.committed > start:
                 drv.apply(ch.range(start, ch.committed))
@@ -820,6 +837,24 @@ class RaftEngine:
                 fsm.restore(b"")
             if ch.committed > start:
                 drv.apply(ch.range(start, ch.committed))
+
+    def _reset_group(self, g: int) -> None:
+        """Regress group ``g`` to genesis, chain + device row + snapshot
+        record: the node presents as an empty replica and the leader's probe
+        (head below its floor) triggers a fresh snapshot install."""
+        ch = self.chains[g]
+        ch.reset()
+        self.kv.delete(b"g%d:snap" % g)
+        self._snap_cache.pop(g, None)
+        self._h_head[g] = GENESIS
+        self._h_commit[g] = GENESIS
+        z = jnp.asarray(0, _I32)
+        self.state = self.state.replace(
+            head=ids.Bid(self.state.head.t.at[g].set(z),
+                         self.state.head.s.at[g].set(z)),
+            commit=ids.Bid(self.state.commit.t.at[g].set(z),
+                           self.state.commit.s.at[g].set(z)),
+        )
 
     def unregister_fsm(self, g: int) -> None:
         drv = self.drivers.pop(g, None)
@@ -913,6 +948,12 @@ class RaftEngine:
         ch = self.chains[g]
         if ch.committed <= ch.floor:
             return None
+        applied = getattr(drv.fsm, "applied_id", None)
+        if callable(applied) and applied() < ch.committed:
+            # The FSM has not applied up to the commit point (cannot happen
+            # on the synchronous tick path; defensive for direct callers) —
+            # snapshotting here would truncate blocks the FSM still needs.
+            return None
         data = drv.fsm.snapshot()
         self._store_snapshot(g, ch.committed, data)
         snap_id = ch.committed
@@ -932,12 +973,10 @@ class RaftEngine:
             return
         for g, drv in self.drivers.items():
             if not supports_snapshot(drv.fsm):
-                # Data-plane FSMs (PartitionFsm) have no snapshot pair yet:
-                # their chains are not compacted (future work: follower log
-                # sync from the leader's segmented log, Kafka-style, so the
-                # chain below commit can be truncated). Skipping here avoids
-                # a no-op take_snapshot retry every tick once the backlog
-                # crosses the threshold.
+                # Skipping here avoids a no-op take_snapshot retry every
+                # tick once the backlog crosses the threshold. (All in-tree
+                # FSMs snapshot — PartitionFsm via its manifest + log-sync
+                # export; this covers user FSMs without the pair.)
                 continue
             ch = self.chains[g]
             backlog = id_seq(ch.committed) - id_seq(ch.floor)
@@ -964,6 +1003,15 @@ class RaftEngine:
         if msg.x <= ch.committed:
             return  # stale: we already have this prefix
         drv = self.drivers.get(g)
+        if drv is None and g != 0:
+            # No FSM wired for a data group yet (restart re-wiring races the
+            # leader's send): installing now would advance the chain past
+            # state the FSM never restored — the gap would be silently
+            # skipped at register_fsm time and this replica's log would stay
+            # empty forever. Drop; the leader re-sends past its throttle.
+            log.warning("deferring snapshot g=%d: no FSM registered yet", g)
+            return
+        snap_record = msg.payload
         if drv is not None:
             if not supports_snapshot(drv.fsm):
                 log.warning(
@@ -972,12 +1020,24 @@ class RaftEngine:
             # Fail (not cancel) outstanding proposals so clients re-route,
             # same as the tick() leadership-loss path; msg.src is the leader.
             drv.drop_waiters(NotLeader(g, msg.src))
-            drv.fsm.restore(msg.payload)
+            try:
+                drv.fsm.restore(msg.payload)
+            except ValueError as e:
+                # Malformed payload (restore validates before mutating its
+                # own state): reject without touching the chain — same
+                # degrade-not-crash rule as poison conf blocks.
+                log.error("rejecting snapshot g=%d from %d: %s", g, msg.src, e)
+                return
+            if callable(getattr(drv.fsm, "snapshot_export", None)):
+                # Export-style FSMs (PartitionFsm): the wire payload was
+                # materialized from the sender's log; durably record only
+                # the small manifest — the restored log IS the state.
+                snap_record = drv.fsm.snapshot()
         # Persist the snapshot record BEFORE mutating the chain (same order
         # as take_snapshot): a crash in between must leave a state the
         # restart recovery can boot from — floor > GENESIS with no matching
         # snapshot record is unrecoverable.
-        self._store_snapshot(g, msg.x, msg.payload)
+        self._store_snapshot(g, msg.x, snap_record)
         ch.install_snapshot(msg.x)
         self._h_head[g] = ch.head
         self._h_commit[g] = ch.committed
@@ -1218,6 +1278,31 @@ class RaftEngine:
             log.warning("no usable snapshot for floor %#x g=%d",
                         self.chains[g].floor, g)
             return None
+        drv = self.drivers.get(g)
+        if drv is None and g != 0:
+            # Data-group snapshot with no FSM wired (restart race, mirror of
+            # the receive-side deferral): the record may be an export-style
+            # manifest we cannot materialize — shipping it raw would be
+            # rejected by every receiver. Defer until re-wiring.
+            log.warning("deferring snapshot send g=%d: no FSM registered", g)
+            return None
+        exp = getattr(drv.fsm, "snapshot_export", None) if drv else None
+        if callable(exp):
+            # Export-style FSMs store only a manifest; the actual payload
+            # (the log prefix) is read from the local log at ship time.
+            # Cached per group keyed by snapshot id — the prefix below a
+            # given snapshot is immutable, and a lagging follower retriggers
+            # this every resend interval until it catches up.
+            cached = self._export_cache.get(g)
+            if cached is not None and cached[0] == snap_id:
+                data = cached[1]
+            else:
+                try:
+                    data = exp(data)
+                except (ValueError, OSError) as e:
+                    log.error("cannot export snapshot g=%d: %s", g, e)
+                    return None
+                self._export_cache[g] = (snap_id, data)
         self._snap_sent_tick[(g, dst)] = self._ticks
         # Group 0 snapshots carry the member table: the receiving node may
         # have missed conf blocks that are now below our truncation floor.

@@ -36,6 +36,9 @@ class InstantRaftClient:
         self.proposals.append(payload)
         return self.fsm.transition(payload)
 
+    def in_sync_ids_map(self, groups) -> dict:
+        return {}  # no consensus engine: metadata falls back to stored ISR
+
 
 @pytest.fixture
 def broker(tmp_path):
