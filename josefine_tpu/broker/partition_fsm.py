@@ -68,9 +68,7 @@ class PartitionFsm:
             # and let the leader re-send the snapshot.
             log.warning("g=%d interrupted snapshot restore detected; "
                         "resetting replica log", group)
-            self.log.wipe()
-            kv.put(self._key, struct.pack(">QQ", 0, 0))
-            kv.delete(self._rkey)
+            self._reset_replica()
             return
         raw = kv.get(self._key)
         if raw is not None:
@@ -84,9 +82,7 @@ class PartitionFsm:
                 log.warning(
                     "g=%d log end %d < recorded %d (lost prefix); "
                     "resetting replica log", group, actual_end, recorded_end)
-                self.log.wipe()
-                self._applied = 0
-                kv.put(self._key, struct.pack(">QQ", 0, 0))
+                self._reset_replica()
             elif actual_end > recorded_end:
                 # Crash after log.append but before the position record: the
                 # block right after _applied is already in the log. Exactly
@@ -97,6 +93,16 @@ class PartitionFsm:
                     "g=%d torn append detected (log end %d > recorded %d); "
                     "first replayed block will be skipped",
                     group, actual_end, recorded_end)
+
+    def _reset_replica(self) -> None:
+        """The ONE wipe-and-reset sequence (crash-recovery paths share it so
+        their ordering can never diverge): empty log, zero position record,
+        clear any restore-intent marker."""
+        self.log.wipe()
+        self._applied = 0
+        self._skip_torn = False
+        self.kv.put(self._key, struct.pack(">QQ", 0, 0))
+        self.kv.delete(self._rkey)
 
     # Engine replay contract: blocks in (applied_id(), committed] are
     # re-applied through transition_block at registration time.
@@ -159,17 +165,12 @@ class PartitionFsm:
         return b"".join(out)
 
     def restore(self, data: bytes) -> None:
-        """Replace the local log with a snapshot payload (or reset it with
-        ``b""``). Frames are fully validated BEFORE the wipe so a malformed
-        payload from the wire rejects without touching durable state."""
-        if not data:
-            self.kv.put(self._rkey, b"1")
-            self.log.wipe()
-            self._applied = 0
-            self._skip_torn = False
-            self.kv.put(self._key, struct.pack(">QQ", 0, 0))
-            self.kv.delete(self._rkey)
-            return
+        """Replace the local log with a snapshot payload. Frames are fully
+        validated BEFORE the wipe so a malformed payload from the wire
+        rejects without touching durable state — including the empty
+        payload: restore() is wire-reachable, so an unconditional
+        empty-means-reset branch would let a degenerate MSG_SNAPSHOT wipe a
+        healthy replica (internal resets use _reset_replica)."""
         if len(data) < 16:
             raise ValueError("partition snapshot shorter than its manifest")
         applied, end = struct.unpack_from(">QQ", data)

@@ -245,6 +245,14 @@ class RaftEngine:
         # snapshot id moves) so resends to lagging followers don't rebuild
         # the log prefix every interval.
         self._export_cache: dict[int, tuple[int, bytes]] = {}
+        # Chunked snapshot transfer state. Sender: (g, dst) -> (snap_id,
+        # next byte offset), advanced by acks. Receiver: g -> (snap_id,
+        # total, staged buffer). Acks are queued here and drained into the
+        # next tick's outbound (receive() has no send channel of its own).
+        self.snap_chunk_bytes = 4 << 20
+        self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
+        self._snap_staging: dict[int, tuple[int, int, bytearray]] = {}
+        self._snap_acks: list[rpc.WireMsg] = []
 
         # Restart recovery for snapshot-capable FSMs: restore the latest
         # snapshot, then replay the committed suffix (snap, commit] — the
@@ -356,7 +364,10 @@ class RaftEngine:
             self._receive_batch(msg)
             return
         if msg.kind == rpc.MSG_SNAPSHOT:
-            self._install_snapshot(msg)
+            self._stage_snapshot(msg)
+            return
+        if msg.kind == rpc.MSG_SNAPSHOT_ACK:
+            self._handle_snap_ack(msg)
             return
         if msg.kind not in _CONSENSUS_KIND_SET:
             raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
@@ -632,6 +643,11 @@ class RaftEngine:
             res.conf_changes.extend(self._conf_notify)
             self._conf_notify.clear()
         res.outbound = self._decode_outbox(ov)
+        if self._snap_acks:
+            # Snapshot-transfer acks queued by receive() (which has no send
+            # channel of its own) ride this tick's outbound.
+            res.outbound.extend(self._snap_acks)
+            self._snap_acks.clear()
         self._ticks += 1
         self._maybe_snapshot()
         _m_ticks.inc(node=self.self_id)
@@ -993,15 +1009,103 @@ class RaftEngine:
             if due:
                 self.take_snapshot(g)
 
-    def _install_snapshot(self, msg: rpc.WireMsg) -> None:
-        """Follower side: adopt a leader snapshot we cannot reach by log
-        replay (our head fell below the leader's truncation floor)."""
+    def _stage_snapshot(self, msg: rpc.WireMsg) -> None:
+        """Receiver side of the chunked snapshot transfer: accumulate
+        in-order chunks per group, ack progress back to the sender, and
+        install once the buffer covers the advertised total. Out-of-order
+        or duplicate chunks are ignored (the re-ack re-synchronizes the
+        sender's pointer); a sender restart with a NEWER snapshot id resets
+        the staging buffer."""
         g = msg.group
-        if not (0 <= g < self.P):
+        if not (0 <= g < self.P) or not (0 <= msg.src < self.N):
+            return
+        if self.drivers.get(g) is None and g != 0:
+            # No FSM wired for this data group yet (restart re-wiring races
+            # the leader's send): don't stage and don't ack — an ack here
+            # would make the sender tear down its transfer state and
+            # re-stream the whole export from offset 0 every tick until
+            # register_fsm happens. Silence keeps the sender's resend
+            # throttle pacing it at one chunk per window.
+            log.warning("deferring snapshot g=%d: no FSM registered yet", g)
             return
         ch = self.chains[g]
         if msg.x <= ch.committed:
-            return  # stale: we already have this prefix
+            # Stale: we already hold this prefix — tell the sender to stop.
+            self._snap_staging.pop(g, None)
+            self._snap_acks.append(rpc.WireMsg(
+                kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
+                x=msg.x, y=msg.z, ok=1))
+            return
+        total = msg.z if msg.z else len(msg.payload)
+        if msg.y == 0 and len(msg.payload) >= total:
+            # Single-frame transfer (small snapshots): install directly.
+            # ok=1 only on a successful install — acking a failed one would
+            # tear down the sender's state and trigger a full re-stream.
+            self._snap_staging.pop(g, None)
+            if self._install_snapshot(msg, msg.payload):
+                self._snap_acks.append(rpc.WireMsg(
+                    kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
+                    dst=msg.src, x=msg.x, y=total, ok=1))
+            return
+        st = self._snap_staging.get(g)
+        if st is None or st[0] != msg.x or st[1] != total:
+            st = (msg.x, total, bytearray())
+            self._snap_staging[g] = st
+        buf = st[2]
+        if msg.y == len(buf) and msg.payload:
+            buf += msg.payload
+            if len(buf) > total:
+                log.warning("snapshot staging overflow g=%d (%d > %d); reset",
+                            g, len(buf), total)
+                self._snap_staging.pop(g, None)
+                return
+        if len(buf) >= total:
+            self._snap_staging.pop(g, None)
+            if self._install_snapshot(msg, bytes(buf)):
+                self._snap_acks.append(rpc.WireMsg(
+                    kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
+                    dst=msg.src, x=msg.x, y=total, ok=1))
+            return
+        self._snap_acks.append(rpc.WireMsg(
+            kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
+            x=msg.x, y=len(buf), ok=0))
+
+    def _handle_snap_ack(self, msg: rpc.WireMsg) -> None:
+        """Sender side: an ack advances the per-(group, dst) transfer
+        pointer and lifts the resend throttle so the next chunk ships on
+        the next tick; ok=1 (installed / already-current) ends the
+        transfer. A regressed ack (receiver restarted mid-transfer) rolls
+        the pointer back."""
+        key = (msg.group, msg.src)
+        ptr = self._snap_send_off.get(key)
+        if ptr is None or ptr[0] != msg.x:
+            return
+        if msg.ok:
+            self._snap_send_off.pop(key, None)
+            self._snap_sent_tick.pop(key, None)
+            if not any(k[0] == msg.group for k in self._snap_send_off):
+                # Last in-flight transfer for this group finished: free the
+                # materialized export (it can be the whole log prefix).
+                self._export_cache.pop(msg.group, None)
+            return
+        self._snap_send_off[key] = (msg.x, msg.y)
+        self._snap_sent_tick.pop(key, None)
+
+    def _install_snapshot(self, msg: rpc.WireMsg, payload: bytes | None = None) -> bool:
+        """Follower side: adopt a leader snapshot we cannot reach by log
+        replay (our head fell below the leader's truncation floor).
+        ``payload`` is the assembled transfer (defaults to the message's own
+        payload for single-frame installs). Returns True only when the
+        snapshot actually installed (the receiver acks ok=1 on that alone).
+        """
+        if payload is None:
+            payload = msg.payload
+        g = msg.group
+        if not (0 <= g < self.P):
+            return False
+        ch = self.chains[g]
+        if msg.x <= ch.committed:
+            return False  # stale: we already have this prefix
         drv = self.drivers.get(g)
         if drv is None and g != 0:
             # No FSM wired for a data group yet (restart re-wiring races the
@@ -1010,24 +1114,24 @@ class RaftEngine:
             # skipped at register_fsm time and this replica's log would stay
             # empty forever. Drop; the leader re-sends past its throttle.
             log.warning("deferring snapshot g=%d: no FSM registered yet", g)
-            return
-        snap_record = msg.payload
+            return False
+        snap_record = payload
         if drv is not None:
             if not supports_snapshot(drv.fsm):
                 log.warning(
                     "cannot install snapshot g=%d: FSM has no restore()", g)
-                return
+                return False
             # Fail (not cancel) outstanding proposals so clients re-route,
             # same as the tick() leadership-loss path; msg.src is the leader.
             drv.drop_waiters(NotLeader(g, msg.src))
             try:
-                drv.fsm.restore(msg.payload)
+                drv.fsm.restore(payload)
             except ValueError as e:
                 # Malformed payload (restore validates before mutating its
                 # own state): reject without touching the chain — same
                 # degrade-not-crash rule as poison conf blocks.
                 log.error("rejecting snapshot g=%d from %d: %s", g, msg.src, e)
-                return
+                return False
             if callable(getattr(drv.fsm, "snapshot_export", None)):
                 # Export-style FSMs (PartitionFsm): the wire payload was
                 # materialized from the sender's log; durably record only
@@ -1089,7 +1193,8 @@ class RaftEngine:
                                    slot=m.slot)
                         for m in self.members.by_id.values())
         _m_installs.inc(node=self.self_id)
-        log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(msg.payload))
+        log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(payload))
+        return True
 
     # ------------------------------------------------------------ helpers
 
@@ -1226,7 +1331,7 @@ class RaftEngine:
                     # reject/re-root loop alive, so once the follower has
                     # installed, its reject hint (= snapshot id) re-roots
                     # our send pointer above the floor within 2 ticks.
-                    snap = self._snapshot_msg(grp, dst, int(tcol[i]), mz)
+                    snap = self._snapshot_msg(grp, dst, int(tcol[i]))
                     if snap is not None:
                         out.append(snap)
                     by[i] = mx
@@ -1269,10 +1374,17 @@ class RaftEngine:
                 nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))
         return out
 
-    def _snapshot_msg(self, g: int, dst: int, term: int, z: int) -> rpc.WireMsg | None:
+    def _snapshot_msg(self, g: int, dst: int, term: int) -> rpc.WireMsg | None:
+        """Next chunk of the snapshot transfer to ``dst`` (or None). The
+        per-(g, dst) pointer advances on acks — an acked chunk ships its
+        successor on the very next tick; an unacked one is re-sent after
+        the throttle window. Chunking (snap_chunk_bytes) keeps every frame
+        bounded no matter how large the exported log prefix is (a single
+        frame would hit the transport's frame cap and could never sync a
+        big partition)."""
         last = self._snap_sent_tick.get((g, dst))
         if last is not None and self._ticks - last < 5:
-            return None  # in flight; don't spam the big payload every tick
+            return None  # chunk in flight; wait for its ack or the window
         snap_id, data = self._load_snapshot(g)
         if snap_id is None or snap_id != self.chains[g].floor:
             log.warning("no usable snapshot for floor %#x g=%d",
@@ -1303,11 +1415,21 @@ class RaftEngine:
                     log.error("cannot export snapshot g=%d: %s", g, e)
                     return None
                 self._export_cache[g] = (snap_id, data)
+        total = len(data)
+        ptr = self._snap_send_off.get((g, dst))
+        off = ptr[1] if ptr is not None and ptr[0] == snap_id else 0
+        if off >= total and total > 0:
+            # Fully sent but the follower is still below the floor (final
+            # ack lost, or the follower restarted): restart the transfer.
+            off = 0
+        chunk = data[off:off + self.snap_chunk_bytes]
+        final = off + len(chunk) >= total
+        self._snap_send_off[(g, dst)] = (snap_id, off)
         self._snap_sent_tick[(g, dst)] = self._ticks
-        # Group 0 snapshots carry the member table: the receiving node may
-        # have missed conf blocks that are now below our truncation floor.
-        aux = (self.kv.get(MemberTable.KEY) or b"") if g == 0 else b""
+        # Group 0 snapshots carry the member table on the installing chunk:
+        # the receiver may have missed conf blocks now below our floor.
+        aux = (self.kv.get(MemberTable.KEY) or b"") if (g == 0 and final) else b""
         return rpc.WireMsg(
             kind=rpc.MSG_SNAPSHOT, group=g, src=self.me, dst=dst,
-            term=term, x=snap_id, z=z, payload=data, aux=aux,
+            term=term, x=snap_id, y=off, z=total, payload=chunk, aux=aux,
         )

@@ -37,14 +37,22 @@ from josefine_tpu.models.types import (  # noqa: E402
 # Host-only kinds (never enter the device inbox).
 MSG_CLIENT_REQ = 10
 MSG_CLIENT_RESP = 11
-# InstallSnapshot: x = snapshot block id, z = leader commit, payload = FSM
-# state dump. Handled entirely host-side; the follower's device row is
-# re-pointed at the snapshot id afterwards (the reference's never-constructed
-# Progress<Snapshot> path, src/raft/progress.rs:182-203, made real).
+# InstallSnapshot chunk: x = snapshot block id, y = chunk byte offset,
+# z = total export length, payload = this chunk's bytes, aux (final chunk
+# only, group 0) = serialized member table. Large state dumps ship as a
+# sequence of bounded chunks — never one frame-cap-breaking message — each
+# acked by the receiver (MSG_SNAPSHOT_ACK) to advance the sender's pointer.
+# Handled entirely host-side; the follower's device row is re-pointed at
+# the snapshot id after the final chunk installs (the reference's
+# never-constructed Progress<Snapshot> path, src/raft/progress.rs:182-203,
+# made real).
 MSG_SNAPSHOT = 12
 # Columnar consensus batch: ALL of one node's consensus traffic to one peer
 # for one tick in a single binary frame (see MsgBatch).
 MSG_BATCH = 13
+# Snapshot transfer ack: x = snapshot block id, y = bytes staged so far,
+# ok = 1 once the snapshot installed (sender drops its transfer pointer).
+MSG_SNAPSHOT_ACK = 14
 
 
 @dataclass

@@ -65,8 +65,12 @@ def test_partition_snapshot_export_restore_roundtrip(tmp_path):
     _apply_batches(dst, 2, start_seq=7)
     assert dst.log.read_from(0, 1 << 20) == src.log.read_from(0, 1 << 20)
 
-    # restore(b"") resets to empty.
-    dst.restore(b"")
+    # restore() is wire-reachable: an empty payload must NOT silently wipe
+    # a healthy replica (internal resets go through _reset_replica).
+    with pytest.raises(ValueError):
+        dst.restore(b"")
+    assert dst.log.next_offset() == src.log.next_offset()
+    dst._reset_replica()
     assert dst.applied_id() == 0 and dst.log.next_offset() == 0
 
 
@@ -346,6 +350,61 @@ def test_reset_replica_resyncs_from_leader(tmp_path):
     asyncio.run(main())
 
 
+def test_log_sync_is_chunked(tmp_path):
+    """A large export ships as bounded chunks (never one frame-cap-breaking
+    message): force a tiny chunk size on the leader and verify the follower
+    assembles the full log from many acked chunks."""
+    async def main():
+        from josefine_tpu.raft import rpc
+
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        lead = _leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+        engines[lead].snap_chunk_bytes = 128
+
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(
+                1, records.build_batch(b"payload-%d" % i * 4, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        lc = engines[lead].chains[1]
+        assert lc.floor > GENESIS
+        # Expected transfer size comes from the STORED snapshot record (the
+        # manifest at take time), not the FSM's current position.
+        stored_manifest = kvs[lead].get(b"g1:snap")[8:]
+        export_len = len(pfsms[lead].snapshot_export(stored_manifest))
+        assert export_len > 128  # guarantees a multi-chunk transfer
+
+        # Heal, routing by hand so snapshot chunks can be observed.
+        chunks = []
+        for _ in range(200):
+            for i, e in enumerate(engines):
+                res = e.tick()
+                for m in res.outbound:
+                    if getattr(m, "kind", None) == rpc.MSG_SNAPSHOT and m.group == 1:
+                        chunks.append((m.y, len(m.payload), m.z))
+                        assert len(m.payload) <= 128
+                    if m.dst < len(engines):
+                        engines[m.dst].receive(m)
+            if engines[follower].chains[1].committed >= lc.floor:
+                break
+        offsets = sorted({c[0] for c in chunks})
+        assert len(offsets) >= 2, chunks  # actually transferred in pieces
+        assert all(c[2] == export_len for c in chunks)
+        _run(engines, 20)
+        assert (pfsms[follower].log.read_from(0, 1 << 20)
+                == pfsms[lead].log.read_from(0, 1 << 20))
+        assert engines[follower].chains[1].committed == engines[lead].chains[1].committed
+        # Transfer bookkeeping is torn down on completion.
+        assert (1, follower) not in engines[lead]._snap_send_off
+        assert 1 not in engines[follower]._snap_staging
+
+    asyncio.run(main())
+
+
 def test_snapshot_send_deferred_without_fsm(tmp_path):
     """Ship-side mirror of the receive deferral: a manifest-style record
     cannot be exported without the FSM, so the send must wait, not ship the
@@ -365,12 +424,12 @@ def test_snapshot_send_deferred_without_fsm(tmp_path):
             await f
         assert e.chains[1].floor > GENESIS
         term = e.term(1)
-        assert e._snapshot_msg(1, 0, term, 0) is not None
+        assert e._snapshot_msg(1, 0, term) is not None
         e._snap_sent_tick.clear()
         del e.drivers[1]
-        assert e._snapshot_msg(1, 0, term, 0) is None  # deferred
+        assert e._snapshot_msg(1, 0, term) is None  # deferred
         e.drivers[1] = __import__("josefine_tpu.raft.fsm", fromlist=["Driver"]).Driver(pf)
-        assert e._snapshot_msg(1, 0, term, 0) is not None
+        assert e._snapshot_msg(1, 0, term) is not None
 
     asyncio.run(main())
 
