@@ -144,3 +144,20 @@ def test_torn_tail_record_discarded_on_recovery(tmp_path):
     assert lg2.read(0) == (0, 1, b"good-record")
     assert lg2.read(1) is None
     assert lg2.append(b"replacement") == 1
+
+
+def test_crc32_matches_zlib():
+    """The record checksum is the standard CRC-32 (zlib polynomial, init
+    and final xor) — pins on-disk compatibility across implementation
+    changes (e.g. the slice-by-8 rewrite)."""
+    import random
+    import zlib
+
+    from josefine_tpu import native
+
+    mod = native.load("seglog")
+    rng = random.Random(7)
+    cases = [b"", b"a", b"abc", bytes(range(256))]
+    cases += [rng.randbytes(n) for n in (7, 8, 9, 63, 64, 65, 1000, 65536)]
+    for data in cases:
+        assert mod.crc32(data) == zlib.crc32(data), len(data)
