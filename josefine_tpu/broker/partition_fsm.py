@@ -28,10 +28,14 @@ a 16-byte manifest ``(applied_id, log_end_offset)`` that the engine stores
 as the group's snapshot record and uses to truncate the chain (the record
 batches below the floor already live in the seglog). When a follower falls
 below the truncation floor, the engine materializes the wire payload
-lazily via ``snapshot_export`` — manifest + the framed log prefix — and the
+lazily via ``snapshot_export`` — header + framed log span — and the
 follower's ``restore`` rebuilds its log byte-for-byte (Kafka-style replica
 log sync, which the reference has no analog of: its followers hold empty
-logs forever, ``src/broker/handler/produce.rs:11-36``).
+logs forever, ``src/broker/handler/produce.rs:11-36``). Sync is
+incremental: the engine probes ``snapshot_resume_offset`` first and ships
+only the suffix the follower is missing — both logs are the same
+deterministic fold of the committed block sequence, so the prefix below
+the follower's log end is already identical.
 """
 
 from __future__ import annotations
@@ -135,19 +139,29 @@ class PartitionFsm:
         can be truncated and a restore knows what prefix to expect."""
         return struct.pack(">QQ", self._applied, self.log.next_offset())
 
-    def snapshot_export(self, record: bytes) -> bytes:
+    def snapshot_resume_offset(self) -> int:
+        """Where an incremental log sync may resume: everything below our
+        log end is byte-identical to the leader's (both logs are the same
+        deterministic fold of the committed block sequence), so a transfer
+        only needs the suffix from here."""
+        return self.log.next_offset()
+
+    def snapshot_export(self, record: bytes, start: int = 0) -> bytes:
         """Materialize the wire payload for InstallSnapshot from a stored
-        manifest: the manifest followed by ``(base, count, len, bytes)``
-        frames covering the log prefix ``[0, log_end)``. Called lazily at
-        ship time (engine ``_snapshot_msg``) so the big payload is never
-        stored twice."""
+        manifest: a 24-byte header ``(applied, end, start)`` followed by
+        ``(base, count, len, bytes)`` frames covering the log span
+        ``[start, log_end)``. ``start > 0`` is the incremental form (the
+        receiver reported its resume position); 0 ships the full prefix.
+        Called lazily at ship time (engine ``_snapshot_msg``) so the big
+        payload is never stored twice."""
         if len(record) != 16:
             raise ValueError(
                 f"g={self.group} snapshot record is {len(record)} bytes, "
                 "expected a 16-byte manifest")
         applied, end = struct.unpack(">QQ", record)
-        out = [struct.pack(">QQ", applied, end)]
-        off = 0
+        start = min(max(0, start), end)
+        out = [struct.pack(">QQQ", applied, end, start)]
+        off = start
         done = False
         while off < end and not done:
             blobs = self.log.read_from(off, 4 << 20)
@@ -159,23 +173,38 @@ class PartitionFsm:
                 if base >= end:
                     done = True
                     break
+                if base != off:
+                    # A resume hint that is not one of OUR blob boundaries
+                    # cannot be served (the receiver's log diverges).
+                    raise ValueError(
+                        f"g={self.group} resume offset {off} is not a blob "
+                        f"boundary (nearest base {base})")
                 out.append(struct.pack(">QII", base, count, len(payload)))
                 out.append(payload)
                 off = base + (count or 1)
         return b"".join(out)
 
     def restore(self, data: bytes) -> None:
-        """Replace the local log with a snapshot payload. Frames are fully
-        validated BEFORE the wipe so a malformed payload from the wire
-        rejects without touching durable state — including the empty
-        payload: restore() is wire-reachable, so an unconditional
+        """Adopt a snapshot payload: ``start == 0`` replaces the whole log;
+        ``start > 0`` is an incremental sync appending the missing suffix
+        (only valid when it begins exactly at our log end — both logs are
+        the same deterministic fold, so the prefix is already identical).
+        Frames are fully validated BEFORE any mutation so a malformed
+        payload from the wire rejects without touching durable state —
+        including the empty payload: restore() is wire-reachable, so an
         empty-means-reset branch would let a degenerate MSG_SNAPSHOT wipe a
         healthy replica (internal resets use _reset_replica)."""
-        if len(data) < 16:
-            raise ValueError("partition snapshot shorter than its manifest")
-        applied, end = struct.unpack_from(">QQ", data)
+        if len(data) < 24:
+            raise ValueError("partition snapshot shorter than its header")
+        applied, end, start = struct.unpack_from(">QQQ", data)
+        if start > end:
+            raise ValueError(f"snapshot start {start} beyond end {end}")
+        if start > 0 and start != self.log.next_offset():
+            raise ValueError(
+                f"incremental snapshot starts at {start}, local log end is "
+                f"{self.log.next_offset()}")
         frames: list[tuple[int, bytes]] = []
-        pos, off = 16, 0
+        pos, off = 24, start
         while pos < len(data):
             if pos + 16 > len(data):
                 raise ValueError("truncated snapshot frame header")
@@ -185,7 +214,7 @@ class PartitionFsm:
                 raise ValueError("truncated snapshot frame payload")
             if count < 1:
                 # The seglog rejects count < 1 at append time; catching it
-                # here keeps the validate-before-wipe contract honest.
+                # here keeps the validate-before-mutate contract honest.
                 raise ValueError(f"snapshot frame at {base} has count 0")
             if base != off:
                 raise ValueError(
@@ -195,13 +224,15 @@ class PartitionFsm:
             off = base + (count or 1)
         if off != end:
             raise ValueError(
-                f"snapshot frames end at {off}, manifest claims {end}")
-        # Restore-intent marker: the wipe-to-position-record window is not
-        # crash-atomic (the torn-append detector covers exactly one trailing
-        # append, not a rebuild). A crash inside it is detected at boot and
-        # degrades to an empty replica the leader re-syncs.
+                f"snapshot frames end at {off}, header claims {end}")
+        # Restore-intent marker: neither the wipe-and-rebuild nor the
+        # multi-frame suffix append is crash-atomic (the torn-append
+        # detector covers exactly one trailing append). A crash inside the
+        # window is detected at boot and degrades to an empty replica the
+        # leader re-syncs.
         self.kv.put(self._rkey, b"1")
-        self.log.wipe()
+        if start == 0:
+            self.log.wipe()
         for count, payload in frames:
             self.log.append(payload, count=count)
         self._applied = applied

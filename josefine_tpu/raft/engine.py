@@ -241,17 +241,20 @@ class RaftEngine:
         self._last_snap_tick: dict[int, int] = {}
         self._snap_sent_tick: dict[tuple[int, int], int] = {}
         self._snap_cache: dict[int, tuple[int, bytes]] = {}
-        # Materialized export payloads (one per group, replaced when the
-        # snapshot id moves) so resends to lagging followers don't rebuild
-        # the log prefix every interval.
-        self._export_cache: dict[int, tuple[int, bytes]] = {}
         # Chunked snapshot transfer state. Sender: (g, dst) -> (snap_id,
-        # next byte offset), advanced by acks. Receiver: g -> (snap_id,
-        # total, staged buffer). Acks are queued here and drained into the
-        # next tick's outbound (receive() has no send channel of its own).
+        # next byte offset; -1 = position probe outstanding), advanced by
+        # acks; the materialized (suffix) export lives per transfer in
+        # _snap_payload; (g, dst) -> last-ack tick ages out transfers to
+        # dead/removed followers. Receiver: g -> (snap_id, total, staged
+        # buffer). Acks are queued here and drained into the next tick's
+        # outbound (receive() has no send channel of its own).
         self.snap_chunk_bytes = 4 << 20
+        self.snap_transfer_stale_ticks = 200
         self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
+        self._snap_payload: dict[tuple[int, int], bytes] = {}
+        self._snap_ack_tick: dict[tuple[int, int], int] = {}
         self._snap_staging: dict[int, tuple[int, int, bytearray]] = {}
+        self._snap_stage_tick: dict[int, int] = {}
         self._snap_acks: list[rpc.WireMsg] = []
 
         # Restart recovery for snapshot-capable FSMs: restore the latest
@@ -648,6 +651,8 @@ class RaftEngine:
             # channel of its own) ride this tick's outbound.
             res.outbound.extend(self._snap_acks)
             self._snap_acks.clear()
+        if self._snap_send_off or self._snap_staging:
+            self._gc_snap_transfers()
         self._ticks += 1
         self._maybe_snapshot()
         _m_ticks.inc(node=self.self_id)
@@ -862,6 +867,7 @@ class RaftEngine:
         ch.reset()
         self.kv.delete(b"g%d:snap" % g)
         self._snap_cache.pop(g, None)
+        self._drop_group_transfers(g)
         self._h_head[g] = GENESIS
         self._h_commit[g] = GENESIS
         z = jnp.asarray(0, _I32)
@@ -876,6 +882,7 @@ class RaftEngine:
         drv = self.drivers.pop(g, None)
         if drv is not None:
             drv.drop_waiters(NotLeader(g, -1))
+        self._drop_group_transfers(g)
 
     def _safe_conf_apply(self, blk) -> ConfChange | None:
         """Decode + apply one committed conf block to the member table.
@@ -1036,6 +1043,19 @@ class RaftEngine:
                 kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
                 x=msg.x, y=msg.z, ok=1))
             return
+        if msg.ok:
+            # Position probe: reply with where an incremental sync may
+            # resume (export-style FSMs — everything below our log end is
+            # already identical to the sender's); nothing is staged.
+            drv = self.drivers.get(g)
+            hint = (getattr(drv.fsm, "snapshot_resume_offset", None)
+                    if drv else None)
+            resume = int(hint()) if callable(hint) else 0
+            self._snap_staging.pop(g, None)
+            self._snap_acks.append(rpc.WireMsg(
+                kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
+                x=msg.x, y=0, z=resume, ok=0))
+            return
         total = msg.z if msg.z else len(msg.payload)
         if msg.y == 0 and len(msg.payload) >= total:
             # Single-frame transfer (small snapshots): install directly.
@@ -1051,6 +1071,7 @@ class RaftEngine:
         if st is None or st[0] != msg.x or st[1] != total:
             st = (msg.x, total, bytearray())
             self._snap_staging[g] = st
+        self._snap_stage_tick[g] = self._ticks
         buf = st[2]
         if msg.y == len(buf) and msg.payload:
             buf += msg.payload
@@ -1080,16 +1101,78 @@ class RaftEngine:
         ptr = self._snap_send_off.get(key)
         if ptr is None or ptr[0] != msg.x:
             return
+        self._snap_ack_tick[key] = self._ticks
         if msg.ok:
-            self._snap_send_off.pop(key, None)
-            self._snap_sent_tick.pop(key, None)
-            if not any(k[0] == msg.group for k in self._snap_send_off):
-                # Last in-flight transfer for this group finished: free the
-                # materialized export (it can be the whole log prefix).
-                self._export_cache.pop(msg.group, None)
+            self._drop_transfer(key)
+            return
+        if ptr[1] == -1:
+            # Position-probe reply: the follower's resume offset rides in
+            # z. Build the (suffix) export and start streaming — the whole
+            # point of the probe is that a follower that already holds a
+            # log prefix only receives the missing suffix.
+            g = msg.group
+            drv = self.drivers.get(g)
+            exp = getattr(drv.fsm, "snapshot_export", None) if drv else None
+            if not callable(exp):
+                self._drop_transfer(key)
+                return
+            snap_id, record = self._load_snapshot(g)
+            if snap_id != ptr[0]:
+                # The snapshot moved while probing; restart next round.
+                self._drop_transfer(key)
+                return
+            try:
+                payload = exp(record, int(msg.z))
+            except (ValueError, OSError) as e:
+                log.error("cannot export snapshot g=%d from %d: %s",
+                          g, msg.z, e)
+                self._drop_transfer(key)
+                return
+            self._snap_payload[key] = payload
+            self._snap_send_off[key] = (ptr[0], 0)
+            self._snap_sent_tick.pop(key, None)  # first chunk next tick
+            return
+        if msg.y <= ptr[1]:
+            # No forward progress: the receiver's staging restarted (it
+            # crashed/reset mid-transfer). A pinned suffix export may now be
+            # unservable there (its start no longer matches the replica's
+            # log end), so rolling the pointer back would loop forever —
+            # drop the transfer and re-probe the resume position fresh.
+            self._drop_transfer(key)
             return
         self._snap_send_off[key] = (msg.x, msg.y)
         self._snap_sent_tick.pop(key, None)
+
+    def _drop_transfer(self, key: tuple[int, int]) -> None:
+        self._snap_send_off.pop(key, None)
+        self._snap_payload.pop(key, None)
+        self._snap_sent_tick.pop(key, None)
+        self._snap_ack_tick.pop(key, None)
+
+    def _gc_snap_transfers(self) -> None:
+        """Age out transfers whose peer has gone quiet (crashed or
+        removed): sender state would otherwise pin exported payloads
+        forever, and receiver staging buffers (up to export-sized) would
+        leak when the sending leader dies mid-transfer. A returning peer
+        restarts its transfer with a fresh probe."""
+        for k in [k for k in self._snap_send_off
+                  if self._ticks - self._snap_ack_tick.get(k, 0)
+                  > self.snap_transfer_stale_ticks]:
+            self._drop_transfer(k)
+        for g in [g for g in self._snap_staging
+                  if self._ticks - self._snap_stage_tick.get(g, 0)
+                  > self.snap_transfer_stale_ticks]:
+            self._snap_staging.pop(g, None)
+            self._snap_stage_tick.pop(g, None)
+
+    def _drop_group_transfers(self, g: int) -> None:
+        """Purge ALL transfer state touching group ``g`` (both sides): a
+        group being unregistered or reset must not leak a previous
+        incarnation's export into a future topic claiming the same row."""
+        for k in [k for k in self._snap_send_off if k[0] == g]:
+            self._drop_transfer(k)
+        self._snap_staging.pop(g, None)
+        self._snap_stage_tick.pop(g, None)
 
     def _install_snapshot(self, msg: rpc.WireMsg, payload: bytes | None = None) -> bool:
         """Follower side: adopt a leader snapshot we cannot reach by log
@@ -1374,17 +1457,34 @@ class RaftEngine:
                 nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))
         return out
 
+    def _probe_msg(self, g: int, dst: int, term: int, snap_id: int) -> rpc.WireMsg:
+        """Position probe (ok=1, empty payload): asks the follower where an
+        incremental log sync may resume; its ack carries the offset in z."""
+        self._snap_send_off[(g, dst)] = (snap_id, -1)
+        self._snap_payload.pop((g, dst), None)
+        self._snap_ack_tick.setdefault((g, dst), self._ticks)
+        self._snap_sent_tick[(g, dst)] = self._ticks
+        return rpc.WireMsg(kind=rpc.MSG_SNAPSHOT, group=g, src=self.me,
+                           dst=dst, term=term, x=snap_id, ok=1)
+
     def _snapshot_msg(self, g: int, dst: int, term: int) -> rpc.WireMsg | None:
-        """Next chunk of the snapshot transfer to ``dst`` (or None). The
-        per-(g, dst) pointer advances on acks — an acked chunk ships its
-        successor on the very next tick; an unacked one is re-sent after
-        the throttle window. Chunking (snap_chunk_bytes) keeps every frame
-        bounded no matter how large the exported log prefix is (a single
-        frame would hit the transport's frame cap and could never sync a
-        big partition)."""
-        last = self._snap_sent_tick.get((g, dst))
+        """Next message of the snapshot transfer to ``dst`` (or None).
+
+        Export-style FSMs (the partition data plane) get incremental log
+        sync: a position probe first, then ONLY the suffix the follower is
+        missing, in bounded chunks (snap_chunk_bytes — a single frame would
+        hit the transport's frame cap and could never sync a big
+        partition). The per-(g, dst) pointer advances on acks — an acked
+        chunk ships its successor on the very next tick; an unacked one
+        re-sends after the throttle window. An in-flight transfer keeps
+        shipping its own pinned payload even if a newer snapshot lands
+        mid-transfer (restarting at 0 on every floor advance would never
+        converge under sustained writes); the next transfer then starts
+        from the follower's new, higher resume offset."""
+        key = (g, dst)
+        last = self._snap_sent_tick.get(key)
         if last is not None and self._ticks - last < 5:
-            return None  # chunk in flight; wait for its ack or the window
+            return None  # message in flight; wait for its ack or the window
         snap_id, data = self._load_snapshot(g)
         if snap_id is None or snap_id != self.chains[g].floor:
             log.warning("no usable snapshot for floor %#x g=%d",
@@ -1399,33 +1499,33 @@ class RaftEngine:
             log.warning("deferring snapshot send g=%d: no FSM registered", g)
             return None
         exp = getattr(drv.fsm, "snapshot_export", None) if drv else None
+        ptr = self._snap_send_off.get(key)
         if callable(exp):
-            # Export-style FSMs store only a manifest; the actual payload
-            # (the log prefix) is read from the local log at ship time.
-            # Cached per group keyed by snapshot id — the prefix below a
-            # given snapshot is immutable, and a lagging follower retriggers
-            # this every resend interval until it catches up.
-            cached = self._export_cache.get(g)
-            if cached is not None and cached[0] == snap_id:
-                data = cached[1]
-            else:
-                try:
-                    data = exp(data)
-                except (ValueError, OSError) as e:
-                    log.error("cannot export snapshot g=%d: %s", g, e)
-                    return None
-                self._export_cache[g] = (snap_id, data)
+            payload = self._snap_payload.get(key)
+            if ptr is None or (ptr[1] >= 0 and payload is None):
+                return self._probe_msg(g, dst, term, snap_id)
+            if ptr[1] == -1:
+                # Probe outstanding, ack lost: re-probe (at the current
+                # snapshot — nothing is in flight yet to pin).
+                return self._probe_msg(g, dst, term, snap_id)
+            # In-flight transfer: keep shipping ITS payload (ptr[0] may be
+            # an older, pinned snapshot id).
+            snap_id = ptr[0]
+            data = payload
         total = len(data)
-        ptr = self._snap_send_off.get((g, dst))
-        off = ptr[1] if ptr is not None and ptr[0] == snap_id else 0
+        off = ptr[1] if ptr is not None and ptr[0] == snap_id and ptr[1] >= 0 else 0
         if off >= total and total > 0:
             # Fully sent but the follower is still below the floor (final
             # ack lost, or the follower restarted): restart the transfer.
+            if callable(exp):
+                return self._probe_msg(g, dst, term,
+                                       self.chains[g].floor)
             off = 0
         chunk = data[off:off + self.snap_chunk_bytes]
         final = off + len(chunk) >= total
-        self._snap_send_off[(g, dst)] = (snap_id, off)
-        self._snap_sent_tick[(g, dst)] = self._ticks
+        self._snap_send_off[key] = (snap_id, off)
+        self._snap_ack_tick.setdefault(key, self._ticks)
+        self._snap_sent_tick[key] = self._ticks
         # Group 0 snapshots carry the member table on the installing chunk:
         # the receiver may have missed conf blocks now below our floor.
         aux = (self.kv.get(MemberTable.KEY) or b"") if (g == 0 and final) else b""

@@ -65,6 +65,19 @@ def test_partition_snapshot_export_restore_roundtrip(tmp_path):
     _apply_batches(dst, 2, start_seq=7)
     assert dst.log.read_from(0, 1 << 20) == src.log.read_from(0, 1 << 20)
 
+    # Incremental sync: a suffix export applies on top of the existing
+    # prefix (no wipe) and lands in the identical state.
+    _apply_batches(src, 3, start_seq=9)
+    resume = dst.log.next_offset()
+    suffix = src.snapshot_export(src.snapshot(), resume)
+    assert len(suffix) < len(src.snapshot_export(src.snapshot()))
+    dst.restore(suffix)
+    assert dst.applied_id() == src.applied_id()
+    assert dst.log.read_from(0, 1 << 20) == src.log.read_from(0, 1 << 20)
+    # A suffix that does not start at our log end is rejected untouched.
+    with pytest.raises(ValueError):
+        dst.restore(suffix)
+
     # restore() is wire-reachable: an empty payload must NOT silently wipe
     # a healthy replica (internal resets go through _reset_replica).
     with pytest.raises(ValueError):
@@ -86,9 +99,9 @@ def test_partition_restore_rejects_malformed_without_wiping(tmp_path):
 
     truncated = payload[:-3]
     gap = bytearray(payload)
-    struct.pack_into(">Q", gap, 16, 999)  # first frame base != 0
+    struct.pack_into(">Q", gap, 24, 999)  # first frame base != start
     zero_count = bytearray(payload)
-    struct.pack_into(">I", zero_count, 24, 0)  # first frame count = 0
+    struct.pack_into(">I", zero_count, 32, 0)  # first frame count = 0
     for bad in (payload[:10], truncated, bytes(gap), bytes(zero_count)):
         with pytest.raises(ValueError):
             dst.restore(bad)
@@ -384,7 +397,8 @@ def test_log_sync_is_chunked(tmp_path):
             for i, e in enumerate(engines):
                 res = e.tick()
                 for m in res.outbound:
-                    if getattr(m, "kind", None) == rpc.MSG_SNAPSHOT and m.group == 1:
+                    if (getattr(m, "kind", None) == rpc.MSG_SNAPSHOT
+                            and m.group == 1 and not m.ok):  # not a probe
                         chunks.append((m.y, len(m.payload), m.z))
                         assert len(m.payload) <= 128
                     if m.dst < len(engines):
@@ -401,6 +415,208 @@ def test_log_sync_is_chunked(tmp_path):
         # Transfer bookkeeping is torn down on completion.
         assert (1, follower) not in engines[lead]._snap_send_off
         assert 1 not in engines[follower]._snap_staging
+
+    asyncio.run(main())
+
+
+def test_pinned_transfer_converges_under_sustained_writes(tmp_path):
+    """A floor advance mid-transfer must not reset the follower to offset 0:
+    with writes arriving faster than a whole transfer completes, an unpinned
+    transfer restarts on every new snapshot and the follower never catches
+    up. The sender pins the in-flight export until it finishes."""
+    async def main():
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        lead = _leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+        engines[lead].snap_chunk_bytes = 128  # ~3 ticks per chunk
+
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(
+                1, records.build_batch(b"seed-%d" % i * 4, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        assert engines[lead].chains[1].floor > GENESIS
+
+        # Heal while writes continue: every 3 ticks a new proposal, so the
+        # snapshot threshold keeps re-crossing DURING the chunked transfer.
+        live = []
+        n = 0
+        for _ in range(100):
+            if engines[lead].is_leader(1):
+                live.append(engines[lead].propose(
+                    1, records.build_batch(b"live-%d" % n * 4, 1)))
+                n += 1
+            _run(engines, 3)
+        # Stop writing; the follower must converge (the final transfer
+        # ships the full export in ~128-byte chunks, one per ack round).
+        for _ in range(20):
+            _run(engines, 50)
+            if (engines[follower].chains[1].committed
+                    == engines[lead].chains[1].committed):
+                break
+        for fu in live:
+            if fu.done():
+                fu.exception()  # consume
+        assert engines[follower].chains[1].committed == engines[lead].chains[1].committed
+        assert (pfsms[follower].log.read_from(0, 1 << 21)
+                == pfsms[lead].log.read_from(0, 1 << 21))
+
+    asyncio.run(main())
+
+
+def test_second_catchup_is_incremental(tmp_path):
+    """A replica that already holds a log prefix receives ONLY the missing
+    suffix on its next catch-up (the position probe carries its resume
+    offset), not the full log again."""
+    async def main():
+        from josefine_tpu.raft import rpc
+
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        lead = _leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+
+        # Round 1: follower lags past the floor, catches up fully.
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(1, records.build_batch(b"a%d" % i, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        _run(engines, 60)
+        assert (pfsms[follower].log.read_from(0, 1 << 20)
+                == pfsms[lead].log.read_from(0, 1 << 20))
+        synced_end = pfsms[follower].log.next_offset()
+
+        # Round 2: lag again past a NEW floor.
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(1, records.build_batch(b"b%d" % i, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        assert engines[follower].chains[1].committed < engines[lead].chains[1].floor
+
+        # Heal: observed transfer totals must cover only the suffix.
+        full = len(pfsms[lead].snapshot_export(
+            kvs[lead].get(b"g1:snap")[8:]))
+        totals = []
+        for _ in range(200):
+            for i, e in enumerate(engines):
+                res = e.tick()
+                for m in res.outbound:
+                    if (getattr(m, "kind", None) == rpc.MSG_SNAPSHOT
+                            and m.group == 1 and not m.ok):
+                        totals.append(m.z)
+                    if m.dst < len(engines):
+                        engines[m.dst].receive(m)
+            if (engines[follower].chains[1].committed
+                    >= engines[lead].chains[1].floor):
+                break
+        assert totals, "no transfer observed"
+        assert max(totals) < full, (totals, full)
+        _run(engines, 20)
+        assert (pfsms[follower].log.read_from(0, 1 << 20)
+                == pfsms[lead].log.read_from(0, 1 << 20))
+        assert pfsms[follower].log.next_offset() > synced_end
+
+    asyncio.run(main())
+
+
+def test_follower_reset_mid_suffix_transfer_recovers(tmp_path):
+    """A follower that crashes mid-incremental-restore reboots as an EMPTY
+    replica (restore-intent marker), making the leader's pinned suffix
+    export unservable (its start no longer matches the replica's log end).
+    The leader must drop the transfer on the no-progress ack and re-probe
+    — not roll the pointer back and re-stream the doomed payload forever."""
+    async def main():
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        lead = _leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+        engines[lead].snap_chunk_bytes = 128
+
+        # Round 1: full sync so the follower holds a log prefix.
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(1, records.build_batch(b"a%d" % i, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 60)
+        for fu in futs:
+            await fu
+        assert pfsms[follower].log.next_offset() > 0
+
+        # Round 2: lag past a new floor, then let the suffix transfer begin.
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(1, records.build_batch(b"b%d" % i * 4, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        _run(engines, 10)  # probe + first chunk(s) in flight
+        assert engines[lead]._snap_payload, "suffix transfer never started"
+
+        # Crash the follower mid-restore: marker set -> reboot resets the
+        # replica, and register_fsm regresses the whole group.
+        kvs[follower].put(b"pfsm:r:1", b"1")
+        e2 = RaftEngine(kvs[follower], [1, 2, 3], follower + 1, groups=2,
+                        params=PARAMS, base_seed=55, snapshot_threshold=4)
+        pf2 = PartitionFsm(kvs[follower], 1, Log(tmp_path / ("n%d" % follower)))
+        assert pf2.log.next_offset() == 0
+        e2.register_fsm(1, pf2)
+        engines[follower] = e2
+        pfsms[follower] = pf2
+
+        # The leader must re-probe and fully re-sync the now-empty replica.
+        for _ in range(20):
+            _run(engines, 30)
+            if (engines[follower].chains[1].committed
+                    == engines[lead].chains[1].committed):
+                break
+        assert (pfsms[follower].log.read_from(0, 1 << 20)
+                == pfsms[lead].log.read_from(0, 1 << 20))
+
+    asyncio.run(main())
+
+
+def test_stale_transfer_gc_frees_export(tmp_path):
+    """A follower that dies mid-transfer must not pin the materialized
+    export in leader memory forever: the transfer ages out after
+    snap_transfer_stale_ticks without an ack."""
+    async def main():
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        lead = _leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+        engines[lead].snap_chunk_bytes = 64
+        engines[lead].snap_transfer_stale_ticks = 30
+
+        futs = []
+        for i in range(8):
+            futs.append(engines[lead].propose(1, records.build_batch(b"x%d" % i, 1)))
+            _run(engines, 3, down=(follower,))
+        _run(engines, 5, down=(follower,))
+        for fu in futs:
+            await fu
+        assert engines[lead].chains[1].floor > GENESIS
+
+        # A few healed rounds: probe, probe-ack, payload build, first chunk
+        # — then the follower dies.
+        _run(engines, 8)
+        assert engines[lead]._snap_send_off, "transfer never started"
+        assert engines[lead]._snap_payload, "export never materialized"
+        _run(engines, 60, down=(follower,))
+        assert not engines[lead]._snap_send_off
+        assert not engines[lead]._snap_payload
+
+        # The follower's return still works: a fresh transfer completes.
+        _run(engines, 80)
+        assert engines[follower].chains[1].committed == engines[lead].chains[1].committed
+        assert (pfsms[follower].log.read_from(0, 1 << 20)
+                == pfsms[lead].log.read_from(0, 1 << 20))
 
     asyncio.run(main())
 
