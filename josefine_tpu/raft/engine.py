@@ -252,6 +252,7 @@ class RaftEngine:
         self.snap_transfer_stale_ticks = 200
         self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
         self._snap_payload: dict[tuple[int, int], bytes] = {}
+        self._snap_payload_meta: dict[tuple[int, int], tuple[int, int]] = {}
         self._snap_ack_tick: dict[tuple[int, int], int] = {}
         self._snap_staging: dict[int, tuple[int, int, bytearray]] = {}
         self._snap_stage_tick: dict[int, int] = {}
@@ -1095,8 +1096,10 @@ class RaftEngine:
         """Sender side: an ack advances the per-(group, dst) transfer
         pointer and lifts the resend throttle so the next chunk ships on
         the next tick; ok=1 (installed / already-current) ends the
-        transfer. A regressed ack (receiver restarted mid-transfer) rolls
-        the pointer back."""
+        transfer. An equal-offset ack is a duplicate (resent chunk) and is
+        ignored; a REGRESSED ack means the receiver's staging restarted, so
+        the transfer is dropped and re-probed (a pinned suffix may no
+        longer be servable there)."""
         key = (msg.group, msg.src)
         ptr = self._snap_send_off.get(key)
         if ptr is None or ptr[0] != msg.x:
@@ -1121,19 +1124,36 @@ class RaftEngine:
                 # The snapshot moved while probing; restart next round.
                 self._drop_transfer(key)
                 return
-            try:
-                payload = exp(record, int(msg.z))
-            except (ValueError, OSError) as e:
-                log.error("cannot export snapshot g=%d from %d: %s",
-                          g, msg.z, e)
-                self._drop_transfer(key)
-                return
+            start = int(msg.z)
+            payload = None
+            for k2, m2 in self._snap_payload_meta.items():
+                # Concurrent catch-ups of the SAME span (several replaced
+                # replicas resuming from the same offset) share one bytes
+                # object instead of materializing a full copy per peer.
+                if k2[0] == g and m2 == (ptr[0], start):
+                    payload = self._snap_payload.get(k2)
+                    break
+            if payload is None:
+                try:
+                    payload = exp(record, start)
+                except (ValueError, OSError) as e:
+                    log.error("cannot export snapshot g=%d from %d: %s",
+                              g, start, e)
+                    self._drop_transfer(key)
+                    return
             self._snap_payload[key] = payload
+            self._snap_payload_meta[key] = (ptr[0], start)
             self._snap_send_off[key] = (ptr[0], 0)
             self._snap_sent_tick.pop(key, None)  # first chunk next tick
             return
-        if msg.y <= ptr[1]:
-            # No forward progress: the receiver's staging restarted (it
+        if msg.y == ptr[1]:
+            # Duplicate of the ack that advanced us here (the receiver
+            # re-acks an ignored resent chunk). Not a regression — dropping
+            # the transfer on it would livelock catch-up whenever ack
+            # latency exceeds the resend window.
+            return
+        if msg.y < ptr[1]:
+            # True regression: the receiver's staging restarted (it
             # crashed/reset mid-transfer). A pinned suffix export may now be
             # unservable there (its start no longer matches the replica's
             # log end), so rolling the pointer back would loop forever —
@@ -1146,6 +1166,7 @@ class RaftEngine:
     def _drop_transfer(self, key: tuple[int, int]) -> None:
         self._snap_send_off.pop(key, None)
         self._snap_payload.pop(key, None)
+        self._snap_payload_meta.pop(key, None)
         self._snap_sent_tick.pop(key, None)
         self._snap_ack_tick.pop(key, None)
 

@@ -583,6 +583,40 @@ def test_follower_reset_mid_suffix_transfer_recovers(tmp_path):
     asyncio.run(main())
 
 
+def test_duplicate_ack_does_not_kill_transfer(tmp_path):
+    """An equal-offset ack is a duplicate (the receiver re-acks a resent
+    chunk it already holds), NOT a regression: dropping the transfer on it
+    would livelock catch-up whenever ack latency exceeds the resend
+    window. Only a strictly-lower ack (receiver reset) drops."""
+    async def main():
+        from josefine_tpu.raft import rpc
+
+        kv = MemKV()
+        e = RaftEngine(kv, [1, 2], 1, groups=2, params=PARAMS)
+        key = (1, 1)
+        e._snap_send_off[key] = (42, 256)
+        e._snap_payload[key] = b"x" * 1024
+        e._snap_payload_meta[key] = (42, 0)
+
+        dup = rpc.WireMsg(kind=rpc.MSG_SNAPSHOT_ACK, group=1, src=1, dst=0,
+                          x=42, y=256, ok=0)
+        e._handle_snap_ack(dup)
+        assert e._snap_send_off.get(key) == (42, 256)  # untouched
+
+        fwd = rpc.WireMsg(kind=rpc.MSG_SNAPSHOT_ACK, group=1, src=1, dst=0,
+                          x=42, y=512, ok=0)
+        e._handle_snap_ack(fwd)
+        assert e._snap_send_off.get(key) == (42, 512)  # advanced
+
+        back = rpc.WireMsg(kind=rpc.MSG_SNAPSHOT_ACK, group=1, src=1, dst=0,
+                           x=42, y=128, ok=0)
+        e._handle_snap_ack(back)
+        assert key not in e._snap_send_off  # regression -> drop + re-probe
+        assert key not in e._snap_payload
+
+    asyncio.run(main())
+
+
 def test_stale_transfer_gc_frees_export(tmp_path):
     """A follower that dies mid-transfer must not pin the materialized
     export in leader memory forever: the transfer ages out after
