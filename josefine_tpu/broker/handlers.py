@@ -460,8 +460,16 @@ class Broker:
                     rep, part = got
                     batch = p.get("records") or b""
                     group = self._live_group(part)
+                    bad = records.validate_batch(batch) if batch else None
                     if not batch:
                         pass
+                    elif bad is not None:
+                        # Refuse at ingress: once committed, a corrupt batch
+                        # would replicate to every replica's log and poison
+                        # the partition for CRC-checking consumers forever.
+                        log.warning("rejecting produce to %s[%d]: %s",
+                                    t["name"], idx, bad)
+                        err = ErrorCode.CORRUPT_MESSAGE
                     elif group is not None:
                         err, base = await self._produce_replicated(
                             group, batch, acks)

@@ -45,26 +45,24 @@ constexpr size_t RECORD_HEADER = 20;
 // Slice-by-8 (same polynomial/values as the classic bytewise table — the
 // on-disk format is unchanged): CRC is the hot loop of every blob read and
 // append (a 64-record batch blob is tens of KB), and the bytewise loop was
-// the storage engine's throughput ceiling.
-uint32_t crc_table[8][256];
-bool crc_init_done = false;
-void crc_init() {
+// the storage engine's throughput ceiling. Two instances: IEEE 0xEDB88320
+// (record blobs, zlib-compatible) and Castagnoli 0x82F63B78 (Kafka record
+// batch CRC — exposed so the broker can validate produced batches).
+void build_crc_tables(uint32_t poly, uint32_t t[8][256]) {
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t c = i;
-    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc_table[0][i] = c;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? poly ^ (c >> 1) : c >> 1;
+    t[0][i] = c;
   }
   for (uint32_t i = 0; i < 256; i++) {
-    uint32_t c = crc_table[0][i];
+    uint32_t c = t[0][i];
     for (int s = 1; s < 8; s++) {
-      c = crc_table[0][c & 0xFF] ^ (c >> 8);
-      crc_table[s][i] = c;
+      c = t[0][c & 0xFF] ^ (c >> 8);
+      t[s][i] = c;
     }
   }
-  crc_init_done = true;
 }
-uint32_t crc32(const uint8_t* p, size_t n) {
-  if (!crc_init_done) crc_init();
+uint32_t crc_slice8(const uint32_t t[8][256], const uint8_t* p, size_t n) {
   uint32_t c = 0xFFFFFFFFu;
 #if !defined(__BYTE_ORDER__) || __BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__
   while (n >= 8) {
@@ -72,16 +70,32 @@ uint32_t crc32(const uint8_t* p, size_t n) {
     memcpy(&lo, p, 4);
     memcpy(&hi, p + 4, 4);
     c ^= lo;
-    c = crc_table[7][c & 0xFF] ^ crc_table[6][(c >> 8) & 0xFF] ^
-        crc_table[5][(c >> 16) & 0xFF] ^ crc_table[4][c >> 24] ^
-        crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
-        crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+    c = t[7][c & 0xFF] ^ t[6][(c >> 8) & 0xFF] ^
+        t[5][(c >> 16) & 0xFF] ^ t[4][c >> 24] ^
+        t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+        t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
     p += 8;
     n -= 8;
   }
 #endif
-  while (n--) c = crc_table[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  while (n--) c = t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
+}
+uint32_t crc_table[8][256];
+uint32_t crc32c_table[8][256];
+bool crc_init_done = false;
+void crc_init() {
+  build_crc_tables(0xEDB88320u, crc_table);
+  build_crc_tables(0x82F63B78u, crc32c_table);
+  crc_init_done = true;
+}
+uint32_t crc32(const uint8_t* p, size_t n) {
+  if (!crc_init_done) crc_init();
+  return crc_slice8(crc_table, p, n);
+}
+uint32_t crc32c(const uint8_t* p, size_t n) {
+  if (!crc_init_done) crc_init();
+  return crc_slice8(crc32c_table, p, n);
 }
 
 void put_u32(uint8_t* p, uint32_t v) {
@@ -521,11 +535,21 @@ PyObject* seglog_crc32(PyObject*, PyObject* args) {
   return PyLong_FromUnsignedLong(c);
 }
 
+PyObject* seglog_crc32c(PyObject*, PyObject* args) {
+  Py_buffer buf;
+  if (!PyArg_ParseTuple(args, "y*", &buf)) return nullptr;
+  uint32_t c = crc32c((const uint8_t*)buf.buf, (size_t)buf.len);
+  PyBuffer_Release(&buf);
+  return PyLong_FromUnsignedLong(c);
+}
+
 PyMethodDef module_methods[] = {
     {"open", (PyCFunction)seglog_open, METH_VARARGS | METH_KEYWORDS,
      "open(dir, max_segment_bytes=1GiB, index_bytes=10MiB) -> Log"},
     {"crc32", (PyCFunction)seglog_crc32, METH_VARARGS,
      "crc32(bytes) -> int (standard CRC-32, zlib-compatible)"},
+    {"crc32c", (PyCFunction)seglog_crc32c, METH_VARARGS,
+     "crc32c(bytes) -> int (Castagnoli CRC-32C, Kafka batch checksum)"},
     {nullptr, nullptr, 0, nullptr},
 };
 

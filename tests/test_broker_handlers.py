@@ -246,3 +246,29 @@ async def test_unsupported_api_versions_request_answered(broker):
 @pytest.mark.asyncio
 async def test_unknown_api_closes_connection(broker):
     assert await broker.handle_request(11, 5, None) is None
+
+
+@pytest.mark.asyncio
+async def test_produce_rejects_corrupt_batch(broker):
+    """A batch failing CRC/structure validation is refused with
+    CORRUPT_MESSAGE at ingress — nothing reaches the log (a committed
+    corrupt batch would poison every replica for CRC-checking consumers)."""
+    await create_topic(broker, partitions=1)
+    good = make_batch(b"valid", n_records=1)
+    corrupt = bytearray(good)
+    corrupt[-1] ^= 0xFF
+    resp = await broker.produce(3, {
+        "acks": -1, "timeout_ms": 1000,
+        "topics": [{"name": "events", "partitions": [
+            {"index": 0, "records": bytes(corrupt)}]}],
+    })
+    p0 = resp["responses"][0]["partitions"][0]
+    assert p0["error_code"] == ErrorCode.CORRUPT_MESSAGE
+    # The log is untouched; a valid batch still lands at offset 0.
+    resp = await broker.produce(3, {
+        "acks": -1, "timeout_ms": 1000,
+        "topics": [{"name": "events", "partitions": [
+            {"index": 0, "records": good}]}],
+    })
+    p0 = resp["responses"][0]["partitions"][0]
+    assert (p0["error_code"], p0["base_offset"]) == (ErrorCode.NONE, 0)
