@@ -9,7 +9,9 @@ conservative message-op count; each message also carries a span of blocks —
 the blocks/sec rate is reported in extra).
 
 Engine: the fused multi-tick Pallas kernel (``ops/pallas_step.py``) —
-state stays resident in VMEM for a whole 100-tick window per partition tile.
+state stays resident in VMEM for a whole 500-tick window per 128-partition
+tile (long windows amortize launch overhead; measured best operating point
+on v5e).
 Set JOSEFINE_NO_PALLAS=1 to fall back to the per-tick XLA path
 (``chained_raft.run_ticks``); the fallback also triggers automatically if
 the Pallas path fails on this backend.
@@ -32,10 +34,10 @@ BASELINE_APPENDS_PER_SEC = 1_000_000.0
 
 P = 100_000
 N = 5
-TICKS = 100
-REPS = 5
+TICKS = 500
+REPS = 2
 PROPOSALS_PER_TICK = 4
-TILE = 256
+TILE = 128  # measured best: 128-lane tiles, long windows amortize launches
 
 
 def run_xla(params, member, state, inbox, proposals, ticks):

@@ -25,31 +25,72 @@ _ATTRIBUTES_OFFSET = 21
 _LAST_OFFSET_DELTA = 23
 
 _crc32c_fn = None
+_PY_CRC_TABLE: list[int] | None = None
 
 
-def _crc32c(data: bytes) -> int:
+def _crc32c_py(data) -> int:
+    """Pure-Python Castagnoli fallback: keeps client-side batch building
+    (examples, demos) free of the native toolchain; the broker normally
+    gets the slice-by-8 C implementation."""
+    global _PY_CRC_TABLE
+    if _PY_CRC_TABLE is None:
+        t = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else c >> 1
+            t.append(c)
+        _PY_CRC_TABLE = t
+    c = 0xFFFFFFFF
+    for b in bytes(data):
+        c = _PY_CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def _crc32c(data) -> int:
     global _crc32c_fn
     if _crc32c_fn is None:  # cache: native.load stats the .so per call
-        _crc32c_fn = native.load("seglog").crc32c
+        try:
+            _crc32c_fn = native.load("seglog").crc32c
+        except Exception:
+            _crc32c_fn = _crc32c_py
     return _crc32c_fn(data)
 
 
-def _batch_spans(blob: bytes):
-    """(start, length, count) of each v2 batch in a partition's records
-    field — a produce request may carry SEVERAL concatenated batches (a
-    real client accumulates per-partition batches into one request).
-    Yields nothing for non-v2/opaque blobs."""
+def _scan(blob: bytes) -> tuple[list[tuple[int, int, int]], str | None]:
+    """THE v2 framing walk (single source of truth for every helper here):
+    ``([(start, total_len, last_offset_delta), ...], reason)`` where reason
+    is None for a clean walk to end-of-field and a string describing the
+    first framing violation otherwise (spans up to that point are still
+    returned for lenient callers)."""
+    spans: list[tuple[int, int, int]] = []
     pos = 0
-    while pos + BATCH_OVERHEAD <= len(blob):
+    while pos < len(blob):
+        if pos + BATCH_OVERHEAD > len(blob):
+            return spans, (f"batch {len(spans)} shorter than v2 header "
+                           f"({len(blob) - pos} bytes at {pos})")
         if blob[pos + _MAGIC_OFFSET] != 2:
-            return
+            return spans, (f"unsupported batch magic "
+                           f"{blob[pos + _MAGIC_OFFSET]} at {pos}")
         (blen,) = struct.unpack_from(">i", blob, pos + 8)
         total = blen + 12
         if blen < BATCH_OVERHEAD - 12 or pos + total > len(blob):
-            return
+            return spans, (f"batch_length {blen} at {pos} overruns field "
+                           f"({len(blob)})")
         (delta,) = struct.unpack_from(">i", blob, pos + _LAST_OFFSET_DELTA)
-        yield pos, total, max(1, delta + 1)
+        spans.append((pos, total, delta))
         pos += total
+    return spans, None
+
+
+def _batch_spans(blob: bytes):
+    """(start, length, count) of each well-framed v2 batch in a records
+    field — a produce request may carry SEVERAL concatenated batches (a
+    real client accumulates per-partition batches into one request).
+    Lenient: stops at the first framing violation; yields nothing for
+    non-v2/opaque blobs."""
+    for start, total, delta in _scan(blob)[0]:
+        yield start, total, max(1, delta + 1)
 
 
 def record_count(blob: bytes) -> int:
@@ -68,29 +109,19 @@ def validate_batch(blob: bytes) -> str | None:
     (The reference validates nothing; its Produce path is unreachable over
     the wire, SURVEY.md quirk 8. Legacy magic-0/1 batches are refused —
     the data plane is v2-only by design.)"""
-    pos = 0
-    n = 0
-    while pos < len(blob):
-        if pos + BATCH_OVERHEAD > len(blob):
-            return (f"batch {n} shorter than v2 header "
-                    f"({len(blob) - pos} bytes at {pos})")
-        if blob[pos + _MAGIC_OFFSET] != 2:
-            return f"unsupported batch magic {blob[pos + _MAGIC_OFFSET]} at {pos}"
-        (blen,) = struct.unpack_from(">i", blob, pos + 8)
-        total = blen + 12
-        if blen < BATCH_OVERHEAD - 12 or pos + total > len(blob):
-            return f"batch_length {blen} at {pos} overruns field ({len(blob)})"
-        (delta,) = struct.unpack_from(">i", blob, pos + _LAST_OFFSET_DELTA)
+    spans, reason = _scan(blob)
+    if reason is not None:
+        return reason
+    if not spans:
+        return "no record batch"
+    view = memoryview(blob)  # zero-copy CRC input on the produce hot path
+    for pos, total, delta in spans:
         if delta < 0:
             return f"negative last_offset_delta {delta} at {pos}"
         (crc,) = struct.unpack_from(">I", blob, pos + _CRC_OFFSET)
-        actual = _crc32c(blob[pos + _ATTRIBUTES_OFFSET:pos + total])
+        actual = _crc32c(view[pos + _ATTRIBUTES_OFFSET:pos + total])
         if crc != actual:
             return f"crc {crc:#010x} != computed {actual:#010x} at {pos}"
-        pos += total
-        n += 1
-    if n == 0:
-        return "no record batch"
     return None
 
 

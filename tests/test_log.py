@@ -185,9 +185,13 @@ def test_crc32c_known_vectors():
     assert mod.crc32c(b"123456789") == 0xE3069283
     assert mod.crc32c(b"") == 0
     rng = random.Random(3)
+    from josefine_tpu.broker.records import _crc32c_py
     for n in (1, 7, 8, 9, 15, 16, 17, 100):
         data = rng.randbytes(n)
         assert mod.crc32c(data) == _crc32c_ref(data), n
+        # The pure-Python fallback (client-side batch building without the
+        # native toolchain) agrees with the native implementation.
+        assert _crc32c_py(data) == mod.crc32c(data), n
 
 
 def test_validate_batch():
