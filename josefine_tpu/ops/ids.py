@@ -22,7 +22,6 @@ they pack into a single u64 ``(t << 32) | s``.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import struct

@@ -38,12 +38,11 @@ from josefine_tpu.models.types import (
     Msgs,
     NodeState,
     StepParams,
-    empty_msgs,
     step_params,
 )
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
-from josefine_tpu.raft.chain import GENESIS, Chain, pack_id, id_term, id_seq
+from josefine_tpu.raft.chain import GENESIS, Chain, id_term, id_seq
 from josefine_tpu.raft.fsm import Driver, Fsm, supports_snapshot
 from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange, MemberTable, is_conf
 from josefine_tpu.utils.kv import KV
