@@ -16,6 +16,9 @@ config 1-2. Here one host process drives P groups per tick.
 
 Usage: python bench_engine.py [--sizes 1000,10000,100000] [--ticks 200]
 Writes BENCH_engine.json and prints one JSON line per size.
+With --kernel, times only the bare packed device step per size (no
+cluster, no wire; --ticks overrides the per-size iteration count) and
+writes BENCH_engine_kernel.json instead.
 """
 
 from __future__ import annotations
@@ -102,23 +105,73 @@ async def bench_one(P: int, ticks: int, warmup: int) -> dict:
     }
 
 
+def bench_kernel(P: int, iters: int) -> dict:
+    """Time the engine's EXACT packed step (one node's kernel dispatch +
+    the single up/down transfer pair) in isolation — separates the device
+    kernel from the host bridge in the per-tick budget. On a tunneled TPU
+    the transfer latency is the tunnel's, not the hardware's; co-located
+    the same two transfers are PCIe-microseconds."""
+    import jax
+
+    e = RaftEngine(MemKV(), [0, 1, 2], 0, groups=P,
+                   params=step_params(timeout_min=3, timeout_max=8, hb_ticks=1))
+    in10 = np.zeros((10, P, e.N), np.int32)
+    # Warm up / compile.
+    st, flat = e._step(e.params, e.member, e._me_dev, e.state, in10)
+    np.asarray(flat)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st, flat = e._step(e.params, e.member, e._me_dev, st, in10)
+        np.asarray(flat)  # the tick's one device->host fetch
+    dt = time.perf_counter() - t0
+
+    # Compute-only: device-resident input, block on the device result
+    # without fetching — isolates the kernel from the host<->device hop
+    # (which on a tunneled chip is the tunnel's latency/bandwidth, not the
+    # hardware's; co-located it is a PCIe-microseconds pair).
+    in10_dev = jax.device_put(in10)
+    st, flat = e._step(e.params, e.member, e._me_dev, st, in10_dev)
+    jax.block_until_ready(flat)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        st, flat = e._step(e.params, e.member, e._me_dev, st, in10_dev)
+        jax.block_until_ready(flat)
+    dt_c = time.perf_counter() - t0
+    return {
+        "P": P,
+        "iters": iters,
+        "ms_per_step": round(1000 * dt / iters, 2),
+        "ms_per_step_compute_only": round(1000 * dt_c / iters, 2),
+        "steps_per_sec": round(iters / dt, 2),
+        "device": str(jax.devices()[0]),
+    }
+
+
 async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default=None)
     ap.add_argument("--sizes", default="1000,10000,100000")
     ap.add_argument("--ticks", type=int, default=200)
     ap.add_argument("--warmup", type=int, default=40)
+    ap.add_argument("--kernel", action="store_true",
+                    help="time the bare packed step only (no cluster, no wire)")
     args = ap.parse_args()
 
     results = []
     for P in (int(s) for s in args.sizes.split(",")):
-        ticks = min(args.ticks, max(30, 3_000_000 // P))  # bound wall time at big P
-        r = await bench_one(P, ticks, args.warmup)
+        if args.kernel:
+            iters = args.ticks if args.ticks != 200 else max(10, 2_000_000 // P)
+            r = bench_kernel(P, iters=iters)
+        else:
+            ticks = min(args.ticks, max(30, 3_000_000 // P))  # bound wall time at big P
+            r = await bench_one(P, ticks, args.warmup)
         results.append(r)
         print(json.dumps(r))
 
-    with open("BENCH_engine.json", "w") as f:
-        json.dump({"bench": "engine_host_bridge", "results": results}, f, indent=1)
+    name = "engine_packed_step" if args.kernel else "engine_host_bridge"
+    out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
+    with open(out_path, "w") as f:
+        json.dump({"bench": name, "results": results}, f, indent=1)
 
 
 if __name__ == "__main__":
