@@ -151,7 +151,7 @@ async def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default=None)
     ap.add_argument("--sizes", default="1000,10000,100000")
-    ap.add_argument("--ticks", type=int, default=200)
+    ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--warmup", type=int, default=40)
     ap.add_argument("--kernel", action="store_true",
                     help="time the bare packed step only (no cluster, no wire)")
@@ -160,10 +160,14 @@ async def main():
     results = []
     for P in (int(s) for s in args.sizes.split(",")):
         if args.kernel:
-            iters = args.ticks if args.ticks != 200 else max(10, 2_000_000 // P)
+            iters = args.ticks if args.ticks is not None else max(10, 2_000_000 // P)
             r = bench_kernel(P, iters=iters)
         else:
-            ticks = min(args.ticks, max(30, 3_000_000 // P))  # bound wall time at big P
+            # Bound wall time at big P unless --ticks is explicit.
+            ticks = (args.ticks if args.ticks is not None
+                     else max(30, 3_000_000 // P))
+            if args.ticks is None:
+                ticks = min(200, ticks)
             r = await bench_one(P, ticks, args.warmup)
         results.append(r)
         print(json.dumps(r))

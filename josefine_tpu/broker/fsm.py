@@ -17,6 +17,7 @@ from josefine_tpu.broker.state import (
     OffsetCommitBatch,
     Partition,
     Store,
+    GroupReleased,
     Topic,
     TopicTombstone,
 )
@@ -28,6 +29,7 @@ _ENSURE_GROUP = 4
 _COMMIT_OFFSET = 5
 _DELETE_TOPIC = 6
 _COMMIT_OFFSETS = 7
+_GROUP_RELEASED = 8
 
 _KINDS = {
     _ENSURE_TOPIC: Topic,
@@ -37,6 +39,7 @@ _KINDS = {
     _COMMIT_OFFSET: OffsetCommit,
     _DELETE_TOPIC: TopicTombstone,
     _COMMIT_OFFSETS: OffsetCommitBatch,
+    _GROUP_RELEASED: GroupReleased,
 }
 _TAGS = {v: k for k, v in _KINDS.items()}
 
@@ -71,6 +74,11 @@ class Transition:
     @staticmethod
     def delete_topic(name: str) -> bytes:
         return bytes([_DELETE_TOPIC]) + TopicTombstone(name=name).encode()
+
+    @staticmethod
+    def group_released(group: int, broker_id: int) -> bytes:
+        return (bytes([_GROUP_RELEASED])
+                + GroupReleased(group=group, broker_id=broker_id).encode())
 
     @staticmethod
     def decode(data: bytes):
@@ -129,9 +137,19 @@ class JosefineFsm:
             for oc in entity.entries:
                 self.store.commit_offset(oc)
             applied = entity
+        elif isinstance(entity, GroupReleased):
+            # One replica host reset its local row state; when the last ack
+            # lands the row re-enters the claimable pool (claim_group).
+            self.store.ack_group_release(entity.group, entity.broker_id)
+            applied = entity
         elif isinstance(entity, TopicTombstone):
             released = self.store.get_partitions(entity.name)
             self.store.delete_topic(entity.name)
+            for p in released:
+                if p.group >= 1:
+                    # Begin draining the row: reusable only after every
+                    # replica host acks its local reset (GroupReleased).
+                    self.store.release_group(p.group, p.assigned_replicas)
             if self.on_partition_released is not None:
                 for p in released:
                     if p.group >= 1:

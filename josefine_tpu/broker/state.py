@@ -161,6 +161,24 @@ class TopicTombstone:
         return cls(**json.loads(raw))
 
 
+@dataclass
+class GroupReleased:
+    """One replica host's ack that it reset its local state for a released
+    consensus-group row (chain, device row, partition-FSM records). The row
+    becomes reusable by claim_group once every replica host's ack commits —
+    the distributed barrier that makes row recycling safe."""
+
+    group: int
+    broker_id: int
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "GroupReleased":
+        return cls(**json.loads(raw))
+
+
 class Store:
     """Metadata store over KV. All writes flow through the replicated FSM
     (``broker/fsm.py``) — handlers only read."""
@@ -263,18 +281,76 @@ class Store:
     # ------------------------------------------- consensus-group allocation
 
     def claim_group(self, pool: int) -> int:
-        """Allocate the next consensus-group row in [1, pool), or -1 when
-        the pool is exhausted. Deterministic (pure function of store state),
-        so every node applying the same committed EnsurePartition assigns
-        the same row. Monotone: freed rows are NOT reused — a reused row
-        would inherit the dead topic's chain/log state (safe reuse needs a
-        replicated group reset, future work)."""
-        raw = self._kv.get(self._pfx + b"galloc:next")
-        nxt = int(raw) if raw else 1
-        if nxt >= pool:
-            return -1
-        self._kv.put(self._pfx + b"galloc:next", b"%d" % (nxt + 1))
-        return nxt
+        """Allocate a consensus-group row in [1, pool): the lowest RECYCLED
+        row if any (see release_group/ack_group_release — a freed row is
+        reusable once every replica host has reset its local row state and
+        had that ack committed), else the next fresh row; -1 when the pool
+        is exhausted. Deterministic (pure function of store state), so
+        every node applying the same committed EnsurePartition assigns the
+        same row. Each claim bumps the row's INCARNATION counter; nodes
+        compare it against their locally persisted value to detect a row
+        they must reset before serving (a reused row must never inherit a
+        dead topic's chain/log state)."""
+        free = sorted(self._galloc_free_rows())
+        if free:
+            g = free[0]
+            self._kv.delete(self._pfx + b"galloc:free:%d" % g)
+        else:
+            raw = self._kv.get(self._pfx + b"galloc:next")
+            g = int(raw) if raw else 1
+            if g >= pool:
+                return -1
+            self._kv.put(self._pfx + b"galloc:next", b"%d" % (g + 1))
+        inc = self.group_incarnation(g) + 1
+        self._kv.put(self._pfx + b"galloc:inc:%d" % g, b"%d" % inc)
+        return g
+
+    def _galloc_free_rows(self) -> list[int]:
+        pfx = self._pfx + b"galloc:free:"
+        return [int(k[len(pfx):]) for k, _ in self._kv.scan_prefix(pfx)]
+
+    def group_incarnation(self, g: int) -> int:
+        raw = self._kv.get(self._pfx + b"galloc:inc:%d" % g)
+        return int(raw) if raw else 0
+
+    def release_group(self, g: int, replica_ids) -> None:
+        """Begin draining a released row (its topic was deleted): the row
+        becomes claimable again only after every listed replica host acks
+        that it reset its local row state (ack_group_release). A row with
+        no holders frees immediately."""
+        pending = sorted({int(b) for b in replica_ids})
+        if not pending:
+            self._kv.put(self._pfx + b"galloc:free:%d" % g, b"1")
+            return
+        self._kv.put(self._pfx + b"galloc:drain:%d" % g,
+                     b",".join(b"%d" % b for b in pending))
+
+    def ack_group_release(self, g: int, broker_id: int) -> bool:
+        """Record one replica host's reset ack; returns True when the row
+        just became free. Idempotent: unknown rows / repeated acks no-op."""
+        key = self._pfx + b"galloc:drain:%d" % g
+        raw = self._kv.get(key)
+        if raw is None:
+            return False
+        pending = {int(b) for b in raw.split(b",") if b}
+        pending.discard(int(broker_id))
+        if pending:
+            self._kv.put(key, b",".join(b"%d" % b for b in sorted(pending)))
+            return False
+        self._kv.delete(key)
+        self._kv.put(self._pfx + b"galloc:free:%d" % g, b"1")
+        return True
+
+    def groups_pending_release(self, broker_id: int) -> list[int]:
+        """Rows still draining on this broker's account (restart scan: a
+        node that was down through a DeleteTopic must reset those rows and
+        ack before they can ever be reused)."""
+        pfx = self._pfx + b"galloc:drain:"
+        out = []
+        for k, raw in self._kv.scan_prefix(pfx):
+            if int(broker_id) in {int(b) for b in raw.split(b",") if b}:
+                out.append(int(k[len(pfx):]))
+        return out
 
     # ------------------------------------------------------------- groups
 

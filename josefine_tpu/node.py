@@ -71,6 +71,12 @@ class Node:
         # the data-plane PartitionFsm. Startup re-wires from the store scan.
         self.fsm.on_partition_assigned = self._wire_partition
         self.fsm.on_partition_released = self._release_partition
+        # Released-row ack lane (consensus-group recycling): after resetting
+        # local state for a released row, the broker proposes GroupReleased
+        # through Raft; the row re-enters the claimable pool once every
+        # replica host's ack commits.
+        self._pending_acks: list[int] = []
+        self._ack_task: asyncio.Task | None = None
         self._rewire_partitions()
         self._register_task: asyncio.Task | None = None
         # Observability endpoint (TPU-build addition; the reference's only
@@ -106,11 +112,35 @@ class Node:
             if self.config.broker.id in p.assigned_replicas:
                 hosted.append(p)
         eng.configure_groups(claims)
+        for g in claims:
+            self._sync_group_incarnation(g)
         for p in hosted:
             rep = self.broker.broker.replicas.ensure(p)
             eng.register_fsm(p.group, PartitionFsm(
                 self.kv, p.group, rep.log,
                 on_append=self.broker.broker.signal_append))
+        # Rows released while we were down (the drain entry still lists us):
+        # reset the leftover local state and ack so the row can be reused.
+        for g in self.store.groups_pending_release(self.config.broker.id):
+            if 0 < g < eng.P:
+                self._reset_released_row(g)
+
+    def _sync_group_incarnation(self, g: int) -> None:
+        """Align local row state with the store's incarnation for row g:
+        a mismatch means the row was recycled (or first claimed) and any
+        local leftovers belong to its previous life — reset them before
+        serving. Idempotent; a match is a no-op beyond stamping the engine
+        (live rows must never be wiped by a re-fired hook)."""
+        eng = self.raft.engine
+        inc = self.store.group_incarnation(g)
+        key = b"ginc:%d" % g
+        local = int(self.kv.get(key) or 0)
+        if local != inc:
+            eng.recycle_group(g)
+            self.kv.delete(b"pfsm:%d" % g)
+            self.kv.delete(b"pfsm:r:%d" % g)
+            self.kv.put(key, b"%d" % inc)
+        eng.set_group_incarnation(g, inc)
 
     def _wire_partition(self, p) -> None:
         """Commit-time hook: an EnsurePartition with a group claim applied.
@@ -118,6 +148,7 @@ class Node:
         eng = self.raft.engine
         if p.group < 1 or p.group >= eng.P:
             return
+        self._sync_group_incarnation(p.group)
         slots = {eng.members.slot_of(b) for b in p.assigned_replicas}
         slots.discard(None)
         eng.set_group_members(p.group, slots)
@@ -130,13 +161,49 @@ class Node:
 
     def _release_partition(self, p) -> None:
         """Commit-time hook: the partition's topic was deleted — idle the
-        group row. The row is NOT reused (Store.claim_group is monotone), so
-        the dead chain/pfsm state cannot leak into a future topic."""
+        group row, and (replica hosts only) reset local row state and ack
+        through Raft so the row can be recycled once every host has."""
         eng = self.raft.engine
         if p.group < 1 or p.group >= eng.P:
             return
         eng.unregister_fsm(p.group)
         eng.set_group_members(p.group, set())
+        if self.config.broker.id in p.assigned_replicas:
+            self._reset_released_row(p.group)
+
+    def _reset_released_row(self, g: int) -> None:
+        eng = self.raft.engine
+        eng.recycle_group(g)
+        self.kv.delete(b"pfsm:%d" % g)
+        self.kv.delete(b"pfsm:r:%d" % g)
+        self.kv.delete(b"ginc:%d" % g)
+        if g not in self._pending_acks:
+            self._pending_acks.append(g)
+        self._kick_acks()
+
+    def _kick_acks(self) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # constructed outside the loop: start() kicks
+        if self._ack_task is None or self._ack_task.done():
+            self._ack_task = loop.create_task(self._drain_acks())
+
+    async def _drain_acks(self) -> None:
+        while self._pending_acks and not self.shutdown.is_shutdown:
+            g = self._pending_acks[0]
+            payload = Transition.group_released(g, self.config.broker.id)
+            try:
+                await self.client.propose(payload, timeout=5.0)
+                self._pending_acks.pop(0)
+                log.info("released consensus row %d acked", g)
+            except asyncio.CancelledError:
+                return
+            except (ProposalTimeout, asyncio.TimeoutError):
+                continue
+            except Exception:
+                log.exception("release ack for row %d failed; retrying", g)
+                await asyncio.sleep(0.5)
 
     def _drop_topic_local(self, name: str) -> None:
         replicas = self.broker.broker.replicas
@@ -156,6 +223,7 @@ class Node:
         if self.metrics_server is not None:
             await self.metrics_server.start()
         self._register_task = asyncio.create_task(self._register_self())
+        self._kick_acks()
 
     async def _register_self(self) -> None:
         """Propose EnsureBroker(self) until the cluster has a leader."""
@@ -188,6 +256,9 @@ class Node:
         if self._register_task:
             self._register_task.cancel()
             await asyncio.gather(self._register_task, return_exceptions=True)
+        if self._ack_task:
+            self._ack_task.cancel()
+            await asyncio.gather(self._ack_task, return_exceptions=True)
         await self.broker.stop()
         await self.raft.stop()
         if self.metrics_server is not None:

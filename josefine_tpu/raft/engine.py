@@ -341,6 +341,12 @@ class RaftEngine:
         # the liveness half of the derived ISR (in_sync_map). Updated with
         # one vectorized mask per tick from the inbox the host itself built.
         self._h_last_seen = np.zeros((groups, self.N), np.int64)
+        # Per-row incarnation (consensus-group recycling): stamped onto
+        # every outbound data-group frame and checked at intake — a frame
+        # from a recycled row's previous life must never be applied to its
+        # successor (stale frames can linger in reconnect queues across the
+        # release/ack/re-claim barrier).
+        self._h_ginc = np.zeros(groups, np.int64)
 
         self._pending_msgs: list[rpc.WireMsg] = []
         self._pending_batches: list[rpc.MsgBatch] = []
@@ -367,9 +373,13 @@ class RaftEngine:
             self._receive_batch(msg)
             return
         if msg.kind == rpc.MSG_SNAPSHOT:
+            if not self._inc_ok(msg):
+                return
             self._stage_snapshot(msg)
             return
         if msg.kind == rpc.MSG_SNAPSHOT_ACK:
+            if not self._inc_ok(msg):
+                return
             self._handle_snap_ack(msg)
             return
         if msg.kind not in _CONSENSUS_KIND_SET:
@@ -380,8 +390,24 @@ class RaftEngine:
         if not (0 <= msg.group < self.P) or not (0 <= msg.src < self.N):
             log.warning("dropping message for unknown group/node g=%d src=%d", msg.group, msg.src)
             return
+        if not self._inc_ok(msg):
+            return
         self._c_in.inc()
         self._pending_msgs.append(msg)
+
+    def _inc_ok(self, msg: rpc.WireMsg) -> bool:
+        """Row-incarnation guard (consensus-group recycling): a frame
+        stamped with a different incarnation than our local row belongs to
+        the row's previous (or a newer) life — drop it. Stale frames can
+        sit in a peer's reconnect queue across the whole release/ack/
+        re-claim barrier, and an old InstallSnapshot applied to a reused
+        row would resurrect the dead topic's data."""
+        if 0 <= msg.group < self.P and msg.inc != self._h_ginc[msg.group]:
+            log.warning("dropping stale-incarnation frame g=%d inc=%d "
+                        "(local %d) kind=%d", msg.group, msg.inc,
+                        self._h_ginc[msg.group], msg.kind)
+            return False
+        return True
 
     def _receive_batch(self, b: rpc.MsgBatch) -> None:
         """Validate and queue a columnar batch. Per-entry checks mirror
@@ -398,7 +424,8 @@ class RaftEngine:
             order = np.argsort(b.group, kind="stable")
             b = rpc.MsgBatch(b.src, b.dst, b.group[order], b.kind_col[order],
                              b.term[order], b.x[order], b.y[order],
-                             b.z[order], b.ok[order], b.blocks)
+                             b.z[order], b.ok[order], b.blocks,
+                             inc=b.inc[order])
             dup = np.zeros(len(b), bool)
             dup[1:] = b.group[1:] == b.group[:-1]
             if dup.any():
@@ -407,9 +434,13 @@ class RaftEngine:
         # Same whitelist as the single-message path: only device consensus
         # kinds may enter the inbox (SNAPSHOT/CLIENT_* are host-side only).
         inb &= np.isin(b.kind_col, _CONSENSUS_KINDS)
+        # Row-incarnation guard (consensus-group recycling): entries stamped
+        # with another incarnation belong to a recycled row's previous life.
+        inb &= self._h_ginc[np.clip(b.group, 0, self.P - 1)] == b.inc
         if not inb.all():
-            log.warning("dropping %d batch entries (unknown group or "
-                        "non-consensus kind) src=%d", int((~inb).sum()), b.src)
+            log.warning("dropping %d batch entries (unknown group, "
+                        "non-consensus kind, or stale incarnation) src=%d",
+                        int((~inb).sum()), b.src)
             b = b.take(inb)
         # AE span integrity, same rules as WireMsg.span_is_valid: an entry
         # claiming a span (x != y) must carry a parent-linked payload chain
@@ -805,6 +836,45 @@ class RaftEngine:
     def group_members(self, g: int) -> frozenset[int] | None:
         return self._group_claims.get(g)
 
+    def set_group_incarnation(self, g: int, inc: int) -> None:
+        if not (0 < g < self.P):
+            raise ValueError(f"group {g} not a data group (P={self.P})")
+        self._h_ginc[g] = int(inc)
+
+    def group_incarnation(self, g: int) -> int:
+        return int(self._h_ginc[g])
+
+    def recycle_group(self, g: int) -> None:
+        """Reset a data-group row for reuse by a NEW topic partition: chain
+        back to genesis, snapshot record gone, transfer state purged, and
+        the device row fully demoted (role/leader/progress/votes cleared —
+        a row that was leading its previous incarnation must not keep
+        broadcasting). The durable (term, voted_for) record is deliberately
+        KEPT: term monotonicity across incarnations means any straggler
+        frame from the old life carries a term the new life has already
+        seen. Callers then bump the row incarnation (set_group_incarnation)
+        so stale frames are dropped at intake."""
+        if not (0 < g < self.P):
+            raise ValueError(f"group {g} not a data group (P={self.P})")
+        self._reset_group(g)
+        z32 = jnp.asarray(0, _I32)
+        st = self.state
+        self.state = st.replace(
+            role=st.role.at[g].set(z32),
+            leader=st.leader.at[g].set(jnp.asarray(-1, _I32)),
+            elapsed=st.elapsed.at[g].set(z32),
+            hb_elapsed=st.hb_elapsed.at[g].set(z32),
+            votes=st.votes.at[g].set(jnp.zeros_like(st.votes[g])),
+            match=ids.Bid(st.match.t.at[g].set(jnp.zeros_like(st.match.t[g])),
+                          st.match.s.at[g].set(jnp.zeros_like(st.match.s[g]))),
+            nxt=ids.Bid(st.nxt.t.at[g].set(jnp.zeros_like(st.nxt.t[g])),
+                        st.nxt.s.at[g].set(jnp.zeros_like(st.nxt.s[g]))),
+        )
+        self._h_role[g] = 0
+        self._h_leader[g] = -1
+        self._h_last_seen[g] = 0
+        self._proposals.pop(g, None)
+
     def configure_groups(self, claims: dict[int, frozenset[int] | set[int]]) -> None:
         """Replace ALL data-group claims at once (startup re-wiring from the
         replicated store): groups in ``claims`` get their slot sets, every
@@ -1041,7 +1111,7 @@ class RaftEngine:
             self._snap_staging.pop(g, None)
             self._snap_acks.append(rpc.WireMsg(
                 kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
-                x=msg.x, y=msg.z, ok=1))
+                x=msg.x, y=msg.z, ok=1, inc=int(self._h_ginc[g])))
             return
         if msg.ok:
             # Position probe: reply with where an incremental sync may
@@ -1054,7 +1124,7 @@ class RaftEngine:
             self._snap_staging.pop(g, None)
             self._snap_acks.append(rpc.WireMsg(
                 kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
-                x=msg.x, y=0, z=resume, ok=0))
+                x=msg.x, y=0, z=resume, ok=0, inc=int(self._h_ginc[g])))
             return
         total = msg.z if msg.z else len(msg.payload)
         if msg.y == 0 and len(msg.payload) >= total:
@@ -1065,7 +1135,8 @@ class RaftEngine:
             if self._install_snapshot(msg, msg.payload):
                 self._snap_acks.append(rpc.WireMsg(
                     kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
-                    dst=msg.src, x=msg.x, y=total, ok=1))
+                    dst=msg.src, x=msg.x, y=total, ok=1,
+                    inc=int(self._h_ginc[g])))
             return
         st = self._snap_staging.get(g)
         if st is None or st[0] != msg.x or st[1] != total:
@@ -1085,11 +1156,12 @@ class RaftEngine:
             if self._install_snapshot(msg, bytes(buf)):
                 self._snap_acks.append(rpc.WireMsg(
                     kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
-                    dst=msg.src, x=msg.x, y=total, ok=1))
+                    dst=msg.src, x=msg.x, y=total, ok=1,
+                    inc=int(self._h_ginc[g])))
             return
         self._snap_acks.append(rpc.WireMsg(
             kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
-            x=msg.x, y=len(buf), ok=0))
+            x=msg.x, y=len(buf), ok=0, inc=int(self._h_ginc[g])))
 
     def _handle_snap_ack(self, msg: rpc.WireMsg) -> None:
         """Sender side: an ack advances the per-(group, dst) transfer
@@ -1419,7 +1491,8 @@ class RaftEngine:
             bx = xcol[g, dst]
             by = ycol[g, dst]
             bz = zcol[g, dst]
-            batch = rpc.MsgBatch(self.me, dst, g, kcol, tcol, bx, by, bz, okcol)
+            batch = rpc.MsgBatch(self.me, dst, g, kcol, tcol, bx, by, bz,
+                                 okcol, inc=self._h_ginc[g])
             # AE entries with a non-empty span need chain payloads attached.
             ae = np.nonzero((kcol == rpc.MSG_APPEND) & (by != bx))[0]
             for i in ae.tolist():
@@ -1485,7 +1558,8 @@ class RaftEngine:
         self._snap_ack_tick.setdefault((g, dst), self._ticks)
         self._snap_sent_tick[(g, dst)] = self._ticks
         return rpc.WireMsg(kind=rpc.MSG_SNAPSHOT, group=g, src=self.me,
-                           dst=dst, term=term, x=snap_id, ok=1)
+                           dst=dst, term=term, x=snap_id, ok=1,
+                           inc=int(self._h_ginc[g]))
 
     def _snapshot_msg(self, g: int, dst: int, term: int) -> rpc.WireMsg | None:
         """Next message of the snapshot transfer to ``dst`` (or None).
@@ -1552,4 +1626,5 @@ class RaftEngine:
         return rpc.WireMsg(
             kind=rpc.MSG_SNAPSHOT, group=g, src=self.me, dst=dst,
             term=term, x=snap_id, y=off, z=total, payload=chunk, aux=aux,
+            inc=int(self._h_ginc[g]),
         )

@@ -68,6 +68,9 @@ class WireMsg:
     ok: int = 0
     blocks: list[Block] = field(default_factory=list)  # AE payload span (x, y]
     req_id: str = ""      # CLIENT_* correlation
+    inc: int = 0          # data-group row incarnation (release/reuse guard:
+                          # a frame from a recycled row's previous life must
+                          # never be applied to its successor)
     payload: bytes = b""  # CLIENT_* / SNAPSHOT body
     aux: bytes = b""      # SNAPSHOT: serialized member table (conf blocks
                           # below the truncation floor are gone, so cluster
@@ -84,6 +87,8 @@ class WireMsg:
             ]
         if self.req_id:
             d["r"] = self.req_id
+        if self.inc:
+            d["i"] = self.inc
         if self.payload:
             d["p"] = base64.b64encode(self.payload).decode()
         if self.aux:
@@ -102,6 +107,7 @@ class WireMsg:
                 for i, p, data in d.get("b", [])
             ],
             req_id=d.get("r", ""),
+            inc=d.get("i", 0),
             payload=base64.b64decode(d["p"]) if "p" in d else b"",
             aux=base64.b64decode(d["a"]) if "a" in d else b"",
         )
@@ -145,10 +151,11 @@ class MsgBatch:
     """
 
     __slots__ = ("src", "dst", "group", "kind_col", "term", "x", "y", "z",
-                 "ok", "blocks")
+                 "ok", "inc", "blocks")
     kind = MSG_BATCH  # class-level: transport/server dispatch parity w/ WireMsg
 
-    def __init__(self, src, dst, group, kind_col, term, x, y, z, ok, blocks=None):
+    def __init__(self, src, dst, group, kind_col, term, x, y, z, ok,
+                 blocks=None, inc=None):
         self.src = src
         self.dst = dst
         self.group = group        # np.intp[count], ascending
@@ -158,6 +165,8 @@ class MsgBatch:
         self.y = y
         self.z = z
         self.ok = ok              # np.int32[count]
+        # Per-entry data-group row incarnation (release/reuse guard).
+        self.inc = inc if inc is not None else np.zeros(len(group), np.int64)
         self.blocks = blocks if blocks is not None else {}  # group -> [Block]
 
     def __len__(self) -> int:
@@ -166,7 +175,7 @@ class MsgBatch:
     def encode(self) -> bytes:
         n = len(self.group)
         parts = [
-            _BATCH_HDR.pack(_BATCH_MAGIC, 1, self.src, self.dst, n,
+            _BATCH_HDR.pack(_BATCH_MAGIC, 2, self.src, self.dst, n,
                             len(self.blocks)),
             np.ascontiguousarray(self.group, dtype=">u4").tobytes(),
             np.ascontiguousarray(self.kind_col, dtype=">u1").tobytes(),
@@ -175,6 +184,7 @@ class MsgBatch:
             np.ascontiguousarray(self.y, dtype=">u8").tobytes(),
             np.ascontiguousarray(self.z, dtype=">u8").tobytes(),
             np.ascontiguousarray(self.ok, dtype=">u1").tobytes(),
+            np.ascontiguousarray(self.inc, dtype=">u4").tobytes(),
         ]
         for g, blks in self.blocks.items():
             parts.append(_SPAN_HDR.pack(g, len(blks)))
@@ -186,7 +196,7 @@ class MsgBatch:
     @classmethod
     def decode(cls, raw: bytes) -> "MsgBatch":
         magic, ver, src, dst, n, nspans = _BATCH_HDR.unpack_from(raw, 0)
-        if magic != _BATCH_MAGIC or ver != 1:
+        if magic != _BATCH_MAGIC or ver not in (1, 2):
             raise ValueError(f"bad batch frame (magic={magic} ver={ver})")
         o = _BATCH_HDR.size
 
@@ -203,6 +213,8 @@ class MsgBatch:
         y = col(">u8", 8, np.int64)
         z = col(">u8", 8, np.int64)
         ok = col(">u1", 1, np.int32)
+        inc = (col(">u4", 4, np.int64) if ver >= 2
+               else np.zeros(n, np.int64))
         blocks: dict[int, list[Block]] = {}
         for _ in range(nspans):
             g, nb = _SPAN_HDR.unpack_from(raw, o)
@@ -224,7 +236,8 @@ class MsgBatch:
         if o != len(raw):
             raise ValueError(
                 f"batch frame has {len(raw) - o} trailing bytes")
-        return cls(src, dst, group, kind_col, term, x, y, z, ok, blocks)
+        return cls(src, dst, group, kind_col, term, x, y, z, ok, blocks,
+                   inc=inc)
 
     def take(self, mask: np.ndarray) -> "MsgBatch":
         """Column-sliced copy keeping entries where ``mask`` is True (and
@@ -235,7 +248,8 @@ class MsgBatch:
             blocks = {g: b for g, b in blocks.items() if g in kept}
         return MsgBatch(self.src, self.dst, self.group[mask],
                         self.kind_col[mask], self.term[mask], self.x[mask],
-                        self.y[mask], self.z[mask], self.ok[mask], blocks)
+                        self.y[mask], self.z[mask], self.ok[mask], blocks,
+                        inc=self.inc[mask])
 
     def messages(self):
         """Materialize per-entry WireMsgs (debug/tests; the hot path never
@@ -247,6 +261,7 @@ class MsgBatch:
                 dst=self.dst, term=int(self.term[i]), x=int(self.x[i]),
                 y=int(self.y[i]), z=int(self.z[i]), ok=int(self.ok[i]),
                 blocks=list(self.blocks.get(g, [])),
+                inc=int(self.inc[i]),
             )
 
 

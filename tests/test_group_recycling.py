@@ -1,0 +1,392 @@
+"""Consensus-group row recycling: release -> reset/ack barrier -> reuse.
+
+Rows on the P axis were previously allocated monotonically (a reused row
+would have inherited the dead topic's chain/log state), so sustained topic
+churn permanently exhausted the pool. Recycling makes reuse safe with two
+mechanisms:
+
+* a distributed barrier: a released row re-enters the claimable pool only
+  after EVERY replica host has reset its local row state (chain to
+  genesis, device row demoted, partition-FSM records cleared) and had a
+  GroupReleased ack committed through Raft — a node that slept through the
+  delete therefore blocks reuse until it too has reset;
+* an incarnation guard: each claim bumps the row's replicated incarnation
+  counter, every outbound data-group frame is stamped with it, and intake
+  drops mismatches — a stale frame lingering in a reconnect queue from the
+  row's previous life (worst case: an old InstallSnapshot that would
+  resurrect the dead topic's data) can never be applied to its successor.
+
+No reference analog: the reference has exactly one consensus group and no
+topic deletion over the wire.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from josefine_tpu.broker import records
+from josefine_tpu.broker.fsm import JosefineFsm, Transition
+from josefine_tpu.broker.state import Partition, Store, Topic
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.chain import GENESIS
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+from test_integration import NodeManager
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+# ------------------------------------------------------------- store unit
+
+
+def test_store_release_ack_reuse_lifecycle():
+    store = Store(MemKV())
+    pool = 4  # rows 1..3
+    assert [store.claim_group(pool) for _ in range(3)] == [1, 2, 3]
+    assert store.claim_group(pool) == -1  # exhausted
+    assert store.group_incarnation(1) == 1
+
+    # Release row 2 to holders {10, 20}: not reusable until both ack.
+    store.release_group(2, [20, 10])
+    assert store.claim_group(pool) == -1
+    assert store.groups_pending_release(10) == [2]
+    assert store.ack_group_release(2, 10) is False
+    assert store.claim_group(pool) == -1
+    assert store.groups_pending_release(10) == []
+    assert store.ack_group_release(2, 20) is True
+    # Reused at the next claim, with a bumped incarnation.
+    assert store.claim_group(pool) == 2
+    assert store.group_incarnation(2) == 2
+
+    # A row with no holders frees immediately; repeated acks no-op.
+    store.release_group(3, [])
+    assert store.ack_group_release(3, 99) is False
+    assert store.claim_group(pool) == 3
+    assert store.group_incarnation(3) == 2
+
+
+def test_store_recycles_lowest_row_first():
+    store = Store(MemKV())
+    pool = 5
+    assert [store.claim_group(pool) for _ in range(4)] == [1, 2, 3, 4]
+    store.release_group(3, [])
+    store.release_group(1, [])
+    assert store.claim_group(pool) == 1
+    assert store.claim_group(pool) == 3
+    assert store.claim_group(pool) == -1
+
+
+# ---------------------------------------------------------------- via FSM
+
+
+def test_delete_topic_drains_rows_and_acks_free_them():
+    store = Store(MemKV())
+    fsm = JosefineFsm(store, group_pool=4)
+    fsm.transition(Transition.ensure_topic(
+        Topic(id="t1", name="t", partitions={0: [1, 2]}, internal=False)))
+    fsm.transition(Transition.ensure_partition(Partition(
+        id="p0", idx=0, topic="t", isr=[1, 2], assigned_replicas=[1, 2],
+        leader=1, group=-1)))
+    p = store.get_partition("t", 0)
+    assert p.group == 1
+
+    fsm.transition(Transition.delete_topic("t"))
+    assert store.groups_pending_release(1) == [1]
+    assert store.groups_pending_release(2) == [1]
+    assert store.claim_group(4) == 2  # row 1 still draining -> fresh row
+
+    fsm.transition(Transition.group_released(1, 1))
+    fsm.transition(Transition.group_released(1, 2))
+    assert store.claim_group(4) == 1  # recycled
+    assert store.group_incarnation(1) == 2
+
+
+# -------------------------------------------------- engine intake guard
+
+
+def test_engine_drops_stale_incarnation_frames():
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2], 1, groups=3, params=PARAMS)
+        e.set_group_incarnation(2, 2)
+
+        def batch(inc):
+            n = 1
+            return rpc.MsgBatch(
+                1, 0, np.array([2], np.intp),
+                np.array([rpc.MSG_VOTE_REQ], np.int32),
+                np.array([1], np.int64), np.zeros(n, np.int64),
+                np.zeros(n, np.int64), np.zeros(n, np.int64),
+                np.zeros(n, np.int32), inc=np.array([inc], np.int64))
+
+        e.receive(batch(1))  # stale incarnation
+        assert not e._pending_batches
+        e.receive(batch(2))  # current
+        assert len(e._pending_batches) == 1
+
+        # WireMsg path: a stale-incarnation InstallSnapshot (the dangerous
+        # one — it would resurrect the dead topic's data) is dropped before
+        # any staging.
+        snap = rpc.WireMsg(kind=rpc.MSG_SNAPSHOT, group=2, src=1, dst=0,
+                           x=1 << 32, y=0, z=4, payload=b"old!", inc=1)
+        e.receive(snap)
+        assert 2 not in e._snap_staging
+        stale_vote = rpc.WireMsg(kind=rpc.MSG_VOTE_REQ, group=2, src=1,
+                                 dst=0, term=9, inc=1)
+        e.receive(stale_vote)
+        assert not e._pending_msgs
+
+    asyncio.run(main())
+
+
+def test_unsorted_batch_keeps_incarnation_column():
+    """The intake's re-sort normalization must carry the inc column: losing
+    it would zero-fill and drop EVERY entry for claimed rows (incarnation
+    >= 1) as 'stale'."""
+    async def main():
+        e = RaftEngine(MemKV(), [1, 2], 1, groups=3, params=PARAMS)
+        e.set_group_incarnation(1, 1)
+        e.set_group_incarnation(2, 2)
+        b = rpc.MsgBatch(
+            1, 0, np.array([2, 1], np.intp),  # descending: forces re-sort
+            np.array([rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_REQ], np.int32),
+            np.array([1, 1], np.int64), np.zeros(2, np.int64),
+            np.zeros(2, np.int64), np.zeros(2, np.int64),
+            np.zeros(2, np.int32), inc=np.array([2, 1], np.int64))
+        e.receive(b)
+        assert len(e._pending_batches) == 1
+        kept = e._pending_batches[0]
+        assert kept.group.tolist() == [1, 2]
+        assert kept.inc.tolist() == [1, 2]  # per-entry inc followed the sort
+
+    asyncio.run(main())
+
+
+def test_batch_messages_carry_incarnation():
+    """messages() (the test-harness materializer) must propagate per-entry
+    inc, or fault-injection harnesses feeding WireMsgs back into engines
+    would silently lose all traffic for claimed rows."""
+    b = rpc.MsgBatch(
+        0, 1, np.array([1], np.intp), np.array([rpc.MSG_APPEND], np.int32),
+        np.array([1], np.int64), np.zeros(1, np.int64),
+        np.zeros(1, np.int64), np.zeros(1, np.int64),
+        np.zeros(1, np.int32), inc=np.array([3], np.int64))
+    (m,) = list(b.messages())
+    assert m.inc == 3
+
+
+def test_recycle_group_demotes_device_row():
+    async def main():
+        kv = MemKV()
+        e = RaftEngine(kv, [1], 1, groups=2, params=PARAMS)
+        for _ in range(12):
+            e.tick()
+        assert e.is_leader(1)
+        f = e.propose(1, b"payload")
+        for _ in range(4):
+            e.tick()
+        await f
+        assert e.chains[1].head > GENESIS
+
+        e.recycle_group(1)
+        assert e.chains[1].head == GENESIS
+        assert not e.is_leader(1)
+        assert int(np.asarray(e.state.role)[1]) == 0
+        assert e.chains[1].committed == GENESIS
+        # Term survives (monotonicity across incarnations).
+        assert e.term(1) >= 1
+        # The row elects again and works from a clean chain.
+        for _ in range(15):
+            e.tick()
+        assert e.is_leader(1)
+        f = e.propose(1, b"fresh")
+        for _ in range(4):
+            e.tick()
+        await f
+        assert e.chains[1].committed > GENESIS
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ end-to-end
+
+
+async def _create(cl, name, partitions, rf):
+    resp = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+        "topics": [{"name": name, "num_partitions": partitions,
+                    "replication_factor": rf, "assignments": [],
+                    "configs": []}],
+        "timeout_ms": 10000, "validate_only": False,
+    }, timeout=25.0), 30)
+    return resp["topics"][0]
+
+
+@pytest.mark.asyncio
+async def test_topic_churn_reuses_rows_end_to_end(tmp_path):
+    """Create -> delete -> recreate with a pool that REQUIRES reuse: the
+    new topic claims the recycled rows (bumped incarnation), every replica
+    starts it from a clean chain/log (offsets from 0), and the data plane
+    replicates normally."""
+    async with NodeManager(3, tmp_path, partitions=3) as mgr:  # rows 1, 2
+        await mgr.wait_registered()
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            assert (await _create(cl, "alpha", 2, 3))["error_code"] == ErrorCode.NONE
+            for _ in range(100):
+                parts = mgr.nodes[0].store.get_partitions("alpha")
+                if len(parts) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(p.group for p in parts) == [1, 2]
+            assert mgr.nodes[0].store.claim_group(3) == -1  # pool exhausted
+
+            # Produce one record so the rows carry real state to reset.
+            for _ in range(200):
+                lead = next((n for n in mgr.nodes
+                             if n.raft.engine.is_leader(parts[0].group)), None)
+                if lead:
+                    break
+                await asyncio.sleep(0.05)
+            cl2 = await kafka_client.connect(
+                "127.0.0.1", mgr.broker_ports[lead.config.broker.id - 1])
+            pr = await asyncio.wait_for(cl2.send(ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": "alpha", "partitions": [
+                    {"index": parts[0].idx,
+                     "records": records.build_batch(b"old-life", 1)}]}],
+            }), 15)
+            assert (pr["responses"][0]["partitions"][0]["error_code"]
+                    == ErrorCode.NONE)
+            await cl2.close()
+
+            # Delete; the rows drain and (with every host live) free.
+            dr = await asyncio.wait_for(cl.send(ApiKey.DELETE_TOPICS, 1, {
+                "topic_names": ["alpha"], "timeout_ms": 10000}), 15)
+            assert dr["responses"][0]["error_code"] == ErrorCode.NONE
+
+            def freed():
+                s = mgr.nodes[0].store
+                return (not s.groups_pending_release(1)
+                        and not s.groups_pending_release(2)
+                        and not s.groups_pending_release(3)
+                        and sorted(s._galloc_free_rows()) == [1, 2])
+            for _ in range(300):
+                if freed():
+                    break
+                await asyncio.sleep(0.05)
+            assert freed(), "released rows never freed"
+
+            # Recreate: MUST reuse rows 1 and 2, at incarnation 2.
+            assert (await _create(cl, "beta", 2, 3))["error_code"] == ErrorCode.NONE
+            for _ in range(100):
+                bparts = mgr.nodes[0].store.get_partitions("beta")
+                if len(bparts) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(p.group for p in bparts) == [1, 2]
+            for n in mgr.nodes:
+                for p in bparts:
+                    assert n.store.group_incarnation(p.group) == 2
+                    assert n.raft.engine.group_incarnation(p.group) == 2
+                    # Fresh chain: no old-life blocks.
+                    assert n.raft.engine.chains[p.group].committed == GENESIS \
+                        or n.raft.engine.chains[p.group].head >= GENESIS
+
+            # The reused rows elect and replicate; offsets start at 0.
+            bp = bparts[0]
+            for _ in range(400):
+                lead = next((n for n in mgr.nodes
+                             if n.raft.engine.is_leader(bp.group)), None)
+                if lead:
+                    break
+                await asyncio.sleep(0.05)
+            assert lead, "recycled row never elected"
+            cl3 = await kafka_client.connect(
+                "127.0.0.1", mgr.broker_ports[lead.config.broker.id - 1])
+            pr = await asyncio.wait_for(cl3.send(ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": "beta", "partitions": [
+                    {"index": bp.idx,
+                     "records": records.build_batch(b"new-life", 1)}]}],
+            }), 15)
+            p0 = pr["responses"][0]["partitions"][0]
+            assert (p0["error_code"], p0["base_offset"]) == (ErrorCode.NONE, 0)
+            fr = await asyncio.wait_for(cl3.send(ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 0, "min_bytes": 1,
+                "max_bytes": 1 << 20, "isolation_level": 0,
+                "topics": [{"topic": "beta", "partitions": [
+                    {"partition": bp.idx, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 20}]}],
+            }), 15)
+            fp = fr["responses"][0]["partitions"][0]
+            assert b"new-life" in fp["records"]
+            assert b"old-life" not in fp["records"]
+            await cl3.close()
+        finally:
+            await cl.close()
+
+
+@pytest.mark.asyncio
+async def test_down_replica_blocks_reuse_until_it_resets(tmp_path):
+    """A replica host that sleeps through the delete blocks reuse (the
+    barrier): the rows stay draining until it restarts, resets its leftover
+    row state, and its ack commits."""
+    from josefine_tpu.node import Node
+
+    async with NodeManager(3, tmp_path, partitions=3, in_memory=False) as mgr:
+        await mgr.wait_registered()
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            assert (await _create(cl, "t", 1, 3))["error_code"] == ErrorCode.NONE
+            for _ in range(100):
+                parts = mgr.nodes[0].store.get_partitions("t")
+                if parts:
+                    break
+                await asyncio.sleep(0.05)
+            g = parts[0].group
+            assert g == 1
+        finally:
+            await cl.close()
+
+        # Node 3 sleeps through the delete.
+        victim = 2
+        await mgr.nodes[victim].stop()
+        mgr.nodes[victim] = None
+        await asyncio.sleep(0.3)
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            dr = await asyncio.wait_for(cl.send(ApiKey.DELETE_TOPICS, 1, {
+                "topic_names": ["t"], "timeout_ms": 10000}), 20)
+            assert dr["responses"][0]["error_code"] == ErrorCode.NONE
+        finally:
+            await cl.close()
+
+        # Live hosts ack, but the row must STAY draining on the victim's
+        # account — not claimable.
+        s = mgr.nodes[0].store
+        for _ in range(200):
+            if (not s.groups_pending_release(1)
+                    and not s.groups_pending_release(2)
+                    and s.groups_pending_release(3) == [g]):
+                break
+            await asyncio.sleep(0.05)
+        assert s.groups_pending_release(3) == [g]
+        assert not s._galloc_free_rows()
+
+        # Victim restarts over its durable state: it resets the leftover
+        # row and acks; the row frees cluster-wide.
+        node = Node(mgr.configs[victim], in_memory=False)
+        await node.start()
+        mgr.nodes[victim] = node
+        for _ in range(400):
+            if (s._galloc_free_rows() == [g]
+                    and not s.groups_pending_release(3)):
+                break
+            await asyncio.sleep(0.05)
+        assert s._galloc_free_rows() == [g]
+        # And its local leftover chain state is gone.
+        assert node.raft.engine.chains[g].head == GENESIS
