@@ -18,6 +18,7 @@ from josefine_tpu.broker.state import (
     Partition,
     Store,
     GroupReleased,
+    PidAlloc,
     Topic,
     TopicTombstone,
 )
@@ -30,6 +31,7 @@ _COMMIT_OFFSET = 5
 _DELETE_TOPIC = 6
 _COMMIT_OFFSETS = 7
 _GROUP_RELEASED = 8
+_ALLOC_PID = 9
 
 _KINDS = {
     _ENSURE_TOPIC: Topic,
@@ -40,6 +42,7 @@ _KINDS = {
     _DELETE_TOPIC: TopicTombstone,
     _COMMIT_OFFSETS: OffsetCommitBatch,
     _GROUP_RELEASED: GroupReleased,
+    _ALLOC_PID: PidAlloc,
 }
 _TAGS = {v: k for k, v in _KINDS.items()}
 
@@ -76,9 +79,14 @@ class Transition:
         return bytes([_DELETE_TOPIC]) + TopicTombstone(name=name).encode()
 
     @staticmethod
-    def group_released(group: int, broker_id: int) -> bytes:
+    def alloc_pid() -> bytes:
+        return bytes([_ALLOC_PID]) + PidAlloc().encode()
+
+    @staticmethod
+    def group_released(group: int, broker_id: int, inc: int = -1) -> bytes:
         return (bytes([_GROUP_RELEASED])
-                + GroupReleased(group=group, broker_id=broker_id).encode())
+                + GroupReleased(group=group, broker_id=broker_id,
+                                inc=inc).encode())
 
     @staticmethod
     def decode(data: bytes):
@@ -137,10 +145,14 @@ class JosefineFsm:
             for oc in entity.entries:
                 self.store.commit_offset(oc)
             applied = entity
+        elif isinstance(entity, PidAlloc):
+            entity.id = self.store.alloc_pid()
+            applied = entity
         elif isinstance(entity, GroupReleased):
             # One replica host reset its local row state; when the last ack
             # lands the row re-enters the claimable pool (claim_group).
-            self.store.ack_group_release(entity.group, entity.broker_id)
+            self.store.ack_group_release(entity.group, entity.broker_id,
+                                         entity.inc)
             applied = entity
         elif isinstance(entity, TopicTombstone):
             released = self.store.get_partitions(entity.name)

@@ -27,7 +27,7 @@ import uuid
 
 from josefine_tpu.broker import records
 from josefine_tpu.broker import partition_fsm
-from josefine_tpu.broker.fsm import Transition
+from josefine_tpu.broker.fsm import Transition, decode_result as fsm_decode_result
 from josefine_tpu.broker.groups import GroupCoordinator
 from josefine_tpu.broker.replica import ReplicaRegistry
 from josefine_tpu.broker.state import Broker as BrokerInfo
@@ -158,6 +158,8 @@ class Broker:
                 return await self.offset_commit(api_version, body)
             if api_key == ApiKey.OFFSET_FETCH:
                 return self.offset_fetch(api_version, body)
+            if api_key == ApiKey.INIT_PRODUCER_ID:
+                return await self.init_producer_id(api_version, body)
         except Exception:
             _m_errors.inc(api=api_key)
             log.exception("handler error api=%d v=%d", api_key, api_version)
@@ -509,7 +511,7 @@ class Broker:
                 task.add_done_callback(self._bg_tasks.discard)
                 return int(ErrorCode.NONE), -1
             result = await self.client.propose_local(batch, group=group)
-            return int(ErrorCode.NONE), partition_fsm.decode_base_offset(result)
+            return partition_fsm.decode_produce_result(result)
         except NotLeader:
             return int(ErrorCode.NOT_LEADER_OR_FOLLOWER), -1
         except (ProposalTimeout, asyncio.TimeoutError):
@@ -517,6 +519,29 @@ class Broker:
         except Exception:  # noqa: BLE001 - surfaced to the client
             log.exception("replicated produce failed (group %d)", group)
             return int(ErrorCode.UNKNOWN_SERVER_ERROR), -1
+
+    async def init_producer_id(self, version: int, body: dict) -> dict:
+        """Idempotent-producer id allocation: a replicated counter through
+        Raft, so ids are unique cluster-wide and survive failover. No
+        transactional support (transactional_id must be null) — same
+        boundary real brokers had before transactions. No reference analog
+        (its Produce path is unreachable; SURVEY.md quirk 8)."""
+        resp = {"throttle_time_ms": 0, "error_code": ErrorCode.NONE,
+                "producer_id": -1, "producer_epoch": -1}
+        if body.get("transactional_id") is not None:
+            resp["error_code"] = ErrorCode.INVALID_REQUEST
+            return resp
+        try:
+            result = await self.client.propose(Transition.alloc_pid())
+            entity = fsm_decode_result(result)
+            resp["producer_id"] = entity.id
+            resp["producer_epoch"] = 0
+        except (ProposalTimeout, asyncio.TimeoutError):
+            resp["error_code"] = ErrorCode.REQUEST_TIMED_OUT
+        except Exception:  # noqa: BLE001 - surfaced to the client
+            log.exception("producer id allocation failed")
+            resp["error_code"] = ErrorCode.UNKNOWN_SERVER_ERROR
+        return resp
 
     def _local_replica(self, topic: str, idx: int):
         """Replica this broker hosts, materialized from the replicated store

@@ -49,6 +49,12 @@ from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("broker.partition_fsm")
 
+# Producer-dedup map bound per partition (deterministic LRU by last-seen
+# block id). A producer idle long enough to be evicted loses dedup
+# protection for its next retry — the same trade real brokers make with
+# producer.id.expiration.ms.
+_MAX_PIDS = 256
+
 
 class PartitionFsm:
     """Applies committed record batches of one consensus group to a Log."""
@@ -65,6 +71,11 @@ class PartitionFsm:
         self._rkey = b"pfsm:r:%d" % group
         self._applied = 0
         self._skip_torn = False
+        # Idempotent-producer dedup: pid -> [epoch, base_seq, count,
+        # base_offset] of the LAST applied blob from that producer. Part of
+        # the replicated state (persisted per apply, rides snapshots): every
+        # replica must make identical dedup decisions at apply time.
+        self._pids: dict[int, list[int]] = {}
         if kv.get(self._rkey) is not None:
             # Crash mid-restore: the log was wiped/partially rebuilt while
             # the position record still describes the pre-restore state.
@@ -76,7 +87,8 @@ class PartitionFsm:
             return
         raw = kv.get(self._key)
         if raw is not None:
-            self._applied, recorded_end = struct.unpack(">QQ", raw)
+            self._applied, recorded_end = struct.unpack_from(">QQ", raw)
+            self._pids = _decode_pids(raw[16:])
             actual_end = self.log.next_offset()
             if actual_end < recorded_end:
                 # The log is SHORTER than the position record claims — e.g.
@@ -101,12 +113,17 @@ class PartitionFsm:
     def _reset_replica(self) -> None:
         """The ONE wipe-and-reset sequence (crash-recovery paths share it so
         their ordering can never diverge): empty log, zero position record,
-        clear any restore-intent marker."""
+        cleared producer-dedup state, no restore-intent marker."""
         self.log.wipe()
         self._applied = 0
         self._skip_torn = False
-        self.kv.put(self._key, struct.pack(">QQ", 0, 0))
+        self._pids = {}
+        self.kv.put(self._key, self._record())
         self.kv.delete(self._rkey)
+
+    def _record(self) -> bytes:
+        return (struct.pack(">QQ", self._applied, self.log.next_offset())
+                + _encode_pids(self._pids))
 
     # Engine replay contract: blocks in (applied_id(), committed] are
     # re-applied through transition_block at registration time.
@@ -117,27 +134,70 @@ class PartitionFsm:
         if blk.id <= self._applied:
             return b""  # duplicate delivery (defensive; replay is exact)
         batch = blk.data
-        count = records.record_count(batch)
-        if self._skip_torn:
-            self._skip_torn = False
-            base = self.log.next_offset() - count
-        else:
-            base = self.log.next_offset()
-            self.log.append(records.set_base_offset(batch, base), count=count)
+        pid, epoch, base_seq, count = records.blob_producer_info(batch)
+        # Idempotent-producer dedup, decided deterministically at APPLY time
+        # (every replica holds the same pid state at the same commit point,
+        # so all make the same call). A retried produce whose original DID
+        # commit re-acks the original base offset instead of appending a
+        # second copy — the guarantee real Kafka gives with enable.idempotence
+        # and the reference cannot (its Produce is unreachable; SURVEY.md
+        # quirk 8).
+        err = 0
+        append = True
+        if pid >= 0 and base_seq >= 0:
+            last = self._pids.get(pid)
+            if last is not None and epoch >= last[0]:
+                lepoch, lseq, lcount, lbase = last[:4]
+                if epoch == lepoch and base_seq == lseq:
+                    # Exact retry of the last blob: ack its original base.
+                    append = False
+                    base = lbase
+                elif epoch == lepoch and base_seq < lseq + lcount:
+                    # Older than our dedup window: refuse rather than
+                    # double-append (Kafka DUPLICATE_SEQUENCE_NUMBER).
+                    append = False
+                    err, base = 46, -1
+                elif epoch == lepoch and base_seq != lseq + lcount:
+                    # Sequence gap (Kafka OUT_OF_ORDER_SEQUENCE_NUMBER).
+                    append = False
+                    err, base = 45, -1
+                # epoch > lepoch: new producer session — accept and re-track.
+            elif last is not None:
+                # Stale epoch (Kafka INVALID_PRODUCER_EPOCH).
+                append = False
+                err, base = 47, -1
+        if append:
+            if self._skip_torn:
+                self._skip_torn = False
+                base = self.log.next_offset() - count
+            else:
+                base = self.log.next_offset()
+                self.log.append(records.set_base_offset(batch, base),
+                                count=count)
+            if pid >= 0 and base_seq >= 0:
+                self._pids[pid] = [epoch, base_seq, count, base, blk.id]
+                if len(self._pids) > _MAX_PIDS:
+                    # Deterministic eviction (every replica applies the same
+                    # sequence, so last-seen block ids agree): drop the
+                    # longest-idle producer — the analog of Kafka's
+                    # producer.id.expiration, bounding both the map and the
+                    # per-apply record rewrite.
+                    oldest = min(self._pids, key=lambda k: self._pids[k][4])
+                    del self._pids[oldest]
         self._applied = blk.id
-        self.kv.put(self._key,
-                    struct.pack(">QQ", blk.id, self.log.next_offset()))
-        if self.on_append is not None:
+        self.kv.put(self._key, self._record())
+        if append and self.on_append is not None:
             self.on_append()
-        return struct.pack(">q", base)
+        return struct.pack(">hq", err, base)
 
     # ------------------------------------------------- snapshot / log sync
 
     def snapshot(self) -> bytes:
-        """Tiny manifest: the data already sits in the seglog; a snapshot
-        only needs to pin (applied block id, log end) so the chain below it
-        can be truncated and a restore knows what prefix to expect."""
-        return struct.pack(">QQ", self._applied, self.log.next_offset())
+        """Small manifest: the data already sits in the seglog; a snapshot
+        pins (applied block id, log end) plus the producer-dedup map so the
+        chain below it can be truncated and a restored replica keeps making
+        identical dedup decisions."""
+        return self._record()
 
     def snapshot_resume_offset(self) -> int:
         """Where an incremental log sync may resume: everything below our
@@ -154,13 +214,16 @@ class PartitionFsm:
         receiver reported its resume position); 0 ships the full prefix.
         Called lazily at ship time (engine ``_snapshot_msg``) so the big
         payload is never stored twice."""
-        if len(record) != 16:
+        if len(record) < 16:
             raise ValueError(
                 f"g={self.group} snapshot record is {len(record)} bytes, "
-                "expected a 16-byte manifest")
-        applied, end = struct.unpack(">QQ", record)
+                "expected a manifest of at least 16")
+        applied, end = struct.unpack_from(">QQ", record)
+        pid_bytes = record[16:]
+        _decode_pids(pid_bytes)  # validate before shipping
         start = min(max(0, start), end)
-        out = [struct.pack(">QQQ", applied, end, start)]
+        out = [struct.pack(">QQQI", applied, end, start, len(pid_bytes)),
+               pid_bytes]
         off = start
         done = False
         while off < end and not done:
@@ -194,17 +257,20 @@ class PartitionFsm:
         including the empty payload: restore() is wire-reachable, so an
         empty-means-reset branch would let a degenerate MSG_SNAPSHOT wipe a
         healthy replica (internal resets use _reset_replica)."""
-        if len(data) < 24:
+        if len(data) < 28:
             raise ValueError("partition snapshot shorter than its header")
-        applied, end, start = struct.unpack_from(">QQQ", data)
+        applied, end, start, pid_len = struct.unpack_from(">QQQI", data)
         if start > end:
             raise ValueError(f"snapshot start {start} beyond end {end}")
         if start > 0 and start != self.log.next_offset():
             raise ValueError(
                 f"incremental snapshot starts at {start}, local log end is "
                 f"{self.log.next_offset()}")
+        if 28 + pid_len > len(data):
+            raise ValueError("truncated producer-dedup map")
+        pids = _decode_pids(data[28:28 + pid_len])  # validate before mutate
         frames: list[tuple[int, bytes]] = []
-        pos, off = 24, start
+        pos, off = 28 + pid_len, start
         while pos < len(data):
             if pos + 16 > len(data):
                 raise ValueError("truncated snapshot frame header")
@@ -237,7 +303,8 @@ class PartitionFsm:
             self.log.append(payload, count=count)
         self._applied = applied
         self._skip_torn = False
-        self.kv.put(self._key, struct.pack(">QQ", applied, end))
+        self._pids = pids
+        self.kv.put(self._key, self._record())
         self.kv.delete(self._rkey)
         if self.on_append is not None:
             self.on_append()
@@ -246,7 +313,37 @@ class PartitionFsm:
         pass  # the Log is owned by the Replica registry
 
 
+def _encode_pids(pids: dict[int, list[int]]) -> bytes:
+    """Deterministic (sorted-key) serialization — the map is replicated
+    state and snapshots of it must be byte-identical across replicas."""
+    if not pids:
+        return b""
+    import json
+
+    return json.dumps({str(k): v for k, v in sorted(pids.items())},
+                      separators=(",", ":")).encode()
+
+
+def _decode_pids(raw: bytes) -> dict[int, list[int]]:
+    if not raw:
+        return {}
+    import json
+
+    try:
+        d = json.loads(raw)
+        return {int(k): [int(x) for x in v] for k, v in d.items()}
+    except (ValueError, TypeError, AttributeError) as e:
+        raise ValueError(f"bad producer-dedup map: {e}") from None
+
+
+def decode_produce_result(result: bytes) -> tuple[int, int]:
+    """(error_code, base_offset) from a committed produce proposal's FSM
+    result. error_code is a Kafka code (45 out-of-order sequence, 46
+    duplicate sequence, 47 invalid producer epoch) or 0."""
+    err, base = struct.unpack(">hq", result)
+    return err, base
+
+
 def decode_base_offset(result: bytes) -> int:
-    """Base offset from a committed produce proposal's FSM result."""
-    (base,) = struct.unpack(">q", result)
-    return base
+    """Base offset only (legacy callers/tests)."""
+    return decode_produce_result(result)[1]

@@ -140,18 +140,45 @@ def set_base_offset(blob: bytes, base: int) -> bytes:
     return bytes(out)
 
 
+_PRODUCER_ID = 43      # i64
+_PRODUCER_EPOCH = 51   # i16
+_BASE_SEQUENCE = 53    # i32
 _RECORDS_COUNT = 57
 
 
-def build_batch(payload: bytes, n_records: int = 1) -> bytes:
+def build_batch(payload: bytes, n_records: int = 1, pid: int = -1,
+                epoch: int = 0, base_seq: int = -1) -> bytes:
     """A minimal v2 record batch wrapping opaque record bytes (test/demo
     producer; the broker itself never builds batches). Carries a real
-    CRC-32C so it passes produce-ingress validation."""
+    CRC-32C so it passes produce-ingress validation; pid/epoch/base_seq
+    populate the idempotent-producer header fields (all inside the CRC'd
+    region)."""
     header = bytearray(BATCH_OVERHEAD)
     struct.pack_into(">i", header, 8, BATCH_OVERHEAD - 12 + len(payload))
     header[_MAGIC_OFFSET] = 2
     struct.pack_into(">i", header, _LAST_OFFSET_DELTA, n_records - 1)
+    struct.pack_into(">q", header, _PRODUCER_ID, pid)
+    struct.pack_into(">h", header, _PRODUCER_EPOCH, epoch)
+    struct.pack_into(">i", header, _BASE_SEQUENCE, base_seq)
     struct.pack_into(">i", header, _RECORDS_COUNT, n_records)
     crc = _crc32c(bytes(header[_ATTRIBUTES_OFFSET:]) + payload)
     struct.pack_into(">I", header, _CRC_OFFSET, crc)
     return bytes(header) + payload
+
+
+def blob_producer_info(blob: bytes):
+    """Idempotence view of a records field: (pid, epoch, base_seq,
+    total_count) where pid/epoch/base_seq come from the FIRST batch and
+    total_count spans the whole concatenation. A producer's batches within
+    one request carry consecutive sequences, so the blob is deduplicated
+    as one unit (matching its one-block-one-log-append replication).
+    pid == -1 means non-idempotent."""
+    spans = list(_batch_spans(blob))
+    if not spans:
+        return -1, 0, -1, 1
+    start = spans[0][0]
+    (pid,) = struct.unpack_from(">q", blob, start + _PRODUCER_ID)
+    (epoch,) = struct.unpack_from(">h", blob, start + _PRODUCER_EPOCH)
+    (base_seq,) = struct.unpack_from(">i", blob, start + _BASE_SEQUENCE)
+    total = sum(c for _, _, c in spans)
+    return pid, epoch, base_seq, total

@@ -162,14 +162,34 @@ class TopicTombstone:
 
 
 @dataclass
+class PidAlloc:
+    """Idempotent-producer id allocation through Raft: the FSM fills in the
+    id from a replicated counter at apply time, so ids are unique
+    cluster-wide and survive leader failover."""
+
+    id: int = -1
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "PidAlloc":
+        return cls(**json.loads(raw))
+
+
+@dataclass
 class GroupReleased:
     """One replica host's ack that it reset its local state for a released
     consensus-group row (chain, device row, partition-FSM records). The row
     becomes reusable by claim_group once every replica host's ack commits —
-    the distributed barrier that makes row recycling safe."""
+    the distributed barrier that makes row recycling safe. ``inc`` pins the
+    ack to the incarnation being drained: at-least-once retries can land
+    after the row was reused and released AGAIN, and a stale duplicate must
+    not satisfy the later drain cycle."""
 
     group: int
     broker_id: int
+    inc: int = -1
 
     def encode(self) -> bytes:
         return _dumps(asdict(self))
@@ -325,9 +345,14 @@ class Store:
         self._kv.put(self._pfx + b"galloc:drain:%d" % g,
                      b",".join(b"%d" % b for b in pending))
 
-    def ack_group_release(self, g: int, broker_id: int) -> bool:
+    def ack_group_release(self, g: int, broker_id: int,
+                          inc: int = -1) -> bool:
         """Record one replica host's reset ack; returns True when the row
-        just became free. Idempotent: unknown rows / repeated acks no-op."""
+        just became free. Idempotent: unknown rows / repeated acks no-op,
+        and an ack pinned to a different incarnation (a straggler duplicate
+        from a previous drain cycle of the same row) is ignored."""
+        if inc != -1 and inc != self.group_incarnation(g):
+            return False
         key = self._pfx + b"galloc:drain:%d" % g
         raw = self._kv.get(key)
         if raw is None:
@@ -340,6 +365,13 @@ class Store:
         self._kv.delete(key)
         self._kv.put(self._pfx + b"galloc:free:%d" % g, b"1")
         return True
+
+    def alloc_pid(self) -> int:
+        """Next producer id from the replicated counter (deterministic)."""
+        raw = self._kv.get(self._pfx + b"pid:next")
+        pid = int(raw) if raw else 0
+        self._kv.put(self._pfx + b"pid:next", b"%d" % (pid + 1))
+        return pid
 
     def groups_pending_release(self, broker_id: int) -> list[int]:
         """Rows still draining on this broker's account (restart scan: a

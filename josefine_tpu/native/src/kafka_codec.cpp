@@ -52,6 +52,7 @@ enum ApiKey : int16_t {
   API_API_VERSIONS = 18,
   API_CREATE_TOPICS = 19,
   API_DELETE_TOPICS = 20,
+  API_INIT_PRODUCER_ID = 22,
 };
 
 struct ApiRange { int16_t key, min_ver, max_ver, flexible_from; };
@@ -76,6 +77,7 @@ const ApiRange API_RANGES[] = {
     {API_API_VERSIONS, 0, 3, 3},
     {API_CREATE_TOPICS, 0, 2, 5},
     {API_DELETE_TOPICS, 0, 1, 4},
+    {API_INIT_PRODUCER_ID, 0, 1, 2},
 };
 
 const ApiRange* find_api(int16_t key) {
@@ -388,6 +390,17 @@ SCHEMA(LIST_OFFSETS_RESP,
   FLD({"throttle_time_ms", T_INT32, 2, 127, nullptr}),
   FLD({"topics", T_ARRAY, 0, 127, &LO_RESP_TOPIC}))
 
+// -- InitProducerId (v0-v1; idempotent-producer id allocation — no
+// transactional support: transactional_id must be null)
+SCHEMA(INIT_PRODUCER_ID_REQ,
+  FLD({"transactional_id", T_NSTRING, 0, 127, nullptr}),
+  FLD({"transaction_timeout_ms", T_INT32, 0, 127, nullptr}))
+SCHEMA(INIT_PRODUCER_ID_RESP,
+  FLD({"throttle_time_ms", T_INT32, 0, 127, nullptr}),
+  FLD({"error_code", T_INT16, 0, 127, nullptr}),
+  FLD({"producer_id", T_INT64, 0, 127, nullptr}),
+  FLD({"producer_epoch", T_INT16, 0, 127, nullptr}))
+
 // -- OffsetCommit (v2-v3)
 SCHEMA(OC_REQ_PART,
   FLD({"partition_index", T_INT32, 0, 127, nullptr}),
@@ -540,6 +553,7 @@ const ApiSchemas API_SCHEMAS[] = {
     {API_API_VERSIONS, &API_VERSIONS_REQ, &API_VERSIONS_RESP},
     {API_CREATE_TOPICS, &CREATE_TOPICS_REQ, &CREATE_TOPICS_RESP},
     {API_DELETE_TOPICS, &DELETE_TOPICS_REQ, &DELETE_TOPICS_RESP},
+    {API_INIT_PRODUCER_ID, &INIT_PRODUCER_ID_REQ, &INIT_PRODUCER_ID_RESP},
 };
 
 const Schema* find_schema(int16_t key, bool response) {

@@ -136,11 +136,16 @@ class Node:
         key = b"ginc:%d" % g
         local = int(self.kv.get(key) or 0)
         if local != inc:
-            eng.recycle_group(g)
-            self.kv.delete(b"pfsm:%d" % g)
-            self.kv.delete(b"pfsm:r:%d" % g)
+            self._wipe_local_row(g)
             self.kv.put(key, b"%d" % inc)
         eng.set_group_incarnation(g, inc)
+
+    def _wipe_local_row(self, g: int) -> None:
+        """THE local-row reset (incarnation sync and release share it so
+        the recycle barrier can never diverge from the sync path)."""
+        self.raft.engine.recycle_group(g)
+        self.kv.delete(b"pfsm:%d" % g)
+        self.kv.delete(b"pfsm:r:%d" % g)
 
     def _wire_partition(self, p) -> None:
         """Commit-time hook: an EnsurePartition with a group claim applied.
@@ -172,10 +177,7 @@ class Node:
             self._reset_released_row(p.group)
 
     def _reset_released_row(self, g: int) -> None:
-        eng = self.raft.engine
-        eng.recycle_group(g)
-        self.kv.delete(b"pfsm:%d" % g)
-        self.kv.delete(b"pfsm:r:%d" % g)
+        self._wipe_local_row(g)
         self.kv.delete(b"ginc:%d" % g)
         if g not in self._pending_acks:
             self._pending_acks.append(g)
@@ -192,7 +194,13 @@ class Node:
     async def _drain_acks(self) -> None:
         while self._pending_acks and not self.shutdown.is_shutdown:
             g = self._pending_acks[0]
-            payload = Transition.group_released(g, self.config.broker.id)
+            # Pin the ack to the drained incarnation: the barrier guarantees
+            # the row cannot be re-claimed before our ack commits, so the
+            # store still reports the released claim's incarnation here; a
+            # straggler duplicate from this cycle can then never satisfy a
+            # LATER drain of the same row.
+            payload = Transition.group_released(
+                g, self.config.broker.id, self.store.group_incarnation(g))
             try:
                 await self.client.propose(payload, timeout=5.0)
                 self._pending_acks.pop(0)

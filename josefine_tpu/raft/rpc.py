@@ -196,7 +196,10 @@ class MsgBatch:
     @classmethod
     def decode(cls, raw: bytes) -> "MsgBatch":
         magic, ver, src, dst, n, nspans = _BATCH_HDR.unpack_from(raw, 0)
-        if magic != _BATCH_MAGIC or ver not in (1, 2):
+        if magic != _BATCH_MAGIC or ver != 2:
+            # v1 frames (no incarnation column) would zero-fill inc and the
+            # intake guard would silently drop every entry for claimed rows
+            # — reject loudly instead of stalling the data plane.
             raise ValueError(f"bad batch frame (magic={magic} ver={ver})")
         o = _BATCH_HDR.size
 
@@ -213,8 +216,7 @@ class MsgBatch:
         y = col(">u8", 8, np.int64)
         z = col(">u8", 8, np.int64)
         ok = col(">u1", 1, np.int32)
-        inc = (col(">u4", 4, np.int64) if ver >= 2
-               else np.zeros(n, np.int64))
+        inc = col(">u4", 4, np.int64)
         blocks: dict[int, list[Block]] = {}
         for _ in range(nspans):
             g, nb = _SPAN_HDR.unpack_from(raw, o)

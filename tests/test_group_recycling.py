@@ -81,6 +81,27 @@ def test_store_recycles_lowest_row_first():
     assert store.claim_group(pool) == -1
 
 
+def test_stale_duplicate_ack_cannot_satisfy_later_drain():
+    """Acks are at-least-once (a retry can land after its original
+    committed): a straggler duplicate from a PREVIOUS drain cycle of the
+    same row must not free the row while the current cycle's holders have
+    not reset — the ack is pinned to the incarnation it drained."""
+    store = Store(MemKV())
+    pool = 4
+    assert store.claim_group(pool) == 1            # incarnation 1
+    store.release_group(1, [10])
+    assert store.ack_group_release(1, 10, inc=1) is True
+    assert store.claim_group(pool) == 1            # reused, incarnation 2
+    store.release_group(1, [10, 20])
+    # Straggler duplicate from cycle 1: ignored; the row stays draining.
+    assert store.ack_group_release(1, 10, inc=1) is False
+    assert store.groups_pending_release(10) == [1]
+    # Current-cycle acks proceed normally.
+    assert store.ack_group_release(1, 10, inc=2) is False
+    assert store.ack_group_release(1, 20, inc=2) is True
+    assert store.claim_group(pool) == 1            # incarnation 3
+
+
 # ---------------------------------------------------------------- via FSM
 
 
@@ -274,7 +295,7 @@ async def test_topic_churn_reuses_rows_end_to_end(tmp_path):
                         and not s.groups_pending_release(2)
                         and not s.groups_pending_release(3)
                         and sorted(s._galloc_free_rows()) == [1, 2])
-            for _ in range(300):
+            for _ in range(800):
                 if freed():
                     break
                 await asyncio.sleep(0.05)

@@ -36,7 +36,7 @@ def test_api_versions_v0_roundtrip():
                       for k, a, b in kc.supported_apis()]},
     )
     keys = {e["api_key"] for e in body["api_keys"]}
-    assert {0, 1, 2, 3, 4, 8, 9, 10, 11, 12, 13, 14, 15, 16, 18, 19, 20} == keys
+    assert {0, 1, 2, 3, 4, 8, 9, 10, 11, 12, 13, 14, 15, 16, 18, 19, 20, 22} == keys
 
 
 def test_api_versions_v3_flexible_roundtrip():

@@ -97,11 +97,15 @@ def test_partition_restore_rejects_malformed_without_wiping(tmp_path):
     before = dst.log.read_from(0, 1 << 20)
     applied_before = dst.applied_id()
 
+    # Frames start after the (applied, end, start, pid_map_len) header +
+    # the producer-dedup map.
+    (pid_len,) = struct.unpack_from(">I", payload, 24)
+    f0 = 28 + pid_len
     truncated = payload[:-3]
     gap = bytearray(payload)
-    struct.pack_into(">Q", gap, 24, 999)  # first frame base != start
+    struct.pack_into(">Q", gap, f0, 999)  # first frame base != start
     zero_count = bytearray(payload)
-    struct.pack_into(">I", zero_count, 32, 0)  # first frame count = 0
+    struct.pack_into(">I", zero_count, f0 + 8, 0)  # first frame count = 0
     for bad in (payload[:10], truncated, bytes(gap), bytes(zero_count)):
         with pytest.raises(ValueError):
             dst.restore(bad)
