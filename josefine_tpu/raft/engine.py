@@ -249,6 +249,17 @@ class RaftEngine:
         # outbound (receive() has no send channel of its own).
         self.snap_chunk_bytes = 4 << 20
         self.snap_transfer_stale_ticks = 200
+        # Incremental log-sync resume (receiver-side): when True, a probe
+        # reply carries the local log end and the sender ships only the
+        # missing suffix. DEFAULT OFF: suffix sync assumes the prefix below
+        # the resume offset is byte-identical on both sides, and whole-node
+        # crash chaos with aggressive compaction has produced prefix
+        # divergence whose root cause is still being hunted — a full
+        # restore is self-healing (the receiver becomes byte-identical to
+        # the sync source) while a suffix onto a diverged prefix compounds
+        # the damage. The chunked/acked transfer machinery is identical
+        # either way.
+        self.snap_incremental = False
         self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
         self._snap_payload: dict[tuple[int, int], bytes] = {}
         self._snap_payload_meta: dict[tuple[int, int], tuple[int, int]] = {}
@@ -361,6 +372,13 @@ class RaftEngine:
         # overlapping membership change (disjoint-quorum risk).
         self._conf_pending: int | None = self._scan_conf_pending()
         self._conf_notify: list[ConfChange] = []
+        # Rows recycled DURING the current tick (a claim committing on
+        # group 0 fires the recycle hook mid-loop): the rest of this tick
+        # must not touch them — their scalar mirror/outbox snapshots predate
+        # the reset, and processing them would walk the dead incarnation's
+        # head (chain/device divergence) or ship its frames under the new
+        # incarnation stamp.
+        self._recycled_this_tick: set[int] = set()
 
     # ------------------------------------------------------------ intake
 
@@ -505,6 +523,11 @@ class RaftEngine:
     # -------------------------------------------------------------- tick
 
     def tick(self) -> TickResult:
+        # Rows recycled since the last tick OUTSIDE of tick() (receive()-time
+        # group-0 snapshot installs re-firing partition hooks, startup
+        # resets) were reset before this tick's device step ran — this tick
+        # is already their new incarnation and must NOT be suppressed.
+        self._recycled_this_tick.clear()
         in10, staged, deferred, deferred_b = self._build_inbox()
         for g, lst in self._proposals.items():
             in10[9, g, 0] = len(lst)
@@ -548,6 +571,12 @@ class RaftEngine:
         res = TickResult()
         for g in np.nonzero(active)[0]:
             g = int(g)
+            if g in self._recycled_this_tick:
+                # Recycled by a group-0 commit hook earlier in THIS loop
+                # (group 0 is always processed first — nonzero order is
+                # ascending): every snapshot for this row predates the
+                # reset.
+                continue
             ch = self.chains[g]
             new_head = int(head_new[g])
 
@@ -676,7 +705,8 @@ class RaftEngine:
         if self._conf_notify:
             res.conf_changes.extend(self._conf_notify)
             self._conf_notify.clear()
-        res.outbound = self._decode_outbox(ov)
+        res.outbound = self._decode_outbox(
+            ov, skip=self._recycled_this_tick or None)
         if self._snap_acks:
             # Snapshot-transfer acks queued by receive() (which has no send
             # channel of its own) ride this tick's outbound.
@@ -874,6 +904,14 @@ class RaftEngine:
         self._h_leader[g] = -1
         self._h_last_seen[g] = 0
         self._proposals.pop(g, None)
+        # Already-admitted intake for the old incarnation (the receive-time
+        # filter passed it against the OLD local incarnation) must not reach
+        # the device next tick.
+        self._pending_msgs = [m for m in self._pending_msgs if m.group != g]
+        self._pending_batches = [
+            pb for pb in (b.take(b.group != g) for b in self._pending_batches)
+            if len(pb)]
+        self._recycled_this_tick.add(g)
 
     def configure_groups(self, claims: dict[int, frozenset[int] | set[int]]) -> None:
         """Replace ALL data-group claims at once (startup re-wiring from the
@@ -1119,7 +1157,7 @@ class RaftEngine:
             # already identical to the sender's); nothing is staged.
             drv = self.drivers.get(g)
             hint = (getattr(drv.fsm, "snapshot_resume_offset", None)
-                    if drv else None)
+                    if (drv and self.snap_incremental) else None)
             resume = int(hint()) if callable(hint) else 0
             self._snap_staging.pop(g, None)
             self._snap_acks.append(rpc.WireMsg(
@@ -1463,7 +1501,7 @@ class RaftEngine:
         in10[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
         return in10, staged, deferred, deferred_b
 
-    def _decode_outbox(self, ov) -> list:
+    def _decode_outbox(self, ov, skip: set[int] | None = None) -> list:
         """Decode the packed outbox into ONE columnar MsgBatch per peer (plus
         any InstallSnapshot WireMsgs). The batch IS the wire form — per-tick
         consensus traffic to a peer is a single binary frame end to end; the
@@ -1471,6 +1509,11 @@ class RaftEngine:
         """
         # ov is the host-side (9, P, N) slice of the tick's single flat fetch.
         kind = ov[0]
+        if skip:
+            # Mid-tick-recycled rows: their outbox was computed by the dead
+            # incarnation but would be stamped with the new one — drop it.
+            kind = kind.copy()
+            kind[list(skip)] = 0
         if not kind.any():
             return []
         gi, di = np.nonzero(kind)

@@ -411,3 +411,130 @@ async def test_down_replica_blocks_reuse_until_it_resets(tmp_path):
         assert s._galloc_free_rows() == [g]
         # And its local leftover chain state is gone.
         assert node.raft.engine.chains[g].head == GENESIS
+
+
+@pytest.mark.asyncio
+async def test_churn_with_crashes_recycles_cleanly(tmp_path):
+    """Topic create/produce/delete cycles with a node crash in every cycle:
+    the reset barrier holds (rows free only after the crashed holder
+    returns and acks), incarnations stay monotone, and each generation's
+    partition serves only its own data."""
+    import random
+
+    from josefine_tpu.node import Node
+
+    rng = random.Random(31)
+    async with NodeManager(3, tmp_path, partitions=3, in_memory=False) as mgr:
+        await mgr.wait_registered()
+
+        async def any_client():
+            for i, n in enumerate(mgr.nodes):
+                if n is None:
+                    continue
+                try:
+                    return await kafka_client.connect(
+                        "127.0.0.1", mgr.broker_ports[i])
+                except OSError:
+                    continue
+            raise AssertionError("no live broker")
+
+        store = lambda: next(n for n in mgr.nodes if n is not None).store
+
+        for cycle in range(3):
+            name = "cyc%d" % cycle
+            cl = await any_client()
+            try:
+                r = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                    "topics": [{"name": name, "num_partitions": 2,
+                                "replication_factor": 3, "assignments": [],
+                                "configs": []}],
+                    "timeout_ms": 10000, "validate_only": False}), 25)
+                assert r["topics"][0]["error_code"] == ErrorCode.NONE
+            finally:
+                await cl.close()
+            for _ in range(400):
+                parts = store().get_partitions(name)
+                if len(parts) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert sorted(p.group for p in parts) == [1, 2], (
+                "rows not recycled in cycle %d: %s"
+                % (cycle, [p.group for p in parts]))
+
+            # Produce one batch to partition 0's leader.
+            g = next(p.group for p in parts if p.idx == 0)
+            lead = None
+            for _ in range(600):
+                lead = next((n for n in mgr.nodes
+                             if n and n.raft.engine.is_leader(g)), None)
+                if lead:
+                    break
+                await asyncio.sleep(0.05)
+            assert lead, "no leader in cycle %d" % cycle
+            cl = await kafka_client.connect(
+                "127.0.0.1", mgr.broker_ports[lead.config.broker.id - 1])
+            try:
+                pr = await asyncio.wait_for(cl.send(ApiKey.PRODUCE, 3, {
+                    "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                    "topics": [{"name": name, "partitions": [
+                        {"index": 0,
+                         "records": records.build_batch(
+                             b"cyc-%d-data" % cycle, 1)}]}]}), 15)
+                p0 = pr["responses"][0]["partitions"][0]
+                assert (p0["error_code"], p0["base_offset"]) == (
+                    ErrorCode.NONE, 0), (cycle, p0)
+
+                # Only this generation's data is visible.
+                fr = await asyncio.wait_for(cl.send(ApiKey.FETCH, 4, {
+                    "replica_id": -1, "max_wait_ms": 0, "min_bytes": 1,
+                    "max_bytes": 1 << 20, "isolation_level": 0,
+                    "topics": [{"topic": name, "partitions": [
+                        {"partition": 0, "fetch_offset": 0,
+                         "partition_max_bytes": 1 << 20}]}]}), 15)
+                recs = fr["responses"][0]["partitions"][0]["records"]
+                assert b"cyc-%d-data" % cycle in recs
+                for old in range(cycle):
+                    assert b"cyc-%d-data" % old not in recs, (cycle, old)
+            finally:
+                await cl.close()
+
+            # Crash one node, delete the topic while it is down, restart it.
+            victim = rng.randrange(3)
+            await mgr.nodes[victim].stop()
+            mgr.nodes[victim] = None
+            await asyncio.sleep(0.3)
+            cl = await any_client()
+            try:
+                dr = await asyncio.wait_for(cl.send(ApiKey.DELETE_TOPICS, 1, {
+                    "topic_names": [name], "timeout_ms": 10000}), 25)
+                assert dr["responses"][0]["error_code"] == ErrorCode.NONE
+            finally:
+                await cl.close()
+            # Wait for the delete to commit on the live majority, then
+            # check the barrier: the rows must NOT free while the victim
+            # holds unreset state.
+            for _ in range(400):
+                if store().groups_pending_release(victim + 1) == [1, 2]:
+                    break
+                await asyncio.sleep(0.05)
+            assert store().groups_pending_release(victim + 1) == [1, 2]
+            assert not store()._galloc_free_rows()
+            node = Node(mgr.configs[victim], in_memory=False)
+            await node.start()
+            mgr.nodes[victim] = node
+
+            def freed():
+                s = store()
+                return (sorted(s._galloc_free_rows()) == [1, 2]
+                        and all(not s.groups_pending_release(b)
+                                for b in (1, 2, 3)))
+            for _ in range(800):
+                if freed():
+                    break
+                await asyncio.sleep(0.05)
+            assert freed(), "cycle %d rows never freed" % cycle
+
+        # Three cycles -> incarnations 3 on both rows, everywhere.
+        for n in mgr.nodes:
+            assert n.store.group_incarnation(1) == 3
+            assert n.store.group_incarnation(2) == 3

@@ -151,13 +151,14 @@ def test_log_wipe(tmp_path):
 # --------------------------------------- engine: compaction + log sync
 
 
-def _cluster(tmp_path, n=3, threshold=None):
+def _cluster(tmp_path, n=3, threshold=None, incremental=False):
     ids_ = [1, 2, 3][:n]
     kvs = [MemKV() for _ in range(n)]
     engines, pfsms = [], []
     for i in range(n):
         e = RaftEngine(kvs[i], ids_, ids_[i], groups=2, params=PARAMS,
                        base_seed=7 + i, snapshot_threshold=threshold)
+        e.snap_incremental = incremental
         pf = PartitionFsm(kvs[i], 1, Log(tmp_path / ("n%d" % i)))
         e.register_fsm(1, pf)
         engines.append(e)
@@ -478,7 +479,8 @@ def test_second_catchup_is_incremental(tmp_path):
     async def main():
         from josefine_tpu.raft import rpc
 
-        engines, pfsms, kvs = _cluster(tmp_path, threshold=4)
+        engines, pfsms, kvs = _cluster(tmp_path, threshold=4,
+                                       incremental=True)
         lead = _leader(engines)
         follower = next(i for i in range(3) if i != lead)
 
