@@ -472,6 +472,15 @@ class Broker:
                         log.warning("rejecting produce to %s[%d]: %s",
                                     t["name"], idx, bad)
                         err = ErrorCode.CORRUPT_MESSAGE
+                    elif (incoherent :=
+                          records.validate_producer_coherence(batch)) is not None:
+                        # A multi-batch field must be ONE producer's
+                        # consecutive sequence run: the FSM attributes the
+                        # whole field to the first batch's (pid, epoch), so
+                        # mixed fields would corrupt dedup state.
+                        log.warning("rejecting incoherent produce to "
+                                    "%s[%d]: %s", t["name"], idx, incoherent)
+                        err = ErrorCode.INVALID_RECORD
                     elif group is not None:
                         err, base = await self._produce_replicated(
                             group, batch, acks)

@@ -54,6 +54,11 @@ log = get_logger("broker.partition_fsm")
 # protection for its next retry — the same trade real brokers make with
 # producer.id.expiration.ms.
 _MAX_PIDS = 256
+# Recent-batch window per producer: Kafka retains the last 5 batch
+# metadata entries so idempotent clients may pipeline
+# max.in.flight.requests.per.connection=5 — a retry of any batch in the
+# window re-acks its original base offset instead of erroring.
+_DEDUP_WINDOW = 5
 
 
 class PartitionFsm:
@@ -71,11 +76,15 @@ class PartitionFsm:
         self._rkey = b"pfsm:r:%d" % group
         self._applied = 0
         self._skip_torn = False
-        # Idempotent-producer dedup: pid -> [epoch, base_seq, count,
-        # base_offset] of the LAST applied blob from that producer. Part of
-        # the replicated state (persisted per apply, rides snapshots): every
-        # replica must make identical dedup decisions at apply time.
-        self._pids: dict[int, list[int]] = {}
+        # Idempotent-producer dedup: pid -> [epoch, last_seen_block_id,
+        # [[base_seq, count, base_offset], ...]] holding the last
+        # _DEDUP_WINDOW applied blobs from that producer — Kafka brokers
+        # keep 5 so clients may run max.in.flight.requests.per.connection=5
+        # with idempotence (a retry of any recent in-flight batch re-acks
+        # its original offsets). Part of the replicated state (persisted
+        # per apply, rides snapshots): every replica must make identical
+        # dedup decisions at apply time.
+        self._pids: dict[int, list] = {}
         if kv.get(self._rkey) is not None:
             # Crash mid-restore: the log was wiped/partially rebuilt while
             # the position record still describes the pre-restore state.
@@ -87,8 +96,18 @@ class PartitionFsm:
             return
         raw = kv.get(self._key)
         if raw is not None:
-            self._applied, recorded_end = struct.unpack_from(">QQ", raw)
-            self._pids = _decode_pids(raw[16:])
+            try:
+                self._applied, recorded_end = struct.unpack_from(">QQ", raw)
+                self._pids = _decode_pids(raw[16:])
+            except (ValueError, struct.error):
+                # Unreadable position record (corrupt, or an incompatible
+                # on-disk format from another build): degrade to the same
+                # empty-replica reset as every other unrecoverable-state
+                # path instead of refusing to boot.
+                log.warning("g=%d unreadable position record; "
+                            "resetting replica log", group)
+                self._reset_replica()
+                return
             actual_end = self.log.next_offset()
             if actual_end < recorded_end:
                 # The log is SHORTER than the position record claims — e.g.
@@ -146,26 +165,32 @@ class PartitionFsm:
         append = True
         if pid >= 0 and base_seq >= 0:
             last = self._pids.get(pid)
-            if last is not None and epoch >= last[0]:
-                lepoch, lseq, lcount, lbase = last[:4]
-                if epoch == lepoch and base_seq == lseq:
-                    # Exact retry of the last blob: ack its original base.
-                    append = False
-                    base = lbase
-                elif epoch == lepoch and base_seq < lseq + lcount:
-                    # Older than our dedup window: refuse rather than
-                    # double-append (Kafka DUPLICATE_SEQUENCE_NUMBER).
-                    append = False
-                    err, base = 46, -1
-                elif epoch == lepoch and base_seq != lseq + lcount:
-                    # Sequence gap (Kafka OUT_OF_ORDER_SEQUENCE_NUMBER).
-                    append = False
-                    err, base = 45, -1
-                # epoch > lepoch: new producer session — accept and re-track.
-            elif last is not None:
+            if last is not None and epoch > last[0]:
+                last = None  # new producer session — accept and re-track
+            if last is not None and epoch < last[0]:
                 # Stale epoch (Kafka INVALID_PRODUCER_EPOCH).
                 append = False
                 err, base = 47, -1
+            elif last is not None:
+                window = last[2]  # [[base_seq, count, base_offset], ...]
+                hit = next((e for e in window if e[0] == base_seq), None)
+                tail = window[-1]
+                expected = tail[0] + tail[1]
+                if hit is not None and hit[1] == count:
+                    # Retry of a batch still in the window (Kafka keeps 5
+                    # for max.in.flight=5): re-ack its original base.
+                    append = False
+                    base = hit[2]
+                elif base_seq < expected:
+                    # Behind the window (or an overlapping mismatch):
+                    # refuse rather than double-append
+                    # (Kafka DUPLICATE_SEQUENCE_NUMBER).
+                    append = False
+                    err, base = 46, -1
+                elif base_seq != expected:
+                    # Sequence gap (Kafka OUT_OF_ORDER_SEQUENCE_NUMBER).
+                    append = False
+                    err, base = 45, -1
         if append:
             if self._skip_torn:
                 self._skip_torn = False
@@ -175,14 +200,20 @@ class PartitionFsm:
                 self.log.append(records.set_base_offset(batch, base),
                                 count=count)
             if pid >= 0 and base_seq >= 0:
-                self._pids[pid] = [epoch, base_seq, count, base, blk.id]
+                ent = self._pids.get(pid)
+                if ent is None or epoch > ent[0]:
+                    ent = [epoch, blk.id, []]
+                    self._pids[pid] = ent
+                ent[1] = blk.id
+                ent[2].append([base_seq, count, base])
+                del ent[2][:-_DEDUP_WINDOW]
                 if len(self._pids) > _MAX_PIDS:
                     # Deterministic eviction (every replica applies the same
                     # sequence, so last-seen block ids agree): drop the
                     # longest-idle producer — the analog of Kafka's
                     # producer.id.expiration, bounding both the map and the
                     # per-apply record rewrite.
-                    oldest = min(self._pids, key=lambda k: self._pids[k][4])
+                    oldest = min(self._pids, key=lambda k: self._pids[k][1])
                     del self._pids[oldest]
         self._applied = blk.id
         self.kv.put(self._key, self._record())
@@ -313,9 +344,11 @@ class PartitionFsm:
         pass  # the Log is owned by the Replica registry
 
 
-def _encode_pids(pids: dict[int, list[int]]) -> bytes:
+def _encode_pids(pids: dict[int, list]) -> bytes:
     """Deterministic (sorted-key) serialization — the map is replicated
-    state and snapshots of it must be byte-identical across replicas."""
+    state and snapshots of it must be byte-identical across replicas.
+    Value shape: [epoch, last_seen_block_id, [[base_seq, count, base], ...]]
+    (window capped at _DEDUP_WINDOW entries)."""
     if not pids:
         return b""
     import json
@@ -324,15 +357,22 @@ def _encode_pids(pids: dict[int, list[int]]) -> bytes:
                       separators=(",", ":")).encode()
 
 
-def _decode_pids(raw: bytes) -> dict[int, list[int]]:
+def _decode_pids(raw: bytes) -> dict[int, list]:
     if not raw:
         return {}
     import json
 
     try:
         d = json.loads(raw)
-        return {int(k): [int(x) for x in v] for k, v in d.items()}
-    except (ValueError, TypeError, AttributeError) as e:
+        out: dict[int, list] = {}
+        for k, v in d.items():
+            epoch, blk, window = int(v[0]), int(v[1]), v[2]
+            if not window or len(window) > _DEDUP_WINDOW:
+                raise ValueError(f"window size {len(window)} for pid {k}")
+            out[int(k)] = [
+                epoch, blk, [[int(s), int(c), int(b)] for s, c, b in window]]
+        return out
+    except (ValueError, TypeError, AttributeError, IndexError, KeyError) as e:
         raise ValueError(f"bad producer-dedup map: {e}") from None
 
 

@@ -171,7 +171,10 @@ def blob_producer_info(blob: bytes):
     total_count) where pid/epoch/base_seq come from the FIRST batch and
     total_count spans the whole concatenation. A producer's batches within
     one request carry consecutive sequences, so the blob is deduplicated
-    as one unit (matching its one-block-one-log-append replication).
+    as one unit (matching its one-block-one-log-append replication) —
+    ``validate_producer_coherence`` at produce ingress guarantees every
+    batch in the field actually shares that (pid, epoch) with consecutive
+    sequences, so the first-batch view cannot mis-attribute records.
     pid == -1 means non-idempotent."""
     spans = list(_batch_spans(blob))
     if not spans:
@@ -182,3 +185,38 @@ def blob_producer_info(blob: bytes):
     (base_seq,) = struct.unpack_from(">i", blob, start + _BASE_SEQUENCE)
     total = sum(c for _, _, c in spans)
     return pid, epoch, base_seq, total
+
+
+def validate_producer_coherence(blob: bytes) -> str | None:
+    """Produce-ingress gate for multi-batch fields: the partition FSM
+    attributes the whole field to the FIRST batch's (pid, epoch, base_seq)
+    and counts records across the concatenation, so a field mixing
+    producers — different pids, different epochs, idempotent plus
+    non-idempotent, or non-consecutive sequences — would be mis-tracked
+    (spurious OUT_OF_ORDER/DUPLICATE verdicts, missed dedup). Real Kafka
+    refuses such fields with INVALID_RECORD; so do we. Returns a reason
+    string, or None for a coherent field."""
+    spans = list(_batch_spans(blob))
+    if len(spans) <= 1:
+        return None
+    first = None
+    expect_seq = None
+    for i, (start, _total, count) in enumerate(spans):
+        (pid,) = struct.unpack_from(">q", blob, start + _PRODUCER_ID)
+        (epoch,) = struct.unpack_from(">h", blob, start + _PRODUCER_EPOCH)
+        (seq,) = struct.unpack_from(">i", blob, start + _BASE_SEQUENCE)
+        if first is None:
+            first = (pid, epoch)
+            expect_seq = seq + count if pid >= 0 and seq >= 0 else None
+            continue
+        if (pid, epoch) != first:
+            return (f"batch {i} producer ({pid}, {epoch}) differs from "
+                    f"batch 0 {first}")
+        if expect_seq is not None:
+            if seq != expect_seq:
+                return (f"batch {i} base_sequence {seq} not consecutive "
+                        f"(expected {expect_seq})")
+            expect_seq += count
+        elif seq >= 0:
+            return f"batch {i} carries a sequence but batch 0 does not"
+    return None

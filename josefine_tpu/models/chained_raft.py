@@ -269,9 +269,15 @@ def node_step(
         voted_for=jnp.where(timed_out & ~pv, me, st.voted_for),
         leader=jnp.where(timed_out, -1, st.leader),
         votes=jnp.where(timed_out, self_vote, st.votes),
-        # Redraw with term+1 in both modes (classic: the new term; pre-vote:
-        # the proposed term) so competing campaigners decorrelate.
-        timeout=jnp.where(timed_out, _draw_timeout(st.seed, st.term + 1, params), st.timeout),
+        # Redraw folding in the PREVIOUS timeout value: with pre-vote the
+        # term never moves on a failed round, so a (seed, term)-only hash
+        # redraws the same value forever — two pre-candidates that collide
+        # once then stay phase-locked and livelock the election (their
+        # simultaneous broadcasts shadow each other's grants). Feeding the
+        # old draw back decorrelates every round (a per-node hash walk).
+        timeout=jnp.where(timed_out,
+                          _draw_timeout(st.seed, (st.term + 1) ^ (st.timeout << 8), params),
+                          st.timeout),
     )
     just_cand = timed_out & ~pv
     just_precand = timed_out & pv
@@ -356,7 +362,12 @@ def node_step(
         hb_elapsed=jnp.where(is_leader, jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
     )
     bc_vr = (just_cand | pre_elected) & st.alive & is_peer & ~is_leader
-    bc_pvr = just_precand & st.alive & is_peer & ~is_leader & ~bc_vr
+    # A pending reply outranks our own pre-vote broadcast on that lane
+    # (one outbox lane per (group, dst)): shadowing a peer's PREVOTE_RESP
+    # grant with our own PREVOTE_REQ livelocks simultaneous campaigns —
+    # pre-vote costs nothing to retry next round, the grant does.
+    bc_pvr = (just_precand & st.alive & is_peer & ~is_leader & ~bc_vr
+              & (reply.kind == MSG_NONE))
 
     kind = jnp.where(
         send_ae, MSG_APPEND,

@@ -223,7 +223,10 @@ def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
     just_cand = timed_out and not prevote
     just_precand = timed_out and bool(prevote)
     if timed_out:
-        st.timeout = draw_timeout(st.seed, st.term + 1, tmin, tmax)
+        # Fold the previous draw into the hash (decorrelates stalled
+        # pre-vote rounds — exact twin of node_step's timed_out redraw).
+        st.timeout = draw_timeout(st.seed, (st.term + 1) ^ (st.timeout << 8),
+                                  tmin, tmax)
         st.elapsed = 0
         st.leader = -1
         st.votes = [i == me for i in range(N)]
@@ -285,7 +288,9 @@ def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
         send_ae = (is_leader and my_member and is_peer
                    and (hb_due or st.nxt[dst] < st.head))
         bc_vr = (just_cand or pre_elected) and is_peer and not is_leader
-        bc_pvr = just_precand and is_peer and not is_leader and not bc_vr
+        # Pending replies outrank our own pre-vote broadcast (node_step twin).
+        bc_pvr = (just_precand and is_peer and not is_leader and not bc_vr
+                  and reply[dst].kind == MSG_NONE)
         if send_ae:
             out.append(PyMsg(kind=MSG_APPEND, term=st.term, x=st.nxt[dst],
                              y=st.head, z=st.commit, ok=reply[dst].ok))

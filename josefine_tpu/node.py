@@ -267,8 +267,13 @@ class Node:
         if self._ack_task:
             self._ack_task.cancel()
             await asyncio.gather(self._ack_task, return_exceptions=True)
-        await self.broker.stop()
+        # Raft first: broker.stop() closes the replica logs, and the engine
+        # must not tick or receive (commit-apply, snapshot restore) after
+        # that — a restore interrupted by a closed log orphans its intent
+        # marker and forces a replica reset at next boot (the round-2
+        # acked-loss trigger, tests/test_reset_safety.py).
         await self.raft.stop()
+        await self.broker.stop()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
         self.kv.close()

@@ -255,7 +255,10 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
         voted_for=jnp.where(timed_out & ~pv, me2, st.voted_for),
         leader=jnp.where(timed_out, -1, st.leader),
         votes=jnp.where(timed_out[:, None, :], eyei, st.votes),
-        timeout=jnp.where(timed_out, cr._draw_timeout(st.seed, st.term + 1, params),
+        # Feed the previous draw back into the hash (decorrelates stalled
+        # pre-vote rounds — see node_step's timed_out redraw).
+        timeout=jnp.where(timed_out,
+                          cr._draw_timeout(st.seed, (st.term + 1) ^ (st.timeout << 8), params),
                           st.timeout),
     )
     just_cand = timed_out & ~pv
@@ -337,8 +340,9 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
                              jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
     )
     bc_vr = ((just_cand | pre_elected) & alive_b & ~is_leader)[:, None, :] & is_peer
+    # Pending replies outrank our own pre-vote broadcast (see node_step).
     bc_pvr = ((just_precand & alive_b & ~is_leader)[:, None, :] & is_peer
-              & ~bc_vr)
+              & ~bc_vr & (reply.kind == MSG_NONE))
 
     commit3 = ids.Bid(t=jnp.broadcast_to(st.commit.t[:, None, :], (N, N, T)),
                       s=jnp.broadcast_to(st.commit.s[:, None, :], (N, N, T)))

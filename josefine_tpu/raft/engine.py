@@ -59,6 +59,10 @@ _m_in = REGISTRY.counter("raft_msgs_in_total", "Consensus wire messages accepted
 _m_snapshots = REGISTRY.counter("raft_snapshots_total", "Snapshots taken (log compactions)")
 _m_installs = REGISTRY.counter("raft_snapshot_installs_total", "Snapshots installed from a leader")
 _m_led = REGISTRY.gauge("raft_groups_led", "Groups this node currently leads")
+_m_paroled = REGISTRY.gauge(
+    "raft_groups_paroled",
+    "Groups abstaining from elections until re-replicated past their "
+    "pre-reset ack watermark (vote parole)")
 _m_backlog_dropped = REGISTRY.counter(
     "raft_batch_backlog_dropped_total",
     "Consensus batch entries dropped by the per-src intake backlog cap")
@@ -72,6 +76,12 @@ _CONSENSUS_KIND_SET = frozenset((
     rpc.MSG_PREVOTE_REQ, rpc.MSG_PREVOTE_RESP,
 ))
 _CONSENSUS_KINDS = np.asarray(sorted(_CONSENSUS_KIND_SET), np.int32)
+
+# Kinds a group on vote parole refuses to process (see _reset_group): an
+# election request processed by a voter that forgot its acked log breaks
+# quorum intersection — dropping the request IS the abstention.
+_PAROLE_DROP_KINDS = frozenset((rpc.MSG_VOTE_REQ, rpc.MSG_PREVOTE_REQ))
+_PAROLE_DROP_ARR = np.asarray(sorted(_PAROLE_DROP_KINDS), np.int32)
 
 
 class NotLeader(Exception):
@@ -251,15 +261,33 @@ class RaftEngine:
         self.snap_transfer_stale_ticks = 200
         # Incremental log-sync resume (receiver-side): when True, a probe
         # reply carries the local log end and the sender ships only the
-        # missing suffix. DEFAULT OFF: suffix sync assumes the prefix below
-        # the resume offset is byte-identical on both sides, and whole-node
-        # crash chaos with aggressive compaction has produced prefix
-        # divergence whose root cause is still being hunted — a full
-        # restore is self-healing (the receiver becomes byte-identical to
-        # the sync source) while a suffix onto a diverged prefix compounds
-        # the damage. The chunked/acked transfer machinery is identical
-        # either way.
-        self.snap_incremental = False
+        # missing suffix. Suffix sync is sound because both logs are the
+        # same deterministic fold of one committed block sequence — which
+        # round 2's KNOWN ISSUE violated (a reset voter let an empty quorum
+        # elect over committed history, producing divergent folds that
+        # suffix sync then compounded into mixed-prefix hybrids). With vote
+        # parole enforcing election safety across resets (_reset_group,
+        # tests/test_reset_safety.py), the committed sequence is unique
+        # again and incremental resume is back ON by default.
+        self.snap_incremental = True
+        # Vote parole (durable): group -> pre-reset head watermark. A group
+        # that reset its chain abstains from elections until its head has
+        # been re-replicated past everything it may have acked (see
+        # _reset_group). Survives restarts — the watermark only clears once
+        # the catch-up actually happened.
+        self._parole: dict[int, int] = {}
+        for k, v in kv.scan_prefix(b"parole:"):
+            try:
+                g = int(k[len(b"parole:"):])
+            except ValueError:
+                continue
+            if 0 <= g < self.P and len(v) == 8:
+                self._parole[g] = int.from_bytes(v, "big")
+            else:
+                # Stale key from a larger-P config (or corrupt): drop it
+                # rather than index out of range on every tick's lift scan.
+                log.warning("dropping out-of-range parole key %r", k)
+                kv.delete(k)
         self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
         self._snap_payload: dict[tuple[int, int], bytes] = {}
         self._snap_payload_meta: dict[tuple[int, int], tuple[int, int]] = {}
@@ -410,6 +438,8 @@ class RaftEngine:
             return
         if not self._inc_ok(msg):
             return
+        if msg.kind in _PAROLE_DROP_KINDS and msg.group in self._parole:
+            return  # on vote parole: abstain from elections (see _reset_group)
         self._c_in.inc()
         self._pending_msgs.append(msg)
 
@@ -455,6 +485,13 @@ class RaftEngine:
         # Row-incarnation guard (consensus-group recycling): entries stamped
         # with another incarnation belong to a recycled row's previous life.
         inb &= self._h_ginc[np.clip(b.group, 0, self.P - 1)] == b.inc
+        if self._parole:
+            # Vote parole: a reset group abstains from elections until its
+            # head is re-replicated past its pre-reset ack watermark.
+            par = np.fromiter(self._parole, dtype=b.group.dtype,
+                              count=len(self._parole))
+            inb &= ~(np.isin(b.kind_col, _PAROLE_DROP_ARR)
+                     & np.isin(b.group, par))
         if not inb.all():
             log.warning("dropping %d batch entries (unknown group, "
                         "non-consensus kind, or stale incarnation) src=%d",
@@ -528,6 +565,13 @@ class RaftEngine:
         # resets) were reset before this tick's device step ran — this tick
         # is already their new incarnation and must NOT be suppressed.
         self._recycled_this_tick.clear()
+        if self._parole:
+            # Vote parole: hold every paroled group's election timer at
+            # zero so it can never reach candidacy (timeout_min >= 2 ticks;
+            # elapsed is +1 per step). Grant-suppression happens at intake.
+            idx = jnp.asarray(list(self._parole), jnp.int32)
+            self.state = self.state.replace(
+                elapsed=self.state.elapsed.at[idx].set(jnp.asarray(0, _I32)))
         in10, staged, deferred, deferred_b = self._build_inbox()
         for g, lst in self._proposals.items():
             in10[9, g, 0] = len(lst)
@@ -555,6 +599,17 @@ class RaftEngine:
          n_head_t, n_head_s, n_commit_t, n_commit_s, minted, became) = sv
         head_new = (n_head_t << 32) | n_head_s
         commit_new = (n_commit_t << 32) | n_commit_s
+
+        if self._parole:
+            # Lift parole once legitimate replication has carried the head
+            # back past the pre-reset ack watermark: from here on the node's
+            # chain again contains everything it ever acknowledged, so its
+            # vote is safe to count.
+            for g in [g for g, wm in self._parole.items()
+                      if int(head_new[g]) >= wm]:
+                log.info("g=%d vote parole lifted (head %#x >= watermark "
+                         "%#x)", g, int(head_new[g]), self._parole[g])
+                self._lift_parole(g)
 
         # Active-group selection, vectorized: a group needs host work only if
         # leadership moved, a block was minted/accepted (head moved), commit
@@ -697,6 +752,12 @@ class RaftEngine:
             for g in np.nonzero(vol_changed)[0]:
                 self._store_vol(int(g), int(n_term[g]), int(n_voted[g]))
 
+        if log.isEnabledFor(10):  # TRACE: per-group role transitions
+            for g in np.nonzero(n_role != self._h_role)[0]:
+                log.log(10, "n%d g=%d role %d->%d term=%d head=%#x voted=%d",
+                        self.self_id, int(g), int(self._h_role[g]),
+                        int(n_role[g]), int(n_term[g]), int(head_new[g]),
+                        int(n_voted[g]))
         self._h_term = n_term
         self._h_voted = n_voted
         self._h_role = n_role
@@ -886,22 +947,13 @@ class RaftEngine:
         so stale frames are dropped at intake."""
         if not (0 < g < self.P):
             raise ValueError(f"group {g} not a data group (P={self.P})")
-        self._reset_group(g)
-        z32 = jnp.asarray(0, _I32)
-        st = self.state
-        self.state = st.replace(
-            role=st.role.at[g].set(z32),
-            leader=st.leader.at[g].set(jnp.asarray(-1, _I32)),
-            elapsed=st.elapsed.at[g].set(z32),
-            hb_elapsed=st.hb_elapsed.at[g].set(z32),
-            votes=st.votes.at[g].set(jnp.zeros_like(st.votes[g])),
-            match=ids.Bid(st.match.t.at[g].set(jnp.zeros_like(st.match.t[g])),
-                          st.match.s.at[g].set(jnp.zeros_like(st.match.s[g]))),
-            nxt=ids.Bid(st.nxt.t.at[g].set(jnp.zeros_like(st.nxt.t[g])),
-                        st.nxt.s.at[g].set(jnp.zeros_like(st.nxt.s[g]))),
-        )
-        self._h_role[g] = 0
-        self._h_leader[g] = -1
+        # No vote parole on recycling: the row's history is discarded by
+        # design (topic deleted through a replicated barrier) and the new
+        # incarnation starts at genesis — a parole watermark from the old
+        # life would wedge the fresh topic's row forever. The incarnation
+        # stamp isolates stale frames instead.
+        self._reset_group(g, parole=False)
+        self._lift_parole(g)
         self._h_last_seen[g] = 0
         self._proposals.pop(g, None)
         # Already-admitted intake for the old incarnation (the receive-time
@@ -967,24 +1019,86 @@ class RaftEngine:
             if ch.committed > start:
                 drv.apply(ch.range(start, ch.committed))
 
-    def _reset_group(self, g: int) -> None:
+    def _reset_group(self, g: int, parole: bool = True) -> None:
         """Regress group ``g`` to genesis, chain + device row + snapshot
         record: the node presents as an empty replica and the leader's probe
-        (head below its floor) triggers a fresh snapshot install."""
+        (head below its floor) triggers a fresh snapshot install.
+
+        With ``parole=True`` (every path except row recycling, where the
+        history is discarded by design), the pre-reset head id is persisted
+        as a vote-parole watermark: this node may have ACKED blocks up to
+        that head that counted toward a commit quorum, so until its head
+        catches back up through legitimate leader replication it must
+        abstain from elections entirely — no vote/pre-vote grants (requests
+        are dropped at intake) and no candidacy (the election timer is held
+        at zero each tick). Without this, a reset voter B plus a behind
+        voter C form a quorum that elects an empty leader and erases
+        committed history (the Raft-thesis §11.2 disk-loss rule; the
+        round-2 KNOWN ISSUE, reproduced by tests/test_reset_safety.py).
+        Single-voter groups skip parole: with quorum 1 there is no other
+        ack holder to protect, and abstaining would wedge the row forever.
+        """
         ch = self.chains[g]
+        old_head = ch.head
+        voters = self._group_claims.get(g)
+        n_voters = (len(voters) if voters is not None
+                    else len(self.members.active_slots()))
+        if parole and old_head > GENESIS and n_voters > 1:
+            # Liveness note: if a MAJORITY of a group's voters end up
+            # paroled (multiple independent local-state losses), the group
+            # halts — nobody can campaign and parole can only lift through
+            # leader replication. That is the deliberate trade: round 2's
+            # behavior in the same scenario was silent cluster-wide loss of
+            # acknowledged records. Operator escape hatch (accepting
+            # unclean election): delete the durable ``parole:<g>`` keys.
+            self.kv.put(b"parole:%d" % g, old_head.to_bytes(8, "big"))
+            self._parole[g] = old_head
+            self._pending_msgs = [
+                m for m in self._pending_msgs
+                if not (m.group == g and m.kind in _PAROLE_DROP_KINDS)]
+            # Already-admitted batched election requests must not reach the
+            # emptied row either (they passed intake before parole was set).
+            self._pending_batches = [
+                pb for pb in (
+                    b.take(~((b.group == g)
+                             & np.isin(b.kind_col, _PAROLE_DROP_ARR)))
+                    for b in self._pending_batches)
+                if len(pb)]
+            _m_paroled.set(len(self._parole), node=self.self_id)
+            log.warning("g=%d entering vote parole until head >= %#x",
+                        g, old_head)
         ch.reset()
         self.kv.delete(b"g%d:snap" % g)
         self._snap_cache.pop(g, None)
         self._drop_group_transfers(g)
         self._h_head[g] = GENESIS
         self._h_commit[g] = GENESIS
+        self._h_role[g] = 0
+        self._h_leader[g] = -1
+        # Full device-row demotion, not just head/commit: a row that was
+        # leading (or campaigning) before the reset must not keep its role,
+        # ballot box, or progress rows — they describe state the chain no
+        # longer backs.
         z = jnp.asarray(0, _I32)
-        self.state = self.state.replace(
-            head=ids.Bid(self.state.head.t.at[g].set(z),
-                         self.state.head.s.at[g].set(z)),
-            commit=ids.Bid(self.state.commit.t.at[g].set(z),
-                           self.state.commit.s.at[g].set(z)),
+        st = self.state
+        self.state = st.replace(
+            head=ids.Bid(st.head.t.at[g].set(z), st.head.s.at[g].set(z)),
+            commit=ids.Bid(st.commit.t.at[g].set(z), st.commit.s.at[g].set(z)),
+            role=st.role.at[g].set(z),
+            leader=st.leader.at[g].set(jnp.asarray(-1, _I32)),
+            elapsed=st.elapsed.at[g].set(z),
+            hb_elapsed=st.hb_elapsed.at[g].set(z),
+            votes=st.votes.at[g].set(jnp.zeros_like(st.votes[g])),
+            match=ids.Bid(st.match.t.at[g].set(jnp.zeros_like(st.match.t[g])),
+                          st.match.s.at[g].set(jnp.zeros_like(st.match.s[g]))),
+            nxt=ids.Bid(st.nxt.t.at[g].set(jnp.zeros_like(st.nxt.t[g])),
+                        st.nxt.s.at[g].set(jnp.zeros_like(st.nxt.s[g]))),
         )
+
+    def _lift_parole(self, g: int) -> None:
+        self._parole.pop(g, None)
+        self.kv.delete(b"parole:%d" % g)
+        _m_paroled.set(len(self._parole), node=self.self_id)
 
     def unregister_fsm(self, g: int) -> None:
         drv = self.drivers.pop(g, None)
@@ -1339,10 +1453,16 @@ class RaftEngine:
             drv.drop_waiters(NotLeader(g, msg.src))
             try:
                 drv.fsm.restore(payload)
-            except ValueError as e:
-                # Malformed payload (restore validates before mutating its
-                # own state): reject without touching the chain — same
-                # degrade-not-crash rule as poison conf blocks.
+            except (ValueError, OSError) as e:
+                # ValueError: malformed payload (restore validates before
+                # mutating its own state) — reject without touching the
+                # chain, same degrade-not-crash rule as poison conf blocks.
+                # OSError: the log is closed or unwritable (e.g. a snapshot
+                # chunk arriving inside the shutdown window) — the restore
+                # may have begun mutating, so its intent marker stays put
+                # and boot-time recovery resets the replica; what must NOT
+                # happen is this exception unwinding through the transport
+                # task with the chain untouched either way.
                 log.error("rejecting snapshot g=%d from %d: %s", g, msg.src, e)
                 return False
             if callable(getattr(drv.fsm, "snapshot_export", None)):

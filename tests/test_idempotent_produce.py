@@ -79,6 +79,58 @@ def test_apply_time_dedup_semantics(tmp_path):
     assert r == (0, 6)
 
 
+def test_dedup_window_of_five_reacks_recent_batches(tmp_path):
+    """Kafka retains the last 5 batch metadata per producer so idempotent
+    clients can run max.in.flight.requests.per.connection=5: a retry of
+    ANY batch still in the window re-acks its original base offset; only
+    batches older than the window get DUPLICATE_SEQUENCE_NUMBER."""
+    pf = PartitionFsm(MemKV(), 1, Log(tmp_path / "a"))
+    bases = {}
+    for i in range(7):  # seq 0..6, one record each
+        r = decode_produce_result(pf.transition_block(
+            _blk(i + 1, b"p%d" % i, 1, pid=9, epoch=0, base_seq=i)))
+        assert r == (0, i)
+        bases[i] = r[1]
+    end = pf.log.next_offset()
+    # Retries of the last five (seq 2..6) re-ack their original offsets.
+    for i in range(2, 7):
+        r = decode_produce_result(pf.transition_block(
+            _blk(20 + i, b"p%d" % i, 1, pid=9, epoch=0, base_seq=i)))
+        assert r == (0, bases[i]), f"seq {i}"
+        assert pf.log.next_offset() == end
+    # Seq 1 fell out of the 5-deep window: refused, not double-appended.
+    r = decode_produce_result(pf.transition_block(
+        _blk(30, b"p1", 1, pid=9, epoch=0, base_seq=1)))
+    assert r == (46, -1)
+    # A retry whose count mismatches the windowed entry is refused too.
+    r = decode_produce_result(pf.transition_block(
+        _blk(31, b"pX", 2, pid=9, epoch=0, base_seq=6)))
+    assert r == (46, -1)
+    assert pf.log.next_offset() == end
+
+
+def test_multi_batch_field_coherence_gate():
+    """A records field concatenating batches from different producers (or
+    mixing idempotent with non-idempotent, or with non-consecutive
+    sequences) is refused at ingress — the FSM attributes the whole field
+    to the first batch's (pid, epoch), so mixed fields would corrupt its
+    dedup tracking (ADVICE r2)."""
+    ok2 = (records.build_batch(b"a", 2, pid=5, epoch=0, base_seq=0)
+           + records.build_batch(b"b", 1, pid=5, epoch=0, base_seq=2))
+    assert records.validate_producer_coherence(ok2) is None
+    mixed_pid = (records.build_batch(b"a", 1, pid=5, epoch=0, base_seq=0)
+                 + records.build_batch(b"b", 1, pid=6, epoch=0, base_seq=1))
+    assert records.validate_producer_coherence(mixed_pid) is not None
+    mixed_idem = (records.build_batch(b"a", 1, pid=5, epoch=0, base_seq=0)
+                  + records.build_batch(b"b", 1))
+    assert records.validate_producer_coherence(mixed_idem) is not None
+    gap = (records.build_batch(b"a", 2, pid=5, epoch=0, base_seq=0)
+           + records.build_batch(b"b", 1, pid=5, epoch=0, base_seq=5))
+    assert records.validate_producer_coherence(gap) is not None
+    non_idem2 = records.build_batch(b"a", 1) + records.build_batch(b"b", 1)
+    assert records.validate_producer_coherence(non_idem2) is None
+
+
 def test_dedup_state_survives_restart_and_snapshot(tmp_path):
     kv = MemKV()
     pf = PartitionFsm(kv, 1, Log(tmp_path / "a"))

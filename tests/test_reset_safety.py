@@ -1,0 +1,296 @@
+"""Deterministic reproducer + regression tests for the round-2 KNOWN ISSUE:
+loss of acknowledged records under compaction + crash chaos.
+
+Root cause (round 3): a node whose local replica state is unrecoverable
+(e.g. an interrupted snapshot restore left the ``pfsm:r:`` marker, or the
+log lost its prefix) resets its chain to genesis (``RaftEngine._reset_group``)
+— but KEPT its voting rights. Raft's vote up-to-dateness check is only
+sound while no voter ever forgets entries it acknowledged: commit quorums
+and election quorums must intersect in a node that still HOLDS the
+committed prefix. A reset node B that acked records 1..k grants its vote
+to a node C that never held them; the {B, C} quorum elects an empty
+leader at a higher term, whose fork orphans the acked suffix (term-major
+fork choice), and whose snapshot sync eventually wipes the last full
+replica. The observed corruption — a log whose fold starts at the 6th
+record with base offset 0 — is the empty leader's first post-loss append.
+
+The fix is vote parole: a reset group persists the pre-reset head id as a
+promise watermark; until the node's head catches back up (via legitimate
+leader replication), it abstains from elections entirely — no vote/pre-vote
+grants (requests are dropped at intake) and no candidacy (the election
+timer is held at zero). This is the Raft-thesis disk-loss rule (§11.2: a
+node that lost its log must not vote until re-synced past everything it
+may have acknowledged).
+
+This test scripts the exact interleaving wall-clock chaos only hits ~1 in
+5 loaded runs, making it deterministic: it FAILS on the pre-fix code every
+run, and must stay green forever after.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from test_integration import NodeManager, make_batch
+from test_node_chaos import _metadata, _produce_one
+
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.models.types import step_params
+from josefine_tpu.node import Node
+from josefine_tpu.raft.chain import GENESIS
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV, SqliteKV
+
+TOPIC = "crashy"
+
+
+async def _create_topic(mgr, partitions=1, rf=3):
+    cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+    try:
+        r = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+            "topics": [{"name": TOPIC, "num_partitions": partitions,
+                        "replication_factor": rf, "assignments": [],
+                        "configs": []}],
+            "timeout_ms": 10000, "validate_only": False}, timeout=20.0), 25)
+        assert r["topics"][0]["error_code"] == ErrorCode.NONE
+    finally:
+        await cl.close()
+
+
+async def _wait_partition_known(mgr, live, timeout=15.0):
+    """Until every live node's store has the partition's group binding."""
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        ps = [mgr.nodes[i].store.get_partition(TOPIC, 0) for i in live]
+        if all(p is not None and p.group >= 1 for p in ps):
+            return ps[0].group
+        await asyncio.sleep(0.05)
+    raise TimeoutError("partition group binding never replicated")
+
+
+def _read_fold(node, part=0):
+    rep = node.broker.broker.replicas.get(TOPIC, part)
+    if rep is None:
+        meta = node.store.get_partition(TOPIC, part)
+        rep = node.broker.broker.replicas.ensure(meta)
+    return b"".join(b for _, _, b in rep.log.read_from(0, 1 << 26))
+
+
+@pytest.mark.asyncio
+async def test_reset_node_cannot_elect_empty_quorum(tmp_path):
+    """Scripted loss interleaving (deterministic form of the chaos seeds):
+
+    1. records 1..6 acked by {A, B} while C is down; A and B truncate
+       (snapshot_threshold=5) so their chains have a real floor;
+    2. B stops; an interrupted snapshot restore is simulated by planting
+       the ``pfsm:r:`` marker in its durable KV (exactly what a crash
+       inside ``PartitionFsm.restore`` leaves behind);
+    3. A stops; B and C restart. B's boot detects the marker, wipes its
+       replica, and — applied(0) < floor — resets its chain to genesis.
+
+    Pre-fix: {B, C} elect an empty leader, new produces are ACKED at base
+    offset 0, truncation fires, and A's returning log is snapshot-wiped —
+    records 1..6 are lost cluster-wide despite their acks.
+    Post-fix: B is on vote parole (it may have acked records only A still
+    holds), so the group stays leaderless until A returns; every acked
+    record survives on every replica.
+    """
+    def tune(n):
+        n.raft.engine.snapshot_threshold = 5
+        n.raft.engine.snap_chunk_bytes = 512
+
+    acked: list[bytes] = []
+    async with NodeManager(3, tmp_path, partitions=4, tick_ms=30,
+                           in_memory=False) as mgr:
+        for n in mgr.nodes:
+            tune(n)
+        await mgr.wait_registered(3)
+        await _create_topic(mgr)
+        group = await _wait_partition_known(mgr, live=[0, 1, 2])
+
+        async def crash(i):
+            await mgr.nodes[i].stop()
+            mgr.nodes[i] = None
+
+        async def restart(i):
+            node = Node(mgr.configs[i], in_memory=False)
+            tune(node)
+            await node.start()
+            mgr.nodes[i] = node
+
+        # --- step 1: C down; 6 records acked by {A, B}; floors advance.
+        await crash(2)
+        for k in range(6):
+            payload = b"<pre-%04d>" % k
+            assert await _produce_one(mgr, 0, payload, down={2}), (
+                f"setup produce {k} not acked")
+            acked.append(payload)
+        deadline = asyncio.get_running_loop().time() + 15
+        while asyncio.get_running_loop().time() < deadline:
+            floors = [mgr.nodes[i].raft.engine.chains[group].floor
+                      for i in (0, 1)]
+            if all(f > GENESIS for f in floors):
+                break
+            await asyncio.sleep(0.1)
+        assert all(f > GENESIS for f in floors), (
+            f"chains never truncated (floors {floors}) — scenario needs a "
+            "real floor so the reset path fires")
+
+        # --- step 2: B stops; simulate the interrupted restore.
+        await crash(1)
+        kv = SqliteKV(mgr.configs[1].broker.state_file)
+        kv.put(b"pfsm:r:%d" % group, b"1")
+        kv.close()
+
+        # --- step 3: A stops; B and C restart without it.
+        await crash(0)
+        await restart(1)
+        await restart(2)
+        assert mgr.nodes[1].raft.engine.chains[group].head == GENESIS, (
+            "B's boot should have reset the group (marker + floor)")
+
+        # Pre-fix, {B, C} elect and ACK new records into an empty log.
+        # Post-fix the group must stay leaderless (B abstains), so these
+        # produces time out un-acked (bounded attempts keep the fixed path
+        # fast). Either way, only ACKED records join the contract set.
+        async def produce_bounded(payload: bytes) -> bool:
+            try:
+                return await asyncio.wait_for(
+                    _produce_one(mgr, 0, payload, down={0}), 6.0)
+            except asyncio.TimeoutError:
+                return False
+
+        for k in range(6):
+            payload = b"<post-%04d>" % k
+            if await produce_bounded(payload):
+                acked.append(payload)
+
+        # --- heal: A returns; give the cluster time to converge/sync.
+        await restart(0)
+        await mgr.wait_registered(3)
+        await asyncio.sleep(4)
+
+        # --- the contract: every acked record, exactly once, in ack
+        # order, on every replica.
+        for i, n in enumerate(mgr.nodes):  # forensics on failure
+            eng = n.raft.engine
+            ch = eng.chains[group]
+            print(f"node{i + 1}: head={ch.head:#x} commit={ch.committed:#x} "
+                  f"floor={ch.floor:#x} role={int(eng._h_role[group])} "
+                  f"leader={int(eng._h_leader[group])} "
+                  f"parole={eng._parole.get(group)}")
+        folds = [_read_fold(mgr.nodes[i]) for i in range(3)]
+        for i, fold in enumerate(folds):
+            pos = -1
+            for payload in acked:
+                first = fold.find(payload)
+                assert first != -1, (
+                    f"node {i + 1}: ACKED record {payload!r} lost "
+                    f"(fold: {fold[:200]!r}...)")
+                # At-least-once is the contract (a timed-out attempt can
+                # commit and its retry commit again); first occurrences
+                # must respect ack order — same bar as test_node_chaos.
+                assert first > pos, f"node {i + 1}: {payload!r} out of order"
+                pos = first
+        assert folds[0] == folds[1] == folds[2], "replica folds diverge"
+
+
+# ---------------------------------------------------------------- engine-level
+
+
+def _mk_engines(kvs, params=None):
+    params = params or step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+    return [RaftEngine(kvs[i], [1, 2, 3], i + 1, groups=1, params=params,
+                       snapshot_threshold=5, max_append_entries=64)
+            for i in range(3)]
+
+
+def _route(engines, ticks, live=None):
+    live = live if live is not None else range(len(engines))
+    for _ in range(ticks):
+        out = []
+        for i in live:
+            out.extend(engines[i].tick().outbound)
+        for m in out:
+            if m.dst in live:
+                engines[m.dst].receive(m)
+
+
+async def _commit_some(engines, leader, n=6):
+    futs = []
+    for k in range(n):
+        futs.append(engines[leader].propose(0, b"<rec-%d>" % k))
+        _route(engines, 6)
+        await asyncio.sleep(0)
+    _route(engines, 10)
+    for f in futs:
+        assert f.done() and not f.exception()
+
+
+@pytest.mark.asyncio
+async def test_parole_blocks_empty_quorum_and_lifts_on_catchup():
+    """Engine-level twin of the full-stack scenario: a reset voter plus an
+    empty voter must NOT form an electing quorum; once the full node
+    returns and re-replicates, parole lifts and the cluster converges on
+    the full history."""
+    kvs = [MemKV() for _ in range(3)]
+    engines = _mk_engines(kvs)
+    _route(engines, 30)
+    leader = next(i for i in range(3) if engines[i].is_leader(0))
+    await _commit_some(engines, leader)
+    others = [i for i in range(3) if i != leader]
+    m, k2 = others
+    full_head = engines[leader].chains[0].head
+
+    # K loses its whole disk; M resets with parole; leader L "restarts".
+    kvs[k2] = MemKV()
+    engines[k2] = _mk_engines(kvs)[k2]
+    engines[m] = _mk_engines(kvs)[m]
+    engines[m]._reset_group(0)
+    assert engines[m]._parole == {0: full_head}
+    engines[leader] = _mk_engines(kvs)[leader]
+
+    # Window without the full node: must stay leaderless.
+    _route(engines, 150, live=[m, k2])
+    assert not engines[m].is_leader(0) and not engines[k2].is_leader(0), (
+        "a reset voter enabled an empty-quorum election")
+
+    # Heal: full node returns; must converge on the full history.
+    _route(engines, 400)
+    assert any(e.is_leader(0) for e in engines), "no leader after heal"
+    assert not engines[m]._parole, "parole never lifted after catch-up"
+    heads = [e.chains[0].head for e in engines]
+    assert all(h >= full_head for h in heads), heads
+
+
+@pytest.mark.asyncio
+async def test_parole_survives_restart_and_clears_on_recycle(tmp_path):
+    """The watermark is durable (a restart mid-parole must still abstain)
+    and row recycling clears it (a fresh topic on the row must not
+    inherit the old life's watermark)."""
+    kvs = [MemKV() for _ in range(3)]
+    engines = _mk_engines(kvs)
+    _route(engines, 30)
+    leader = next(i for i in range(3) if engines[i].is_leader(0))
+    await _commit_some(engines, leader, n=3)
+    m = next(i for i in range(3) if i != leader)
+    engines[m]._reset_group(0)
+    wm = dict(engines[m]._parole)
+    assert wm
+    # Restart over the same KV: parole reloads.
+    engines[m] = _mk_engines(kvs)[m]
+    assert engines[m]._parole == wm
+    # Recycling a data-group row clears its parole. (Group 0 is not
+    # recyclable; exercise the path on a 2-group engine.)
+    kv = MemKV()
+    e = RaftEngine(kv, [1, 2, 3], 1, groups=2,
+                   params=step_params(timeout_min=3, timeout_max=8))
+    e._group_claims[1] = frozenset({0, 1, 2})
+    e.chains[1].append(1, b"x")
+    e._reset_group(1)
+    assert 1 in e._parole and kv.get(b"parole:1") is not None
+    e.recycle_group(1)
+    assert 1 not in e._parole and kv.get(b"parole:1") is None

@@ -1,0 +1,102 @@
+"""Minimal harness: post-heal topology from the reset-safety scenario.
+
+A: full chain (7 blocks, commit 6). B: paroled at genesis (watermark = A's
+head). C: empty KV (never saw the group's data). Tick with routing; expect
+A to win the election, commit its tail, sync B+C, and B's parole to lift.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+kvs = [MemKV() for _ in range(3)]
+engines = [RaftEngine(kvs[i], [1, 2, 3], i + 1, groups=1, params=params,
+                      snapshot_threshold=5, max_append_entries=64)
+           for i in range(3)]
+
+
+def route(ticks, live=None):
+    live = live if live is not None else [0, 1, 2]
+    for _ in range(ticks):
+        out = []
+        for i in live:
+            r = engines[i].tick()
+            out.extend((i, m) for m in r.outbound)
+        for i, m in out:
+            if m.dst in live:
+                engines[m.dst].receive(m)
+
+
+route(30)
+leader = next(i for i in range(3) if engines[i].is_leader(0))
+print("leader:", leader)
+futs = []
+
+
+async def drive():
+    import asyncio
+    for k in range(6):
+        f = engines[leader].propose(0, b"<rec-%d>" % k)
+        futs.append(f)
+        route(6)
+        await asyncio.sleep(0)
+    route(10)
+    for f in futs:
+        assert f.done() and not f.exception(), f
+    print("committed; chains:",
+          [(hex(e.chains[0].head), hex(e.chains[0].committed),
+            hex(e.chains[0].floor)) for e in engines])
+
+    others = [i for i in range(3) if i != leader]
+    m, k2 = others[0], others[1]
+    # Simulate: K loses everything (fresh node), M resets with parole.
+    kvs[k2] = MemKV()
+    engines[k2] = RaftEngine(kvs[k2], [1, 2, 3], k2 + 1, groups=1,
+                             params=params, snapshot_threshold=5,
+                             max_append_entries=64)
+    engines[m] = RaftEngine(kvs[m], [1, 2, 3], m + 1, groups=1, params=params,
+                            snapshot_threshold=5, max_append_entries=64)
+    engines[m]._reset_group(0)
+    print("M parole:", engines[m]._parole)
+    # Leader "stops": recreate from its intact KV.
+    engines[leader] = RaftEngine(kvs[leader], [1, 2, 3], leader + 1, groups=1,
+                                 params=params, snapshot_threshold=5,
+                                 max_append_entries=64)
+    # Window without the full node (M + K only): must stay leaderless.
+    route(100, live=[m, k2])
+    print("during window roles:", [int(e._h_role[0]) for e in engines],
+          "terms:", [int(e._h_term[0]) for e in engines])
+    assert not engines[m].is_leader(0) and not engines[k2].is_leader(0), (
+        "empty quorum elected a leader!")
+
+    # Heal: all three tick.
+    for i in range(400):
+        route(1)
+        if i % 50 == 0:
+            print(f"t={i} roles:", [int(e._h_role[0]) for e in engines],
+                  "terms:", [int(e._h_term[0]) for e in engines],
+                  "heads:", [hex(e.chains[0].head) for e in engines],
+                  "parole:", engines[m]._parole)
+    roles = [int(e._h_role[0]) for e in engines]
+    print("final roles:", roles, "parole:", engines[m]._parole)
+    print("heads:", [hex(e.chains[0].head) for e in engines],
+          "commits:", [hex(e.chains[0].committed) for e in engines])
+    assert 2 in roles, "no leader after heal"
+    assert not engines[m]._parole, "parole never lifted"
+
+
+import asyncio
+
+asyncio.run(drive())
+print("OK")

@@ -1,0 +1,88 @@
+"""Repro driver for the compaction+crash acked-record-loss KNOWN ISSUE.
+
+Runs the test_node_chaos scenario body for a list of seeds (compact=True),
+with JOSEFINE_LOG-controlled logging captured to a file per run. On a
+contract violation the run's state dirs + log are preserved under
+./chaos_fail_<seed>/ for forensics.
+
+Usage: python tools/repro_chaos.py <seed> [<seed> ...]
+Exit status: number of failing seeds.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pathlib
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from test_node_chaos import test_node_crash_restart_acked_records_survive as chaos
+
+
+def run_seed(seed: int, keep_dir: pathlib.Path) -> bool:
+    """True on pass. On failure, preserve state + log under keep_dir."""
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix=f"chaos-{seed}-"))
+    log_path = tmp / "josefine.log"
+    root = logging.getLogger("josefine")
+    root.setLevel(logging.DEBUG)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    fh = logging.FileHandler(log_path)
+    fh.setFormatter(logging.Formatter(
+        "%(asctime)s.%(msecs)03d %(levelname)-5s %(name)s: %(message)s",
+        "%H:%M:%S"))
+    root.addHandler(fh)
+    ok = False
+    try:
+        # strip the pytest parametrize wrapper if present
+        fn = getattr(chaos, "__wrapped__", chaos)
+        asyncio.run(fn(tmp, seed, True))
+        ok = True
+    except BaseException as e:
+        print(f"seed {seed}: FAIL {type(e).__name__}: {e}", flush=True)
+        import traceback
+        traceback.print_exc()
+    finally:
+        root.removeHandler(fh)
+        fh.close()
+        if ok:
+            shutil.rmtree(tmp, ignore_errors=True)
+            print(f"seed {seed}: ok", flush=True)
+        else:
+            dst = keep_dir / f"chaos_fail_{seed}"
+            shutil.rmtree(dst, ignore_errors=True)
+            shutil.copytree(tmp, dst)
+            shutil.rmtree(tmp, ignore_errors=True)
+            print(f"seed {seed}: state preserved at {dst}", flush=True)
+    return ok
+
+
+def main() -> int:
+    seeds = [int(s) for s in sys.argv[1:]] or [11, 23]
+    keep = REPO / "chaos_failures"
+    keep.mkdir(exist_ok=True)
+    fails = 0
+    for s in seeds:
+        if not run_seed(s, keep):
+            fails += 1
+    print(f"{len(seeds) - fails}/{len(seeds)} passed")
+    return fails
+
+
+if __name__ == "__main__":
+    sys.exit(main())

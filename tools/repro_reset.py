@@ -1,0 +1,44 @@
+"""Debug driver for tests/test_reset_safety.py with full logging."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import pathlib
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from test_reset_safety import test_reset_node_cannot_elect_empty_quorum as t
+
+
+def main():
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="reset-"))
+    root = logging.getLogger("josefine")
+    root.setLevel(5)
+    fh = logging.FileHandler("/tmp/reset_debug.log", mode="w")
+    fh.setFormatter(logging.Formatter(
+        "%(asctime)s.%(msecs)03d %(levelname)-5s %(name)s: %(message)s",
+        "%H:%M:%S"))
+    root.addHandler(fh)
+    try:
+        asyncio.run(t(tmp))
+        print("PASS")
+    except BaseException as e:
+        print(f"FAIL: {e}")
+        import traceback
+        traceback.print_exc()
+    print(f"state: {tmp}, log: /tmp/reset_debug.log")
+
+
+if __name__ == "__main__":
+    main()
