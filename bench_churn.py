@@ -92,7 +92,8 @@ def main():
     conv = np.concatenate(convs)
     unconverged = int((conv < 0).sum())
     ok = conv[conv >= 0].astype(np.int64)
-    p50, p90, p99 = (float(np.percentile(ok, q)) for q in (50, 90, 99))
+    p50, p90, p99, p999 = (float(np.percentile(ok, q))
+                           for q in (50, 90, 99, 99.9))
 
     # Post-churn health: every partition has exactly one leader and commits
     # still advance under sustained stepping.
@@ -116,6 +117,7 @@ def main():
             "elections_measured": int(conv.size),
             "p90_ticks": p90,
             "p99_ticks": p99,
+            "p99_9_ticks": p999,
             "mean_ticks": round(float(ok.mean()), 2),
             "unconverged": unconverged,
             "churn_wall_s": round(dt, 4),

@@ -48,7 +48,12 @@ PAYLOAD = b"x" * 64
 
 
 async def bench_one(P: int, ticks: int, warmup: int) -> dict:
-    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+    # hb_ticks=16: staggered per-group heartbeats (the scaled
+    # configuration — at 100k groups a per-tick heartbeat from every
+    # leader is 200k messages/tick of pure liveness noise). Election
+    # timers stay at 3-8 ticks because transport traffic feeds the
+    # aggregate keepalive (engine peer_fresh / kernel node_step).
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=16)
     t0 = time.perf_counter()
     engines = [
         RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params)
@@ -62,8 +67,11 @@ async def bench_one(P: int, ticks: int, warmup: int) -> dict:
     def one_tick(live: bool):
         nonlocal proposed, committed
         outbound = []
-        for e in engines:
-            res = e.tick()
+        # Split-phase: dispatch all three engines' device steps before
+        # fetching any result, so their (tunnel) round trips overlap.
+        handles = [e.tick_begin() for e in engines]
+        for e, h in zip(engines, handles):
+            res = e.tick_finish(h)
             outbound.extend(res.outbound)
             committed += len(res.committed)
         for m in outbound:

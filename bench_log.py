@@ -74,6 +74,25 @@ def main():
             "wall_s": round(dt, 3),
         })
 
+        # Power-durability cost: fsync after every batch (the ack path of
+        # broker.durability = "power") — the measured price of closing the
+        # OS/power-failure window (ARCHITECTURE.md "Durability").
+        fs_batches = min(n_batches, 2000)
+        t0 = time.perf_counter()
+        for _ in range(fs_batches):
+            base = log.next_offset()
+            log.append(set_base_offset(batch, base), count=args.batch)
+            log.flush()
+        dt = time.perf_counter() - t0
+        total_records = log.next_offset()
+        results.append({
+            "phase": "append_fsync_per_batch",
+            "records_per_sec": round(fs_batches * args.batch / dt),
+            "mb_per_sec": round(fs_batches * batch_bytes / 1e6 / dt, 1),
+            "batches": fs_batches,
+            "wall_s": round(dt, 3),
+        })
+
         rng = random.Random(0)
         lookups = 20_000
         t0 = time.perf_counter()

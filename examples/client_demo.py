@@ -9,9 +9,10 @@ SURVEY.md quirk 8).
 import os
 import sys
 
-# Runnable as documented (python examples/...): when invoked by path,
-# sys.path[0] is this file's dir, not the repo root the package lives in.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:  # installed (pip install -e .)
+    import josefine_tpu  # noqa: F401
+except ImportError:  # bare checkout, invoked by path: resolve the repo root
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 import asyncio

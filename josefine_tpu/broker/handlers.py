@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import random
 import re
+import time
 import uuid
 
 from josefine_tpu.broker import records
@@ -76,15 +77,22 @@ class Broker:
         store: Store,
         raft_client,
         leader_hint=None,
+        is_controller=None,
     ):
         self.config = config
         self.store = store
         self.client = raft_client
         self.replicas = ReplicaRegistry(config.data_directory)
         self.groups = GroupCoordinator(on_group_created=self._replicate_group)
-        # Metadata-group leader lookup (controller identity); defaults to
-        # self (the reference hardcodes controller_id 1, metadata.rs:30).
+        # Metadata-group leader lookup (controller identity + coordinator
+        # placement anchor); defaults to self (the reference hardcodes
+        # controller_id 1, metadata.rs:30). is_controller answers "does MY
+        # raft node currently lead the metadata group" — the fallback
+        # coordinator identity when leader_hint's id has no registry entry.
         self._leader_hint = leader_hint or (lambda: config.id)
+        self._is_controller = is_controller or (lambda: True)
+        # Short-TTL memo for coordinator_for's registry lookup.
+        self._coord_cache: dict[int, tuple] = {}
         self._rng = random.Random()
         # Strong refs: the loop holds tasks weakly; without this a pending
         # fire-and-forget proposal could be garbage-collected mid-flight.
@@ -364,15 +372,81 @@ class Broker:
 
     # ------------------------------------------------------- FindCoordinator
 
+    def coordinator_for(self, group_id: str):
+        """Group -> broker placement anchored to Raft leadership: the
+        coordinator for EVERY group is the current leader of the metadata
+        consensus group — the exact analog of Kafka's
+        ``__consumer_offsets``-partition-leader rule, with uniqueness
+        inherited from Raft (at most one leader per term; a deposed
+        coordinator stops believing within a leadership-transfer window,
+        not an arbitrary network-view window — hashing over per-broker
+        liveness views could seat two coordinators for one group under an
+        asymmetric partition). The reference pins every group to whichever
+        broker answered (``find_coordinator.rs:7-21``), which splits one
+        consumer group into per-broker fictions. Non-coordinators answer
+        NOT_COORDINATOR so clients re-route; coordinator death is a Raft
+        election away from a new placement, where members rejoin with a
+        fresh generation (in-memory rebalance state is disposable by
+        design; committed offsets are Raft-replicated and survive).
+
+        Returns the coordinator's BrokerInfo, or None while leaderless or
+        before the leader has registered (bootstrap)."""
+        lid = self._leader_hint()
+        if lid is None:
+            return None
+        # Registry lookups hit sqlite under the KV lock on every group API
+        # (heartbeats included) — memoize per leader id briefly; entries
+        # only change on the rare broker re-registration.
+        now = time.monotonic()
+        cached = self._coord_cache.get(lid)
+        if cached is not None and now - cached[1] < 0.5:
+            found = cached[0]
+        else:
+            found = next((b for b in self.store.get_brokers() if b.id == lid),
+                         None)
+            self._coord_cache[lid] = (found, now)
+        if found is not None:
+            return found
+        if self._is_controller():
+            # The leader's id has no registry entry — either bootstrap
+            # (self-registration still in flight) or the legal
+            # partitions=1 config where raft.id != broker.id (so
+            # engine.leader_id(0) is not a broker id). If OUR raft node
+            # leads the metadata group, we ARE the coordinator: answer
+            # self so group APIs keep working; other brokers return
+            # COORDINATOR_NOT_AVAILABLE and clients bootstrap-scan to us.
+            return BrokerInfo(id=self.config.id, ip=self.config.ip,
+                              port=self.config.port)
+        return None
+
+    def _coordinator_gate(self, group_id: str) -> int | None:
+        """NOT_COORDINATOR / COORDINATOR_NOT_AVAILABLE if this broker must
+        not serve group APIs for ``group_id``; None when it is the
+        coordinator."""
+        co = self.coordinator_for(group_id)
+        if co is None:
+            return int(ErrorCode.COORDINATOR_NOT_AVAILABLE)
+        if co.id != self.config.id:
+            return int(ErrorCode.NOT_COORDINATOR)
+        return None
+
     def find_coordinator(self, version: int, body: dict) -> dict:
-        """Always self (reference ``find_coordinator.rs:7-21``)."""
+        group_id = body.get("key") or ""
+        co = self.coordinator_for(group_id)
+        if co is None:
+            return {
+                "throttle_time_ms": 0,
+                "error_code": ErrorCode.COORDINATOR_NOT_AVAILABLE,
+                "error_message": "broker registry empty",
+                "node_id": -1, "host": "", "port": -1,
+            }
         return {
             "throttle_time_ms": 0,
             "error_code": ErrorCode.NONE,
             "error_message": None,
-            "node_id": self.config.id,
-            "host": self.config.ip,
-            "port": self.config.port,
+            "node_id": co.id,
+            "host": co.ip,
+            "port": co.port,
         }
 
     # --------------------------------------------------------- LeaderAndIsr
@@ -574,7 +648,17 @@ class Broker:
         rep = self._local_replica(topic, idx)
         if isinstance(rep, int):
             return rep
-        part = self.store.get_partition(topic, idx) or rep.partition
+        part = self.store.get_partition(topic, idx)
+        if part is None:
+            # Replica known only from a LeaderAndIsr fan-out hint (which
+            # carries no consensus-group binding): the replicated store has
+            # not applied EnsurePartition here yet. Serving a produce now
+            # would take the group-less DIRECT-append path and ack a record
+            # that was never replicated — it then squats at offset 0 and
+            # diverges from the committed fold forever (found by chaos seed
+            # 23). Refuse retryably; the client re-routes/retries and the
+            # binding lands within a tick.
+            return int(ErrorCode.NOT_LEADER_OR_FOLLOWER)
         if not self._leads_partition(part):
             return int(ErrorCode.NOT_LEADER_OR_FOLLOWER)
         return rep, part
@@ -685,6 +769,11 @@ class Broker:
 
     async def join_group(self, version: int, body: dict, client_id: str | None,
                          client_host: str) -> dict:
+        gate = self._coordinator_gate(body.get("group_id") or "")
+        if gate is not None:
+            return {"throttle_time_ms": 0, "error_code": gate,
+                    "generation_id": -1, "protocol_name": "", "leader": "",
+                    "member_id": "", "members": []}
         protocols = [(p["name"], p.get("metadata") or b"")
                      for p in body.get("protocols") or []]
         session_timeout_ms = body.get("session_timeout_ms")
@@ -710,6 +799,10 @@ class Broker:
                 "members": members}
 
     async def sync_group(self, version: int, body: dict) -> dict:
+        gate = self._coordinator_gate(body.get("group_id") or "")
+        if gate is not None:
+            return {"throttle_time_ms": 0, "error_code": gate,
+                    "assignment": b""}
         resp = await self.groups.sync_group(
             group_id=body.get("group_id") or "",
             generation_id=body.get("generation_id", -1),
@@ -720,20 +813,29 @@ class Broker:
                 "assignment": resp.get("assignment", b"")}
 
     def heartbeat(self, version: int, body: dict) -> dict:
-        err = self.groups.heartbeat(body.get("group_id") or "",
-                                    body.get("generation_id", -1),
-                                    body.get("member_id") or "")
+        err = (self._coordinator_gate(body.get("group_id") or "")
+               or self.groups.heartbeat(body.get("group_id") or "",
+                                        body.get("generation_id", -1),
+                                        body.get("member_id") or ""))
         return {"throttle_time_ms": 0, "error_code": err}
 
     def leave_group(self, version: int, body: dict) -> dict:
-        err = self.groups.leave_group(body.get("group_id") or "",
-                                      body.get("member_id") or "")
+        err = (self._coordinator_gate(body.get("group_id") or "")
+               or self.groups.leave_group(body.get("group_id") or "",
+                                          body.get("member_id") or ""))
         return {"throttle_time_ms": 0, "error_code": err}
 
     def describe_groups(self, version: int, body: dict) -> dict:
-        return {"throttle_time_ms": 0,
-                "groups": [self.groups.describe(g)
-                           for g in body.get("groups") or []]}
+        out = []
+        for g in body.get("groups") or []:
+            gate = self._coordinator_gate(g)
+            if gate is not None:
+                out.append({"error_code": gate, "group_id": g,
+                            "group_state": "", "protocol_type": "",
+                            "protocol_data": "", "members": []})
+            else:
+                out.append(self.groups.describe(g))
+        return {"throttle_time_ms": 0, "groups": out}
 
     # ------------------------------------------------------ offsets APIs
 
@@ -743,9 +845,10 @@ class Broker:
         The whole request is one replicated transition — one consensus
         round-trip regardless of partition count."""
         group_id = body.get("group_id") or ""
-        gate = self.groups.validate_commit(group_id,
-                                           body.get("generation_id", -1),
-                                           body.get("member_id") or "")
+        gate = (self._coordinator_gate(group_id)
+                or self.groups.validate_commit(group_id,
+                                               body.get("generation_id", -1),
+                                               body.get("member_id") or ""))
         batch = OffsetCommitBatch()
         results: dict[tuple[str, int], int] = {}
         for t in body.get("topics") or []:
@@ -784,6 +887,21 @@ class Broker:
 
     def offset_fetch(self, version: int, body: dict) -> dict:
         group_id = body.get("group_id") or ""
+        gate = self._coordinator_gate(group_id)
+        if gate is not None:
+            # Pre-v2 responses have no top-level error_code on the wire, so
+            # the gate must also ride per-partition errors or old clients
+            # would read "no offsets committed" and auto-reset.
+            topics_out = [
+                {"name": t.get("name", ""),
+                 "partitions": [{"partition_index": idx,
+                                 "committed_offset": -1, "metadata": None,
+                                 "error_code": gate}
+                                for idx in t.get("partition_indexes") or []]}
+                for t in body.get("topics") or []
+            ]
+            return {"throttle_time_ms": 0, "topics": topics_out,
+                    "error_code": gate}
         requested = body.get("topics")
         topics_out = []
         if requested is None:

@@ -44,6 +44,7 @@ import struct
 
 from josefine_tpu.broker import records
 from josefine_tpu.broker.log import Log
+from josefine_tpu.raft.fsm import ReplicaDiverged
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.tracing import get_logger
 
@@ -64,10 +65,17 @@ _DEDUP_WINDOW = 5
 class PartitionFsm:
     """Applies committed record batches of one consensus group to a Log."""
 
-    def __init__(self, kv: KV, group: int, plog: Log, on_append=None):
+    def __init__(self, kv: KV, group: int, plog: Log, on_append=None,
+                 fsync: bool = False):
         self.kv = kv
         self.group = group
         self.log = plog
+        # Power-loss durability (broker.durability = "power"): fsync the
+        # seglog before each position record, so an acked record can never
+        # be lost to an OS crash between the page-cache write and the KV
+        # commit. Off by default — the "process" crash model (every chaos
+        # suite's model) needs no per-append fsync.
+        self._fsync = fsync
         # Fired after each applied batch: the broker's fetch long-poll
         # wakeup (consumers blocked in Fetch re-check instead of sleeping
         # out their max_wait_ms).
@@ -76,6 +84,7 @@ class PartitionFsm:
         self._rkey = b"pfsm:r:%d" % group
         self._applied = 0
         self._skip_torn = False
+        self._stream = None  # in-flight streaming restore (restore_begin)
         # Idempotent-producer dedup: pid -> [epoch, last_seen_block_id,
         # [[base_seq, count, base_offset], ...]] holding the last
         # _DEDUP_WINDOW applied blobs from that producer — Kafka brokers
@@ -95,6 +104,16 @@ class PartitionFsm:
             self._reset_replica()
             return
         raw = kv.get(self._key)
+        if raw is None and self.log.next_offset() > 0:
+            # First binding over a NON-empty log: nothing this FSM applied
+            # put those bytes there (there is no position record), so the
+            # content is foreign — e.g. an un-replicated append from a
+            # legacy path. Folding committed records on top would diverge
+            # from every other replica; start from a verifiably virgin log.
+            log.warning("g=%d first binding over non-empty log (end %d); "
+                        "resetting replica log", group, self.log.next_offset())
+            self._reset_replica()
+            return
         if raw is not None:
             try:
                 self._applied, recorded_end = struct.unpack_from(">QQ", raw)
@@ -128,6 +147,11 @@ class PartitionFsm:
                     "g=%d torn append detected (log end %d > recorded %d); "
                     "first replayed block will be skipped",
                     group, actual_end, recorded_end)
+
+    def reset(self) -> None:
+        """Public reset for the engine's ReplicaDiverged handling: wipe the
+        replica back to empty so a fresh leader sync rebuilds it."""
+        self._reset_replica()
 
     def _reset_replica(self) -> None:
         """The ONE wipe-and-reset sequence (crash-recovery paths share it so
@@ -193,8 +217,22 @@ class PartitionFsm:
                     err, base = 45, -1
         if append:
             if self._skip_torn:
+                # Torn-append recovery: the boot-time detector saw the log
+                # one append AHEAD of the position record and assumes that
+                # unrecorded tail IS this (first replayed) block's record.
+                # VERIFY it: if the tail bytes differ, something else wrote
+                # the log (e.g. an un-replicated append from a foreign
+                # code path) and skipping would drop a committed record
+                # from this replica forever — unrecoverable locally.
                 self._skip_torn = False
                 base = self.log.next_offset() - count
+                tail = self.log.read(base) if base >= 0 else None
+                expected = records.set_base_offset(batch, base)
+                if (tail is None or tail[0] != base or tail[1] != count
+                        or tail[2] != expected):
+                    raise ReplicaDiverged(
+                        f"g={self.group} torn-tail mismatch at base {base}: "
+                        f"log tail is not block {blk.id:#x}'s record")
             else:
                 base = self.log.next_offset()
                 self.log.append(records.set_base_offset(batch, base),
@@ -216,6 +254,8 @@ class PartitionFsm:
                     oldest = min(self._pids, key=lambda k: self._pids[k][1])
                     del self._pids[oldest]
         self._applied = blk.id
+        if append and self._fsync:
+            self.log.flush()
         self.kv.put(self._key, self._record())
         if append and self.on_append is not None:
             self.on_append()
@@ -237,14 +277,10 @@ class PartitionFsm:
         only needs the suffix from here."""
         return self.log.next_offset()
 
-    def snapshot_export(self, record: bytes, start: int = 0) -> bytes:
-        """Materialize the wire payload for InstallSnapshot from a stored
-        manifest: a 24-byte header ``(applied, end, start)`` followed by
-        ``(base, count, len, bytes)`` frames covering the log span
-        ``[start, log_end)``. ``start > 0`` is the incremental form (the
-        receiver reported its resume position); 0 ships the full prefix.
-        Called lazily at ship time (engine ``_snapshot_msg``) so the big
-        payload is never stored twice."""
+    def snapshot_export_header(self, record: bytes, start: int = 0) -> bytes:
+        """The wire header of an export: ``(applied, end, start, pid_len)``
+        + the producer-dedup map bytes (validated). ``start > 0`` is the
+        incremental form (the receiver reported its resume position)."""
         if len(record) < 16:
             raise ValueError(
                 f"g={self.group} snapshot record is {len(record)} bytes, "
@@ -253,19 +289,29 @@ class PartitionFsm:
         pid_bytes = record[16:]
         _decode_pids(pid_bytes)  # validate before shipping
         start = min(max(0, start), end)
-        out = [struct.pack(">QQQI", applied, end, start, len(pid_bytes)),
-               pid_bytes]
+        return struct.pack(">QQQI", applied, end, start,
+                           len(pid_bytes)) + pid_bytes
+
+    def snapshot_export_frames(self, record: bytes, start: int,
+                               max_bytes: int) -> tuple[bytes, int, bool]:
+        """One bounded WINDOW of ``(base, count, len, bytes)`` frames from
+        log offset ``start``: ``(frames, next_offset, done)``. The engine's
+        transfer stream calls this per window so a multi-GB partition is
+        never materialized in memory on the sender (ADVICE r2 medium) —
+        only ~max_bytes is live per in-flight transfer."""
+        applied, end = struct.unpack_from(">QQ", record)
+        out = []
         off = start
-        done = False
-        while off < end and not done:
-            blobs = self.log.read_from(off, 4 << 20)
+        size = 0
+        while off < end and size < max_bytes:
+            blobs = self.log.read_from(off, min(max_bytes, 4 << 20))
             if not blobs:
                 raise ValueError(
                     f"g={self.group} log hole at offset {off} "
                     f"(manifest end {end}) exporting snapshot")
             for base, count, payload in blobs:
                 if base >= end:
-                    done = True
+                    off = end
                     break
                 if base != off:
                     # A resume hint that is not one of OUR blob boundaries
@@ -275,8 +321,110 @@ class PartitionFsm:
                         f"boundary (nearest base {base})")
                 out.append(struct.pack(">QII", base, count, len(payload)))
                 out.append(payload)
+                size += 16 + len(payload)
                 off = base + (count or 1)
+                if size >= max_bytes:
+                    break
+        return b"".join(out), off, off >= end
+
+    def snapshot_export(self, record: bytes, start: int = 0) -> bytes:
+        """Full single-shot export (header + all frames). Small states and
+        tests; the engine's chunked transfer path streams windows via
+        snapshot_export_header/snapshot_export_frames instead."""
+        header = self.snapshot_export_header(record, start)
+        _, end = struct.unpack_from(">QQ", record)
+        start = min(max(0, start), end)
+        out = [header]
+        off = start
+        done = off >= end
+        while not done:
+            frames, off, done = self.snapshot_export_frames(
+                record, off, 4 << 20)
+            out.append(frames)
         return b"".join(out)
+
+    # Streaming restore (the engine's chunked-transfer receive path): the
+    # peer's export arrives as bounded chunks and is appended to the log
+    # frame by frame — the receiver never holds the whole export either.
+    # A crash anywhere inside the stream leaves the restore-intent marker,
+    # and boot-time recovery resets the replica (exactly the single-shot
+    # path's guarantee). An aborted stream's partial log is a VALID prefix
+    # of the source's log (frames applied in order), so a follow-up
+    # incremental sync resumes from its end without waste.
+
+    def restore_begin(self, header: bytes) -> None:
+        """Start adopting a snapshot stream. ``header`` is the export
+        header: (applied, end, start, pid_len) + pid map. start == 0 wipes
+        and rebuilds; start > 0 appends from exactly our log end."""
+        if len(header) < 28:
+            raise ValueError("snapshot header shorter than 28 bytes")
+        applied, end, start, pid_len = struct.unpack_from(">QQQI", header)
+        if start > end:
+            raise ValueError(f"snapshot start {start} beyond end {end}")
+        if 28 + pid_len != len(header):
+            raise ValueError("snapshot header/pid-map length mismatch")
+        pids = _decode_pids(header[28:])
+        if start > 0 and start != self.log.next_offset():
+            raise ValueError(
+                f"incremental snapshot starts at {start}, local log end is "
+                f"{self.log.next_offset()}")
+        self.kv.put(self._rkey, b"1")
+        if start == 0:
+            self.log.wipe()
+        self._stream = [applied, end, start, pids]
+
+    def restore_chunk(self, frames: bytes) -> None:
+        """Apply whole frames (the engine's stream layer reassembles frame
+        boundaries from byte chunks)."""
+        if getattr(self, "_stream", None) is None:
+            raise ValueError("restore_chunk without restore_begin")
+        applied, end, off, pids = self._stream
+        pos = 0
+        while pos < len(frames):
+            if pos + 16 > len(frames):
+                raise ValueError("truncated snapshot frame header")
+            base, count, ln = struct.unpack_from(">QII", frames, pos)
+            pos += 16
+            if pos + ln > len(frames):
+                raise ValueError("truncated snapshot frame payload")
+            if count < 1:
+                raise ValueError(f"snapshot frame at {base} has count 0")
+            if base != off:
+                raise ValueError(
+                    f"non-contiguous snapshot frame base {base} != {off}")
+            if base + (count or 1) > end:
+                raise ValueError(
+                    f"snapshot frame at {base} overruns manifest end {end}")
+            self.log.append(frames[pos:pos + ln], count=count)
+            pos += ln
+            off = base + (count or 1)
+        self._stream[2] = off
+
+    def restore_end(self) -> None:
+        """Finish the stream: frames must cover exactly [start, end)."""
+        if getattr(self, "_stream", None) is None:
+            raise ValueError("restore_end without restore_begin")
+        applied, end, off, pids = self._stream
+        if off != end:
+            raise ValueError(
+                f"snapshot stream ends at {off}, header claims {end}")
+        if self._fsync:
+            self.log.flush()
+        self._applied = applied
+        self._skip_torn = False
+        self._pids = pids
+        self._stream = None
+        self.kv.put(self._key, self._record())
+        self.kv.delete(self._rkey)
+        if self.on_append is not None:
+            self.on_append()
+
+    def restore_abort(self) -> None:
+        """Drop an in-flight stream. The partial log is a valid prefix of
+        the source's (kept — a follow-up incremental sync resumes from its
+        end); the intent marker stays until some restore completes, so a
+        crash still degrades to the boot-time reset."""
+        self._stream = None
 
     def restore(self, data: bytes) -> None:
         """Adopt a snapshot payload: ``start == 0`` replaces the whole log;
@@ -288,6 +436,7 @@ class PartitionFsm:
         including the empty payload: restore() is wire-reachable, so an
         empty-means-reset branch would let a degenerate MSG_SNAPSHOT wipe a
         healthy replica (internal resets use _reset_replica)."""
+        self._stream = None  # a single-shot restore supersedes any stream
         if len(data) < 28:
             raise ValueError("partition snapshot shorter than its header")
         applied, end, start, pid_len = struct.unpack_from(">QQQI", data)
@@ -332,6 +481,8 @@ class PartitionFsm:
             self.log.wipe()
         for count, payload in frames:
             self.log.append(payload, count=count)
+        if self._fsync:
+            self.log.flush()
         self._applied = applied
         self._skip_torn = False
         self._pids = pids

@@ -36,10 +36,12 @@ class JosefineBroker:
         raft_client,
         shutdown: Shutdown | None = None,
         leader_hint=None,
+        is_controller=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
-        self.broker = Broker(config, store, raft_client, leader_hint=leader_hint)
+        self.broker = Broker(config, store, raft_client, leader_hint=leader_hint,
+                             is_controller=is_controller)
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self.bound_addr: tuple[str, int] | None = None

@@ -373,6 +373,31 @@ class Store:
         self._kv.put(self._pfx + b"pid:next", b"%d" % (pid + 1))
         return pid
 
+    def prune_drains(self, active_ids) -> list[int]:
+        """Drop brokers that no longer exist in the cluster from every
+        draining row's pending set (a removed broker can never ack, and a
+        drain pinned to it would wedge the row out of the pool forever —
+        ADVICE r2). Rows whose pending set empties become claimable.
+        Deterministic from replicated state: called at conf-REMOVE apply
+        on every node, and once at startup against the member table.
+        Returns the rows freed."""
+        active = {int(b) for b in active_ids}
+        pfx = self._pfx + b"galloc:drain:"
+        freed = []
+        for k, raw in list(self._kv.scan_prefix(pfx)):
+            pending = {int(b) for b in raw.split(b",") if b}
+            kept = pending & active
+            if kept == pending:
+                continue
+            g = int(k[len(pfx):])
+            if kept:
+                self._kv.put(k, b",".join(b"%d" % b for b in sorted(kept)))
+            else:
+                self._kv.delete(k)
+                self._kv.put(self._pfx + b"galloc:free:%d" % g, b"1")
+                freed.append(g)
+        return freed
+
     def groups_pending_release(self, broker_id: int) -> list[int]:
         """Rows still draining on this broker's account (restart scan: a
         node that was down through a DeleteTopic must reset those rows and

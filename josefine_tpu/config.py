@@ -96,6 +96,14 @@ class BrokerConfig:
     # Observability endpoint (/metrics, /state, /healthz); 0 = disabled.
     # TPU-build addition: the reference has no metrics at all (SURVEY.md §5).
     metrics_port: int = 0
+    # Crash model (ARCHITECTURE.md "Durability"): "process" (default) makes
+    # every ack durable to process crash (sqlite WAL synchronous=NORMAL, no
+    # per-append seglog fsync); "power" additionally fsyncs the seglog
+    # before each position record and runs sqlite synchronous=FULL, making
+    # acks durable to OS/power failure at a measured throughput cost
+    # (bench_log.py --fsync). The reference never decided (sled defaults,
+    # src/lib.rs:33).
+    durability: str = "process"
 
     def validate(self) -> None:
         if self.id == 0:
@@ -104,6 +112,10 @@ class BrokerConfig:
             raise ValueError("broker.port must be > 1023")
         if self.metrics_port != 0 and self.metrics_port <= 1023:
             raise ValueError("broker.metrics_port must be 0 (disabled) or > 1023")
+        if self.durability not in ("process", "power"):
+            raise ValueError(
+                f"broker.durability must be 'process' or 'power', "
+                f"got {self.durability!r}")
 
 
 @dataclass
@@ -115,12 +127,21 @@ class EngineConfig:
     # The metadata group is group 0; topic partitions may claim further rows.
     partitions: int = 1
     max_nodes: int = 8
+    # Multi-chip: shard the partition axis over this many local devices
+    # (0 = single device). partitions must be divisible by it.
+    mesh_shards: int = 0
 
     def validate(self) -> None:
         if self.backend not in ("jax", "python"):
             raise ValueError(f"engine.backend must be 'jax' or 'python', got {self.backend!r}")
         if self.partitions < 1 or self.max_nodes < 1:
             raise ValueError("engine.partitions and engine.max_nodes must be >= 1")
+        if self.mesh_shards < 0:
+            raise ValueError("engine.mesh_shards must be >= 0")
+        if self.mesh_shards and self.partitions % self.mesh_shards:
+            raise ValueError(
+                f"engine.partitions ({self.partitions}) must be divisible "
+                f"by engine.mesh_shards ({self.mesh_shards})")
 
 
 @dataclass

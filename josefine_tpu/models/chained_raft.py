@@ -155,6 +155,12 @@ def _process_msg(params: StepParams, st: NodeState, m: Msgs, src: int,
         role=jnp.where(is_ae, FOLLOWER, st.role),
         leader=jnp.where(is_ae, src_i, st.leader),
         elapsed=jnp.where(is_ae, 0, st.elapsed),
+        # Followers track ticks since the last AE from THEIR leader in
+        # hb_elapsed (leaders overwrite it with broadcast cadence state in
+        # node_step §6): it bounds how long the aggregate keepalive may
+        # vouch for a silent group — a live NODE whose row was demoted or
+        # wedged must not park its followers' timers forever.
+        hb_elapsed=jnp.where(is_ae, 0, st.hb_elapsed),
     )
     # Accept if the span is rooted at our head (normal append / empty
     # heartbeat) or at our commit pointer (dead-branch abandonment) — the
@@ -219,6 +225,7 @@ def node_step(
     st: NodeState,        # scalar leaves (+ [N] votes/match)
     inbox: Msgs,          # leaves [N] (message from each src; kind 0 = none)
     proposals: jnp.ndarray,  # i32 client blocks offered to this node this tick
+    peer_fresh: jnp.ndarray | None = None,  # bool/i32[N] transport liveness
 ):
     """One tick of one node: inbox fold -> timers -> election tally ->
     proposal minting -> quorum commit -> outbox. Pure; vmap over (P, N).
@@ -259,6 +266,23 @@ def node_step(
     pv = params.prevote == 1
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
+    if peer_fresh is not None:
+        # Aggregate keepalive (epoch-lease style, cf. CockroachDB's
+        # node-liveness leases): when the transport heard from this group's
+        # leader NODE this tick, that stands in for a per-group heartbeat —
+        # the election timer resets exactly as an empty AE would reset it.
+        # This lets leaders stagger per-group heartbeats (hb_ticks >> 1 at
+        # 100k groups) without slowing failure detection: a dead leader
+        # stops pinging, and every group it led times out on its own
+        # 5-10-tick draw as before. Bounded per group: keepalive only
+        # vouches while the leader's last AE for THIS group is within
+        # 8 heartbeat intervals (follower hb_elapsed counts it) — a live
+        # node whose row was demoted/reset must not pin its old followers'
+        # timers forever (they fall back to normal timeout elections).
+        ka = ((st.leader >= 0)
+              & (peer_fresh[jnp.clip(st.leader, 0, member.shape[0] - 1)] != 0)
+              & (st.hb_elapsed < params.hb_ticks * 8))
+        elapsed = jnp.where(ka, 0, elapsed)
     timed_out = st.alive & my_member & ~is_leader & (elapsed >= st.timeout)
     new_term = jnp.where(timed_out & ~pv, st.term + 1, st.term)
     self_vote = dstN == me
@@ -359,7 +383,12 @@ def node_step(
     hb_due = st.hb_elapsed >= params.hb_ticks
     send_ae = is_leader & st.alive & my_member & is_peer & (hb_due | ids.lt(st.nxt, st.head))
     st = st.replace(
-        hb_elapsed=jnp.where(is_leader, jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
+        # Leaders: broadcast cadence. Followers: ticks since their
+        # leader's last AE (reset in _process_msg; bounds the aggregate
+        # keepalive above).
+        hb_elapsed=jnp.where(is_leader,
+                             jnp.where(hb_due, 1, st.hb_elapsed + 1),
+                             st.hb_elapsed + 1)
     )
     bc_vr = (just_cand | pre_elected) & st.alive & is_peer & ~is_leader
     # A pending reply outranks our own pre-vote broadcast on that lane

@@ -163,6 +163,7 @@ def _process_msg(st: PyNode, m: PyMsg, src: int, src_member: bool,
         st.role = FOLLOWER
         st.leader = src
         st.elapsed = 0
+        st.hb_elapsed = 0  # follower AE-staleness counter (node_step twin)
         accept = (m.x == st.head
                   or (m.x == st.commit and m.y >= st.head))
         if accept:
@@ -190,7 +191,9 @@ def _process_msg(st: PyNode, m: PyMsg, src: int, src_member: bool,
 def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
                  proposals: int, tmin: int, tmax: int, hb_ticks: int,
                  auto_proposals: int = 0,
-                 prevote: int = 1) -> tuple[PyNode, list[PyMsg], PyMetrics]:
+                 prevote: int = 1,
+                 peer_fresh: list | None = None,
+                 ) -> tuple[PyNode, list[PyMsg], PyMetrics]:
     """One tick of one node — the exact contract of ``node_step`` in plain
     Python. ``inbox[src]`` is the message from each src (kind 0 = none);
     returns the outbox addressed per dst."""
@@ -219,6 +222,12 @@ def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
         st.elapsed = 0
     else:
         st.elapsed += 1
+    if (peer_fresh is not None and st.leader >= 0
+            and peer_fresh[min(max(st.leader, 0), N - 1)]
+            and st.hb_elapsed < hb_ticks * 8):
+        # Aggregate keepalive — exact twin of node_step's peer_fresh reset
+        # (bounded by the follower's per-group AE-staleness counter).
+        st.elapsed = 0
     timed_out = (my_member and st.role != LEADER and st.elapsed >= st.timeout)
     just_cand = timed_out and not prevote
     just_precand = timed_out and bool(prevote)
@@ -303,7 +312,8 @@ def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
                              y=reply[dst].y, z=reply[dst].z, ok=reply[dst].ok))
         else:
             out.append(reply[dst])
-    st.hb_elapsed = (1 if hb_due else st.hb_elapsed + 1) if is_leader else 0
+    st.hb_elapsed = ((1 if hb_due else st.hb_elapsed + 1) if is_leader
+                     else st.hb_elapsed + 1)
     return st, out, met
 
 
@@ -384,7 +394,8 @@ class PyCluster:
 # ------------------------------------------------ RaftEngine array adapter
 
 
-def py_node_over_groups(params, member, me, state, inbox, prop_counts):
+def py_node_over_groups(params, member, me, state, inbox, prop_counts,
+                        peer_fresh=None):
     """Drop-in replacement for the engine's jitted ``_node_over_groups``:
     same batched-array contract (one node's rows of all P groups), executed
     by the scalar engine. Used when ``engine.backend = "python"``."""
@@ -415,6 +426,8 @@ def py_node_over_groups(params, member, me, state, inbox, prop_counts):
     i_zt, i_zs = h(inbox.z.t), h(inbox.z.s)
     props = np.asarray(prop_counts)
 
+    pf_list = (None if peer_fresh is None
+               else [bool(x) for x in np.asarray(peer_fresh)])
     o_kind = np.zeros((P, N), np.int32); o_term = np.zeros((P, N), np.int32)
     o_ok = np.zeros((P, N), np.int32)
     o_xt = np.zeros((P, N), np.int32); o_xs = np.zeros((P, N), np.int32)
@@ -443,7 +456,7 @@ def py_node_over_groups(params, member, me, state, inbox, prop_counts):
                       ok=int(i_ok[g, s])) for s in range(N)]
         node, out, met = py_node_step(
             node, [bool(b) for b in mem[g]], msgs, int(props[g]),
-            tmin, tmax, hb, auto, prevote)
+            tmin, tmax, hb, auto, prevote, peer_fresh=pf_list)
         s_term[g] = node.term; s_voted[g] = node.voted_for
         s_role[g] = node.role; s_leader[g] = node.leader
         s_elapsed[g] = node.elapsed; s_timeout[g] = node.timeout

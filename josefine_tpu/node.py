@@ -37,12 +37,28 @@ class Node:
         config.validate()
         self.config = config
         self.shutdown = shutdown or Shutdown()
-        self.kv = open_kv(None if in_memory else config.broker.state_file)
+        self.kv = open_kv(None if in_memory else config.broker.state_file,
+                          full_sync=config.broker.durability == "power")
         self.store = Store(self.kv)
         # group_pool = engine.partitions: row 0 is the metadata group; rows
         # [1, P) are claimable by topic partitions (one consensus group per
         # partition — the P axis of the device state tensor).
         self.fsm = JosefineFsm(self.store, group_pool=config.engine.partitions)
+        mesh = None
+        if config.engine.mesh_shards:
+            # Shard the consensus-group axis over local devices (pure data
+            # parallelism — groups are independent; see RaftEngine mesh).
+            import jax
+            from jax.sharding import Mesh
+
+            import numpy as _np
+
+            devs = jax.devices()
+            k = config.engine.mesh_shards
+            if len(devs) < k:
+                raise ValueError(
+                    f"engine.mesh_shards={k} but only {len(devs)} devices")
+            mesh = Mesh(_np.array(devs[:k]), ("p",))
         self.raft = JosefineRaft(
             config.raft,
             self.kv,
@@ -50,6 +66,7 @@ class Node:
             groups=config.engine.partitions,
             shutdown=self.shutdown.clone(),
             backend=config.engine.backend,
+            mesh=mesh,
         )
         self.client = RaftClient(self.raft)
         self.broker = JosefineBroker(
@@ -57,7 +74,10 @@ class Node:
             self.store,
             self.client,
             shutdown=self.shutdown.clone(),
+            # Controller identity AND consumer-group coordinator anchor
+            # (Broker.coordinator_for): the metadata group's Raft leader.
             leader_hint=lambda: self.raft.engine.leader_id(0),
+            is_controller=lambda: self.raft.engine.is_leader(0),
         )
         # Committed DeleteTopic reaches every node through the FSM; each
         # drops its own on-disk replica logs. Deregistration is synchronous
@@ -71,6 +91,10 @@ class Node:
         # the data-plane PartitionFsm. Startup re-wires from the store scan.
         self.fsm.on_partition_assigned = self._wire_partition
         self.fsm.on_partition_released = self._release_partition
+        # Membership changes prune row-drain entries pinned to removed
+        # brokers (a removed broker can never ack its drain; the row would
+        # otherwise be wedged out of the claimable pool forever).
+        self.raft.engine.on_conf_applied = self._on_conf_applied
         # Released-row ack lane (consensus-group recycling): after resetting
         # local state for a released row, the broker proposes GroupReleased
         # through Raft; the row re-enters the claimable pool once every
@@ -118,12 +142,27 @@ class Node:
             rep = self.broker.broker.replicas.ensure(p)
             eng.register_fsm(p.group, PartitionFsm(
                 self.kv, p.group, rep.log,
-                on_append=self.broker.broker.signal_append))
+                on_append=self.broker.broker.signal_append,
+                fsync=self.config.broker.durability == "power"))
         # Rows released while we were down (the drain entry still lists us):
         # reset the leftover local state and ack so the row can be reused.
         for g in self.store.groups_pending_release(self.config.broker.id):
             if 0 < g < eng.P:
                 self._reset_released_row(g)
+        # Drains pinned to brokers that left the cluster while we were down
+        # (their conf-REMOVE prune may predate our durable state).
+        self.store.prune_drains(
+            m.node_id for m in eng.members.by_id.values() if m.active)
+
+    def _on_conf_applied(self, change) -> None:
+        from josefine_tpu.raft.membership import REMOVE
+
+        if change.op == REMOVE:
+            freed = self.store.prune_drains(
+                m.node_id for m in self.raft.engine.members.by_id.values()
+                if m.active)
+            if freed:
+                log.info("membership remove freed wedged drain rows %s", freed)
 
     def _sync_group_incarnation(self, g: int) -> None:
         """Align local row state with the store's incarnation for row g:
@@ -162,7 +201,8 @@ class Node:
             if p.group not in eng.drivers:
                 eng.register_fsm(p.group, PartitionFsm(
                     self.kv, p.group, rep.log,
-                    on_append=self.broker.broker.signal_append))
+                    on_append=self.broker.broker.signal_append,
+                    fsync=self.config.broker.durability == "power"))
 
     def _release_partition(self, p) -> None:
         """Commit-time hook: the partition's topic was deleted — idle the

@@ -198,6 +198,8 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
             role=jnp.where(is_ae, FOLLOWER, st.role),
             leader=jnp.where(is_ae, src, st.leader),
             elapsed=jnp.where(is_ae, 0, st.elapsed),
+            # Follower AE-staleness counter (node_step twin).
+            hb_elapsed=jnp.where(is_ae, 0, st.hb_elapsed),
         )
         accept = is_ae & (
             ids.eq(m.x, st.head) | (ids.eq(m.x, st.commit) & ids.ge(m.y, st.head))
@@ -337,7 +339,8 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
     send_ae = lead3 & is_peer & (hb_due[:, None, :] | ids.lt(st.nxt, head3))
     st = st.replace(
         hb_elapsed=jnp.where(is_leader,
-                             jnp.where(hb_due, 1, st.hb_elapsed + 1), 0)
+                             jnp.where(hb_due, 1, st.hb_elapsed + 1),
+                             st.hb_elapsed + 1)
     )
     bc_vr = ((just_cand | pre_elected) & alive_b & ~is_leader)[:, None, :] & is_peer
     # Pending replies outrank our own pre-vote broadcast (see node_step).

@@ -26,6 +26,8 @@ always implies "host can extend the chain".
 from __future__ import annotations
 
 import asyncio
+import functools
+import struct as _struct
 from dataclasses import dataclass, field
 
 import jax
@@ -43,7 +45,7 @@ from josefine_tpu.models.types import (
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
 from josefine_tpu.raft.chain import GENESIS, Chain, id_term, id_seq
-from josefine_tpu.raft.fsm import Driver, Fsm, supports_snapshot
+from josefine_tpu.raft.fsm import Driver, Fsm, ReplicaDiverged, supports_snapshot
 from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange, MemberTable, is_conf
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.metrics import REGISTRY
@@ -82,6 +84,95 @@ _CONSENSUS_KINDS = np.asarray(sorted(_CONSENSUS_KIND_SET), np.int32)
 # quorum intersection — dropping the request IS the abstention.
 _PAROLE_DROP_KINDS = frozenset((rpc.MSG_VOTE_REQ, rpc.MSG_PREVOTE_REQ))
 _PAROLE_DROP_ARR = np.asarray(sorted(_PAROLE_DROP_KINDS), np.int32)
+
+
+class _SnapStream:
+    """Sender side of one snapshot transfer, materialized lazily: at most
+    ~window_bytes of export is live per in-flight transfer (ADVICE r2:
+    whole-export pinning was a per-follower multi-GB allocation exactly
+    when a replica is being rebuilt). The byte stream is header + frames;
+    windows advance as acks consume the prefix. Total length is unknown
+    until the log walk completes — the final chunk carries it in z
+    (non-final chunks ship z=0)."""
+
+    __slots__ = ("fsm", "record", "base", "win", "next_log", "log_done")
+
+    def __init__(self, fsm, record: bytes, start_log: int):
+        self.fsm = fsm
+        self.record = record
+        self.base = 0
+        self.win = fsm.snapshot_export_header(record, start_log)
+        self.next_log = start_log
+        self.log_done = False
+
+    def read_at(self, off: int, n: int, window_bytes: int) -> tuple[bytes, int]:
+        """(chunk at byte offset ``off``, total_or_0). total > 0 only when
+        this chunk is final. ``off`` must not regress below the consumed
+        prefix (regressed receivers drop the transfer and re-probe)."""
+        if off < self.base:
+            raise ValueError(f"stream regression: {off} < {self.base}")
+        cut = off - self.base
+        if cut:
+            self.win = self.win[cut:]
+            self.base = off
+        while len(self.win) < n and not self.log_done:
+            frames, self.next_log, self.log_done = (
+                self.fsm.snapshot_export_frames(
+                    self.record, self.next_log, max(window_bytes, n)))
+            self.win += frames
+        chunk = self.win[:n]
+        final = self.log_done and len(self.win) <= n
+        return chunk, (off + len(chunk)) if final else 0
+
+
+class _SnapSink:
+    """Receiver side of one streaming snapshot transfer: reassembles frame
+    boundaries from byte chunks and feeds whole frames to the FSM's
+    restore_begin/chunk/end — memory bound is one partial frame plus the
+    header, never the export."""
+
+    __slots__ = ("fsm", "snap_id", "src", "consumed", "buf", "started")
+
+    def __init__(self, fsm, snap_id: int, src: int):
+        self.fsm = fsm
+        self.snap_id = snap_id
+        self.src = src
+        self.consumed = 0      # byte offset acked back to the sender
+        self.buf = bytearray()  # header-in-progress or partial frame tail
+        self.started = False
+
+    def feed(self, chunk: bytes) -> None:
+        self.buf += chunk
+        self.consumed += len(chunk)
+        if not self.started:
+            if len(self.buf) < 28:
+                return
+            (pid_len,) = _struct.unpack_from(">I", self.buf, 24)
+            if len(self.buf) < 28 + pid_len:
+                return
+            self.fsm.restore_begin(bytes(self.buf[:28 + pid_len]))
+            del self.buf[:28 + pid_len]
+            self.started = True
+        # Feed every COMPLETE frame; keep the partial tail.
+        pos = 0
+        while pos + 16 <= len(self.buf):
+            _base, _cnt, ln = _struct.unpack_from(">QII", self.buf, pos)
+            if pos + 16 + ln > len(self.buf):
+                break
+            pos += 16 + ln
+        if pos:
+            self.fsm.restore_chunk(bytes(self.buf[:pos]))
+            del self.buf[:pos]
+
+    def finish(self) -> None:
+        if not self.started or self.buf:
+            raise ValueError("snapshot stream ended mid-frame")
+        self.fsm.restore_end()
+
+    def abort(self) -> None:
+        ab = getattr(self.fsm, "restore_abort", None)
+        if callable(ab):
+            ab()
 
 
 class NotLeader(Exception):
@@ -148,26 +239,143 @@ def _flat_outputs(xp, st, out, met):
     return xp.concatenate([sv.reshape(-1), ov.reshape(-1)])
 
 
-def _jax_packed_step(params, member, me, state, in10):
+def _jax_packed_step(params, member, me, state, in10, peer_fresh=None):
     inbox = _msgs_from_packed(in10)
     props = in10[9, :, 0]
-    st, out, met = jax.vmap(cr.node_step, in_axes=(None, 0, None, 0, 0, 0))(
-        params, member, me, state, inbox, props)
+    st, out, met = jax.vmap(
+        cr.node_step, in_axes=(None, 0, None, 0, 0, 0, None))(
+        params, member, me, state, inbox, props, peer_fresh)
     return st, _flat_outputs(jnp, st, out, met)
 
 
 _packed_over_groups = jax.jit(_jax_packed_step, donate_argnums=(3,))
 
 
-def _py_packed_step(params, member, me, state, in10):
+def _py_packed_step(params, member, me, state, in10, peer_fresh=None):
     """The scalar host engine behind the same packed-IO contract."""
     from josefine_tpu.models.py_step import py_node_over_groups
 
     in10 = np.asarray(in10)
     inbox = _msgs_from_packed(in10)
     props = in10[9, :, 0]
-    st, out, met = py_node_over_groups(params, member, me, state, inbox, props)
+    st, out, met = py_node_over_groups(params, member, me, state, inbox,
+                                       props, peer_fresh)
     return st, _flat_outputs(np, st, out, met)
+
+
+# Sparse packed-IO step: the dense (10, P, N) inbox upload and
+# (10, P) + (9, P, N) outbox fetch scale transfers linearly with P even
+# when almost every group is idle — at P=100k on a tunneled TPU that is
+# ~25 MB/tick of mostly zeros, and the transfer (not compute) sets the
+# tick floor. The sparse contract uploads only the touched inbox rows
+# (idx + values, bucketed so shapes stay static) and fetches only the
+# CHANGED rows, compacted on device into a fixed-capacity buffer (count +
+# row ids + row data in one flat array). Capacity overflow falls back to
+# materializing the dense device-resident outputs — correct, just slower —
+# and the engine grows its bucket for the next tick.
+
+
+def _sparse_changed(state, st, out, met):
+    """Rows the host must process: any durable/mirrored field moved, a
+    block was minted, leadership changed hands, or the outbox has traffic."""
+    return ((st.term != state.term) | (st.voted_for != state.voted_for)
+            | (st.role != state.role) | (st.leader != state.leader)
+            | (st.head.t != state.head.t) | (st.head.s != state.head.s)
+            | (st.commit.t != state.commit.t)
+            | (st.commit.s != state.commit.s)
+            | (met.minted != 0) | met.became_leader
+            | (out.kind != rpc.MSG_NONE).any(axis=-1))
+
+
+def _sparse_compact(xp, changed, sv, ov, k_out):
+    P = sv.shape[1]
+    N = ov.shape[2]
+    cnt = xp.cumsum(changed.astype(jnp.int32 if xp is jnp else np.int32))
+    total = cnt[-1]
+    pos = xp.where(changed, cnt - 1, k_out)
+    rows = xp.concatenate(
+        [sv.T, ov.transpose(1, 0, 2).reshape(P, 9 * N)], axis=1)
+    if xp is jnp:
+        buf = jnp.zeros((k_out, 10 + 9 * N), _I32).at[pos].set(
+            rows, mode="drop")
+        idx_out = jnp.zeros((k_out,), _I32).at[pos].set(
+            jnp.arange(P, dtype=_I32), mode="drop")
+        return jnp.concatenate(
+            [total[None].astype(_I32), idx_out, buf.reshape(-1)])
+    buf = np.zeros((k_out, 10 + 9 * N), np.int32)
+    idx_out = np.zeros((k_out,), np.int32)
+    sel = pos < k_out
+    buf[pos[sel]] = rows[sel]
+    idx_out[pos[sel]] = np.arange(P, dtype=np.int32)[sel]
+    return np.concatenate(
+        [np.asarray([total], np.int32), idx_out, buf.reshape(-1)])
+
+
+@functools.lru_cache(maxsize=None)
+def _sparse_step_fn(k_out: int):
+    def fn(params, member, me, state, peer_fresh, idx, vals):
+        P, N = member.shape
+        in10 = jnp.zeros((10, P, N), _I32).at[:, idx, :].set(
+            vals, mode="drop")
+        inbox = _msgs_from_packed(in10)
+        props = in10[9, :, 0]
+        st, out, met = jax.vmap(
+            cr.node_step, in_axes=(None, 0, None, 0, 0, 0, None))(
+            params, member, me, state, inbox, props, peer_fresh)
+        sv = jnp.stack([
+            st.term, st.voted_for, st.role, st.leader,
+            st.head.t, st.head.s, st.commit.t, st.commit.s,
+            met.minted, met.became_leader.astype(_I32),
+        ])
+        ov = jnp.stack([
+            out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
+            out.z.t, out.z.s, out.ok,
+        ])
+        changed = _sparse_changed(state, st, out, met)
+        flat = _sparse_compact(jnp, changed, sv, ov, k_out)
+        return st, flat, sv, ov
+
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+def _py_sparse_step(k_out, params, member, me, state, peer_fresh, idx, vals):
+    """Scalar-engine twin of the sparse contract (backend="python")."""
+    from josefine_tpu.models.py_step import py_node_over_groups
+
+    member_np = np.asarray(member)
+    P, N = member_np.shape
+    in10 = np.zeros((10, P, N), np.int32)
+    idx = np.asarray(idx)
+    sel = idx < P
+    in10[:, idx[sel], :] = np.asarray(vals)[:, sel, :]
+    inbox = _msgs_from_packed(in10)
+    props = in10[9, :, 0]
+    st, out, met = py_node_over_groups(params, member, me, state, inbox,
+                                       props, peer_fresh)
+    sv = np.stack([
+        np.asarray(st.term), np.asarray(st.voted_for), np.asarray(st.role),
+        np.asarray(st.leader), np.asarray(st.head.t), np.asarray(st.head.s),
+        np.asarray(st.commit.t), np.asarray(st.commit.s),
+        np.asarray(met.minted), np.asarray(met.became_leader).astype(np.int32),
+    ]).astype(np.int32)
+    ov = np.stack([
+        np.asarray(out.kind), np.asarray(out.term),
+        np.asarray(out.x.t), np.asarray(out.x.s),
+        np.asarray(out.y.t), np.asarray(out.y.s),
+        np.asarray(out.z.t), np.asarray(out.z.s), np.asarray(out.ok),
+    ]).astype(np.int32)
+    changed = ((sv[0] != np.asarray(state.term))
+               | (sv[1] != np.asarray(state.voted_for))
+               | (sv[2] != np.asarray(state.role))
+               | (sv[3] != np.asarray(state.leader))
+               | (sv[4] != np.asarray(state.head.t))
+               | (sv[5] != np.asarray(state.head.s))
+               | (sv[6] != np.asarray(state.commit.t))
+               | (sv[7] != np.asarray(state.commit.s))
+               | (sv[8] != 0) | (sv[9] != 0)
+               | (ov[0] != rpc.MSG_NONE).any(axis=-1))
+    flat = _sparse_compact(np, changed, sv, ov, k_out)
+    return st, flat, sv, ov
 
 
 class RaftEngine:
@@ -187,6 +395,8 @@ class RaftEngine:
         max_nodes: int | None = None,
         backend: str = "jax",
         max_append_entries: int | None = 64,
+        sparse_io: bool | None = None,
+        mesh=None,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -252,12 +462,16 @@ class RaftEngine:
         self._snap_cache: dict[int, tuple[int, bytes]] = {}
         # Chunked snapshot transfer state. Sender: (g, dst) -> (snap_id,
         # next byte offset; -1 = position probe outstanding), advanced by
-        # acks; the materialized (suffix) export lives per transfer in
-        # _snap_payload; (g, dst) -> last-ack tick ages out transfers to
-        # dead/removed followers. Receiver: g -> (snap_id, total, staged
-        # buffer). Acks are queued here and drained into the next tick's
-        # outbound (receive() has no send channel of its own).
+        # acks; export-style FSMs stream lazily via a per-transfer
+        # _SnapStream in _snap_payload (at most ~snap_window_bytes live,
+        # never the whole export); (g, dst) -> last-ack tick ages out
+        # transfers to dead/removed followers. Receiver: g -> a _SnapSink
+        # (streaming FSMs) or (snap_id, total, buffer) staging (single-shot
+        # FSMs, e.g. the small metadata manifests). Acks are queued here
+        # and drained into the next tick's outbound (receive() has no send
+        # channel of its own).
         self.snap_chunk_bytes = 4 << 20
+        self.snap_window_bytes = 8 << 20
         self.snap_transfer_stale_ticks = 200
         # Incremental log-sync resume (receiver-side): when True, a probe
         # reply carries the local log end and the sender ships only the
@@ -289,10 +503,9 @@ class RaftEngine:
                 log.warning("dropping out-of-range parole key %r", k)
                 kv.delete(k)
         self._snap_send_off: dict[tuple[int, int], tuple[int, int]] = {}
-        self._snap_payload: dict[tuple[int, int], bytes] = {}
-        self._snap_payload_meta: dict[tuple[int, int], tuple[int, int]] = {}
+        self._snap_payload: dict[tuple[int, int], _SnapStream] = {}
         self._snap_ack_tick: dict[tuple[int, int], int] = {}
-        self._snap_staging: dict[int, tuple[int, int, bytearray]] = {}
+        self._snap_staging: dict[int, object] = {}
         self._snap_stage_tick: dict[int, int] = {}
         self._snap_acks: list[rpc.WireMsg] = []
 
@@ -359,6 +572,31 @@ class RaftEngine:
             term=jnp.asarray(terms, _I32),
             voted_for=jnp.asarray(voted, _I32),
         )
+        # Multi-chip: shard the P (partition-group) axis across a 1-axis
+        # device mesh. Consensus groups are independent, so the engine
+        # kernel is pure data parallelism over 'p' — no collective at all;
+        # the sparse-IO scatter/compaction cross shards is the only
+        # cross-device traffic, and it is metadata-sized. The node axis
+        # stays local (the other members of each group live on OTHER
+        # hosts, reached over the wire — BASELINE config 5's pod-sharded
+        # variant keeps using parallel/sharded.py's all_to_all for the
+        # fully device-resident simulation).
+        self._mesh = mesh
+        if mesh is not None:
+            if backend != "jax":
+                raise ValueError("mesh sharding requires the jax backend")
+            shards = int(np.prod(list(mesh.shape.values())))
+            if self.P % shards:
+                raise ValueError(
+                    f"groups={self.P} not divisible by mesh devices {shards}")
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            def _spec(a):
+                return PartitionSpec("p", *([None] * (a.ndim - 1)))
+
+            self.state = jax.tree.map(
+                lambda a: jax.device_put(a, NamedSharding(mesh, _spec(a))),
+                self.state)
         # Host mirrors (numpy) for fast per-tick diffing. head/commit mirror
         # the packed chain ids so tick() can select active groups with one
         # vectorized compare instead of an O(P) Python scan.
@@ -387,6 +625,20 @@ class RaftEngine:
         # release/ack/re-claim barrier).
         self._h_ginc = np.zeros(groups, np.int64)
 
+        # Sparse packed IO (see module docs at _sparse_step_fn): auto-on for
+        # large P, where dense per-tick transfers are megabytes of zeros.
+        self._sparse = (groups > 4096) if sparse_io is None else bool(sparse_io)
+        self._backend = backend
+        # Adaptive outbox-compaction capacity: grows on overflow (each size
+        # is its own compiled variant; growth is monotone and bounded by P).
+        self._k_out = min(4096, groups)
+        # Per-src transport liveness: tick of the last frame (of any kind,
+        # including MSG_PING) received from each slot. Drives peer_fresh —
+        # the aggregate keepalive that lets leaders stagger per-group
+        # heartbeats without election timers firing (see node_step).
+        self._h_src_seen = np.full(self.N, -(10 ** 9), np.int64)
+        self.keepalive_window_ticks = 2
+
         self._pending_msgs: list[rpc.WireMsg] = []
         self._pending_batches: list[rpc.MsgBatch] = []
         self._proposals: dict[int, list[tuple[bytes, asyncio.Future | None]]] = {}
@@ -400,6 +652,9 @@ class RaftEngine:
         # overlapping membership change (disjoint-quorum risk).
         self._conf_pending: int | None = self._scan_conf_pending()
         self._conf_notify: list[ConfChange] = []
+        # App-layer conf-apply hook (node-wired after construction, like
+        # the partition hooks, so restart replay cannot fire it).
+        self.on_conf_applied = None
         # Rows recycled DURING the current tick (a claim committing on
         # group 0 fires the recycle hook mid-loop): the rest of this tick
         # must not touch them — their scalar mirror/outbox snapshots predate
@@ -418,6 +673,10 @@ class RaftEngine:
         if isinstance(msg, rpc.MsgBatch):
             self._receive_batch(msg)
             return
+        if 0 <= msg.src < self.N:
+            self._h_src_seen[msg.src] = self._ticks
+        if msg.kind == rpc.MSG_PING:
+            return  # pure keepalive: the liveness stamp above is its payload
         if msg.kind == rpc.MSG_SNAPSHOT:
             if not self._inc_ok(msg):
                 return
@@ -465,6 +724,7 @@ class RaftEngine:
         if not (0 <= b.src < self.N):
             log.warning("dropping batch from unknown src %d", b.src)
             return
+        self._h_src_seen[b.src] = self._ticks
         if len(b) > 1 and not (np.diff(b.group) > 0).all():
             # Our own encoder emits strictly-ascending unique groups
             # (np.nonzero order); normalize anything else so the
@@ -560,8 +820,30 @@ class RaftEngine:
     # -------------------------------------------------------------- tick
 
     def tick(self) -> TickResult:
-        # Rows recycled since the last tick OUTSIDE of tick() (receive()-time
-        # group-0 snapshot installs re-firing partition hooks, startup
+        return self.tick_finish(self.tick_begin())
+
+    def _peer_fresh(self) -> np.ndarray:
+        """(N,) transport-liveness vector: slots heard from within the
+        keepalive window. Feeds the device's aggregate keepalive (see
+        node_step peer_fresh) — a live leader NODE keeps all its groups'
+        follower timers reset even when per-group heartbeats are staggered."""
+        fresh = (self._ticks - self._h_src_seen) <= self.keepalive_window_ticks
+        fresh &= self._active_vec()
+        fresh[self.me] = False
+        return fresh.astype(np.int32)
+
+    def tick_begin(self) -> dict:
+        """Dispatch one tick's device step WITHOUT fetching results.
+
+        Splitting begin/finish lets co-located engines (the in-process
+        bench cluster; a future pipelined server loop) overlap their
+        device round trips — on a tunneled TPU the per-dispatch latency
+        (~65 ms) dominates at scale, and three sequential engine ticks
+        would pay it three times. Contract: no receive() and no group
+        mutation between begin and finish of the same engine.
+        """
+        # Rows recycled since the last tick OUTSIDE of tick() (receive()-
+        # time group-0 snapshot installs re-firing partition hooks, startup
         # resets) were reset before this tick's device step ran — this tick
         # is already their new incarnation and must NOT be suppressed.
         self._recycled_this_tick.clear()
@@ -569,100 +851,180 @@ class RaftEngine:
             # Vote parole: hold every paroled group's election timer at
             # zero so it can never reach candidacy (timeout_min >= 2 ticks;
             # elapsed is +1 per step). Grant-suppression happens at intake.
-            idx = jnp.asarray(list(self._parole), jnp.int32)
+            pidx = jnp.asarray(list(self._parole), jnp.int32)
             self.state = self.state.replace(
-                elapsed=self.state.elapsed.at[idx].set(jnp.asarray(0, _I32)))
-        in10, staged, deferred, deferred_b = self._build_inbox()
-        for g, lst in self._proposals.items():
-            in10[9, g, 0] = len(lst)
-
-        self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
-
-        new_state, flat = self._step(
-            self.params,
-            self.member,
-            self._me_dev,
-            self.state,
-            in10,
-        )
+                elapsed=self.state.elapsed.at[pidx].set(jnp.asarray(0, _I32)))
+        pf = self._peer_fresh()
+        if self._sparse:
+            idx, vals, staged, deferred, deferred_b = self._build_inbox_sparse()
+            step = (functools.partial(_py_sparse_step, self._k_out)
+                    if self._backend == "python"
+                    else _sparse_step_fn(self._k_out))
+            new_state, flat, sv_dev, ov_dev = step(
+                self.params, self.member, self._me_dev, self.state,
+                jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
+            h = {"mode": "sparse", "flat": flat, "sv": sv_dev, "ov": ov_dev,
+                 "staged": staged, "k_out": self._k_out}
+        else:
+            in10, staged, deferred, deferred_b = self._build_inbox()
+            for g, lst in self._proposals.items():
+                in10[9, g, 0] = len(lst)
+            self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
+            new_state, flat = self._step(
+                self.params, self.member, self._me_dev, self.state, in10,
+                jnp.asarray(pf))
+            h = {"mode": "dense", "flat": flat, "staged": staged}
         self.state = new_state
         self._pending_msgs = deferred
         self._pending_batches = deferred_b
+        return h
 
-        # Host-side mirror of device decisions: ONE flat fetch holding the
-        # (10, P) scalar mirror and the (9, P, N) outbox.
-        flat = np.asarray(flat)
-        cut = 10 * self.P
-        sv = flat[:cut].reshape(10, self.P).astype(np.int64, copy=False)
-        ov = flat[cut:].reshape(9, self.P, self.N)
+    def tick_finish(self, h: dict) -> TickResult:
+        staged = h["staged"]
+        # Normalize both fetch modes to COMPACT row arrays: ``proc`` holds
+        # the group ids needing host work and the v_* arrays their fetched
+        # values, position-aligned. Sparse mode never materializes dense
+        # (10, P)/(9, P, N) views — at P=100k that would be tens of MB of
+        # host zero-fill per tick, the exact cost sparse IO removes.
+        if h["mode"] == "dense":
+            # ONE flat fetch holding the (10, P) scalar mirror and the
+            # (9, P, N) outbox.
+            flat = np.asarray(h["flat"])
+            cut = 10 * self.P
+            sv = flat[:cut].reshape(10, self.P).astype(np.int64, copy=False)
+            ov = flat[cut:].reshape(9, self.P, self.N)
+            dense = True
+        else:
+            flat = np.asarray(h["flat"])
+            k_out = h["k_out"]
+            total = int(flat[0])
+            C = 10 + 9 * self.N
+            if total > k_out:
+                # Compaction overflow (burst bigger than capacity):
+                # materialize the dense device-resident outputs — correct,
+                # just a bigger transfer — and grow the bucket.
+                sv = np.asarray(h["sv"]).astype(np.int64, copy=False)
+                ov = np.asarray(h["ov"])
+                dense = True
+                while self._k_out < min(self.P, total):
+                    self._k_out = min(self.P, self._k_out * 8)
+                log.info("sparse outbox overflow (%d > %d); capacity now %d",
+                         total, k_out, self._k_out)
+            else:
+                rows_g = flat[1:1 + k_out][:total].astype(np.int64)
+                buf = flat[1 + k_out:].reshape(k_out, C)[:total]
+                dense = False
+
+        if dense:
+            (n_term, n_voted, n_role, n_leader,
+             n_head_t, n_head_s, n_commit_t, n_commit_s,
+             minted_a, became_a) = sv
+            head_all = (n_head_t << 32) | n_head_s
+            commit_all = (n_commit_t << 32) | n_commit_s
+            # Same predicate as the device-side sparse compaction: any
+            # mirrored field moved (vote-only rows included — their
+            # durable vol record and mirrors must update), plus rows with
+            # queued proposals.
+            active = (became_a != 0) | (minted_a != 0)
+            active |= head_all != self._h_head
+            active |= commit_all != self._h_commit
+            active |= n_role != self._h_role
+            active |= n_leader != self._h_leader
+            active |= (n_term != self._h_term) | (n_voted != self._h_voted)
+            active |= (ov[0] != rpc.MSG_NONE).any(axis=1)  # outbox traffic
+            for g, lst in self._proposals.items():
+                if lst:
+                    active[g] = True
+            proc = np.nonzero(active)[0].astype(np.int64)
+            v = sv[:, proc]
+            ov_c = ov[:, proc, :]
+        else:
+            # Fetched rows ⊇ rows needing work; proposal groups the device
+            # left unchanged (no mint — we are not their leader) are
+            # appended with mirror values so their futures still fail fast.
+            fetched = set(rows_g.tolist())
+            extra = np.asarray(
+                [g for g, lst in self._proposals.items()
+                 if lst and g not in fetched], np.int64)
+            v = buf[:, :10].astype(np.int64).T           # (10, R)
+            ov_c = buf[:, 10:].reshape(total, 9, self.N).transpose(1, 0, 2)
+            proc = rows_g
+            if len(extra):
+                ev = np.stack([
+                    self._h_term[extra], self._h_voted[extra],
+                    self._h_role[extra], self._h_leader[extra],
+                    self._h_head[extra] >> 32,
+                    self._h_head[extra] & 0xFFFFFFFF,
+                    self._h_commit[extra] >> 32,
+                    self._h_commit[extra] & 0xFFFFFFFF,
+                    np.zeros(len(extra), np.int64),
+                    np.zeros(len(extra), np.int64),
+                ])
+                v = np.concatenate([v, ev], axis=1)
+                ov_c = np.concatenate(
+                    [ov_c, np.zeros((9, len(extra), self.N), ov_c.dtype)],
+                    axis=1)
+                proc = np.concatenate([proc, extra])
         (n_term, n_voted, n_role, n_leader,
-         n_head_t, n_head_s, n_commit_t, n_commit_s, minted, became) = sv
+         n_head_t, n_head_s, n_commit_t, n_commit_s, minted, became) = v
         head_new = (n_head_t << 32) | n_head_s
         commit_new = (n_commit_t << 32) | n_commit_s
+        pos_of = {int(g): i for i, g in enumerate(proc)}
 
         if self._parole:
             # Lift parole once legitimate replication has carried the head
             # back past the pre-reset ack watermark: from here on the node's
             # chain again contains everything it ever acknowledged, so its
             # vote is safe to count.
-            for g in [g for g, wm in self._parole.items()
-                      if int(head_new[g]) >= wm]:
-                log.info("g=%d vote parole lifted (head %#x >= watermark "
-                         "%#x)", g, int(head_new[g]), self._parole[g])
-                self._lift_parole(g)
-
-        # Active-group selection, vectorized: a group needs host work only if
-        # leadership moved, a block was minted/accepted (head moved), commit
-        # advanced, or a queued proposal must be resolved/failed. Everything
-        # else is pure device state and stays on device.
-        active = (became != 0) | (minted != 0)
-        active |= head_new != self._h_head
-        active |= commit_new != self._h_commit
-        active |= (self._h_role == LEADER) & (n_role != LEADER)
-        for g, lst in self._proposals.items():
-            if lst:
-                active[g] = True
+            for g, wm in list(self._parole.items()):
+                pos = pos_of.get(g)
+                head = int(head_new[pos]) if pos is not None else int(self._h_head[g])
+                if head >= wm:
+                    log.info("g=%d vote parole lifted (head %#x >= "
+                             "watermark %#x)", g, head, wm)
+                    self._lift_parole(g)
 
         res = TickResult()
-        for g in np.nonzero(active)[0]:
-            g = int(g)
+        reset_rows: set[int] = set()
+        for pos in range(len(proc)):
+            g = int(proc[pos])
             if g in self._recycled_this_tick:
                 # Recycled by a group-0 commit hook earlier in THIS loop
-                # (group 0 is always processed first — nonzero order is
+                # (group 0 is always processed first — proc order is
                 # ascending): every snapshot for this row predates the
                 # reset.
                 continue
             ch = self.chains[g]
-            new_head = int(head_new[g])
+            new_head = int(head_new[pos])
 
             # Leadership transitions.
-            if became[g]:
+            if became[pos]:
                 res.became_leader.append(g)
-                ch.append(int(n_term[g]), b"")  # the no-op liveness block
+                ch.append(int(n_term[pos]), b"")  # the no-op liveness block
                 if g == 0:
                     # A deposed leader's conf block may sit uncommitted in
                     # our log and commit later under us — re-arm the
                     # single-change-in-flight guard from the suffix.
                     self._conf_pending = self._scan_conf_pending()
             was_leader = self._h_role[g] == LEADER
-            if was_leader and n_role[g] != LEADER:
+            if was_leader and n_role[pos] != LEADER:
                 res.lost_leadership.append(g)
                 drv = self.drivers.get(g)
                 if drv:
-                    drv.drop_waiters(NotLeader(g, int(n_leader[g])))
+                    drv.drop_waiters(NotLeader(g, int(n_leader[pos])))
                 if g == 0:
                     self._conf_pending = None
                     for fut in self._conf_waiters.values():
                         if not fut.done():
-                            fut.set_exception(NotLeader(g, int(n_leader[g])))
+                            fut.set_exception(NotLeader(g, int(n_leader[pos])))
                     self._conf_waiters.clear()
 
             # Minted payload blocks (leader): mirror device ids exactly.
             queue = self._proposals.get(g, [])
-            if minted[g]:
-                if minted[g] != len(queue):
+            if minted[pos]:
+                if minted[pos] != len(queue):
                     raise RuntimeError(
-                        f"device minted {minted[g]} blocks but host holds "
+                        f"device minted {minted[pos]} blocks but host holds "
                         f"{len(queue)} payloads (group {g})"
                     )
                 for payload, fut in queue:
@@ -680,7 +1042,7 @@ class RaftEngine:
                             payload = change.encode()
                         except ValueError as e:
                             conf_err, payload = e, b""
-                    blk = ch.append(int(n_term[g]), payload)
+                    blk = ch.append(int(n_term[pos]), payload)
                     drv = self.drivers.get(g)
                     if is_conf(payload):
                         self._conf_pending = blk.id
@@ -697,14 +1059,14 @@ class RaftEngine:
             elif queue:
                 for _, fut in queue:
                     if fut is not None and not fut.done():
-                        fut.set_exception(NotLeader(g, int(n_leader[g])))
+                        fut.set_exception(NotLeader(g, int(n_leader[pos])))
                 self._proposals[g] = []
 
             # Accepted spans (follower): reconcile the chain to the device's
             # new head by walking parent pointers through the staged blocks.
             # This is robust to several AEs landing in one tick: only the
             # branch the device actually adopted is persisted.
-            if new_head != self._h_head[g] and not minted[g] and not became[g]:
+            if new_head != self._h_head[g] and not minted[pos] and not became[pos]:
                 by_id = {b.id: b for b in staged.get(g, [])}
                 path = []
                 cur = new_head
@@ -722,7 +1084,7 @@ class RaftEngine:
                     ch.force_head(new_head)
 
             # Commit advancement -> FSM apply (half-open (old, new], every node).
-            new_commit = int(commit_new[g])
+            new_commit = int(commit_new[pos])
             if new_commit != ch.committed:
                 blocks = ch.commit(new_commit)
                 res.committed[g] = new_commit
@@ -735,7 +1097,24 @@ class RaftEngine:
                         app_blocks.append(blk)
                 drv = self.drivers.get(g)
                 if drv:
-                    drv.apply(app_blocks)
+                    try:
+                        drv.apply(app_blocks)
+                    except ReplicaDiverged as e:
+                        # The FSM proved its local state cannot be the fold
+                        # of the committed sequence: rewind the whole group
+                        # to an empty replica (with vote parole) and let
+                        # the leader re-sync it from scratch.
+                        log.error("g=%d replica diverged (%s); resetting "
+                                  "for full re-sync", g, e)
+                        drv.drop_waiters(NotLeader(g, int(n_leader[pos])))
+                        reset_fsm = getattr(drv.fsm, "reset", None)
+                        if callable(reset_fsm):
+                            reset_fsm()
+                        self._reset_group(g)
+                        self._h_head[g] = GENESIS
+                        self._h_commit[g] = GENESIS
+                        reset_rows.add(g)
+                        continue
 
             # Refresh the chain mirrors for this group (the active-row
             # selector above diffs against these next tick).
@@ -745,29 +1124,42 @@ class RaftEngine:
         # Durable volatile state: (term, voted_for) is ONE record written in
         # one put — a crash can never pair a new term with a stale vote,
         # which would allow a second grant in the same term after restart
-        # (two leaders in one term). Scanned over ALL groups, not just
-        # active ones: granting a vote moves neither head nor commit.
-        vol_changed = (n_term != self._h_term) | (n_voted != self._h_voted)
-        if vol_changed.any():
-            for g in np.nonzero(vol_changed)[0]:
-                self._store_vol(int(g), int(n_term[g]), int(n_voted[g]))
+        # (two leaders in one term). The device's changed-row predicate
+        # includes term/voted moves, so every vote-only row is in proc.
+        vol_changed = (n_term != self._h_term[proc]) | (n_voted != self._h_voted[proc])
+        for pos in np.nonzero(vol_changed)[0]:
+            self._store_vol(int(proc[pos]), int(n_term[pos]), int(n_voted[pos]))
 
         if log.isEnabledFor(10):  # TRACE: per-group role transitions
-            for g in np.nonzero(n_role != self._h_role)[0]:
+            for pos in np.nonzero(n_role != self._h_role[proc])[0]:
+                g = int(proc[pos])
                 log.log(10, "n%d g=%d role %d->%d term=%d head=%#x voted=%d",
-                        self.self_id, int(g), int(self._h_role[g]),
-                        int(n_role[g]), int(n_term[g]), int(head_new[g]),
-                        int(n_voted[g]))
-        self._h_term = n_term
-        self._h_voted = n_voted
-        self._h_role = n_role
-        self._h_leader = n_leader
+                        self.self_id, g, int(self._h_role[g]),
+                        int(n_role[pos]), int(n_term[pos]),
+                        int(head_new[pos]), int(n_voted[pos]))
+        # Rows reset/recycled DURING this tick: their fetched values
+        # predate the reset — adopting them would resurrect a demoted
+        # LEADER mirror (stale leader hints, misrouted produces, _m_led
+        # overcounts). Keep the reset's own mirror writes instead.
+        keep = np.asarray(
+            [int(g) not in reset_rows and int(g) not in self._recycled_this_tick
+             for g in proc], bool) if (reset_rows or self._recycled_this_tick) \
+            else np.ones(len(proc), bool)
+        upd = proc[keep]
+        self._h_term[upd] = n_term[keep]
+        self._h_voted[upd] = n_voted[keep]
+        self._h_role[upd] = n_role[keep]
+        self._h_leader[upd] = n_leader[keep]
 
         if self._conf_notify:
             res.conf_changes.extend(self._conf_notify)
             self._conf_notify.clear()
-        res.outbound = self._decode_outbox(
-            ov, skip=self._recycled_this_tick or None)
+        # Skip rows reset mid-tick too, not just recycled ones: a
+        # ReplicaDiverged reset discards the blocks this tick's computed
+        # AE-ack claims to hold, and a same-tick vote grant from the wiped
+        # row is exactly the forgotten-ack vote parole exists to prevent.
+        skip = self._recycled_this_tick | reset_rows
+        res.outbound = self._decode_outbox(ov_c, proc, skip=skip or None)
         if self._snap_acks:
             # Snapshot-transfer acks queued by receive() (which has no send
             # channel of its own) ride this tick's outbound.
@@ -1007,7 +1399,18 @@ class RaftEngine:
                 return
             start = max(applied(), ch.floor)
             if ch.committed > start:
-                drv.apply(ch.range(start, ch.committed))
+                try:
+                    drv.apply(ch.range(start, ch.committed))
+                except ReplicaDiverged as e:
+                    log.error("g=%d replica diverged during restart replay "
+                              "(%s); resetting for full re-sync", g, e)
+                    reset_fsm = getattr(fsm, "reset", None)
+                    if callable(reset_fsm):
+                        # Wipe the replica too: a polluted log left behind
+                        # would poison an incremental sync's resume hint.
+                        reset_fsm()
+                    self._reset_group(g)
+                    return
         elif supports_snapshot(fsm) and ch.committed != GENESIS:
             snap_id, snap_data = self._load_snapshot(g)
             start = GENESIS
@@ -1152,6 +1555,14 @@ class RaftEngine:
             return
         self.node_ids = [self.members.id_of(s) for s in range(self.N)]
         self.member = self._member_mask()
+        if self.on_conf_applied is not None:
+            # App-layer hook (wired by the node, like the partition hooks):
+            # e.g. pruning row-drain entries pinned to a removed broker.
+            # Runs at commit time on every node — deterministic.
+            try:
+                self.on_conf_applied(change)
+            except Exception:
+                log.exception("on_conf_applied hook failed for %s", change)
         if fut is not None and not fut.done():
             fut.set_result(blk.data)
         if res is not None:
@@ -1260,7 +1671,7 @@ class RaftEngine:
         ch = self.chains[g]
         if msg.x <= ch.committed:
             # Stale: we already hold this prefix — tell the sender to stop.
-            self._snap_staging.pop(g, None)
+            self._drop_staging(g)
             self._snap_acks.append(rpc.WireMsg(
                 kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
                 x=msg.x, y=msg.z, ok=1, inc=int(self._h_ginc[g])))
@@ -1273,47 +1684,100 @@ class RaftEngine:
             hint = (getattr(drv.fsm, "snapshot_resume_offset", None)
                     if (drv and self.snap_incremental) else None)
             resume = int(hint()) if callable(hint) else 0
-            self._snap_staging.pop(g, None)
+            self._drop_staging(g)
             self._snap_acks.append(rpc.WireMsg(
                 kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
                 x=msg.x, y=0, z=resume, ok=0, inc=int(self._h_ginc[g])))
             return
-        total = msg.z if msg.z else len(msg.payload)
-        if msg.y == 0 and len(msg.payload) >= total:
+        if msg.y == 0 and msg.z and len(msg.payload) >= msg.z:
             # Single-frame transfer (small snapshots): install directly.
             # ok=1 only on a successful install — acking a failed one would
             # tear down the sender's state and trigger a full re-stream.
-            self._snap_staging.pop(g, None)
+            self._drop_staging(g)
             if self._install_snapshot(msg, msg.payload):
                 self._snap_acks.append(rpc.WireMsg(
                     kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
-                    dst=msg.src, x=msg.x, y=total, ok=1,
+                    dst=msg.src, x=msg.x, y=msg.z, ok=1,
                     inc=int(self._h_ginc[g])))
             return
-        st = self._snap_staging.get(g)
-        if st is None or st[0] != msg.x or st[1] != total:
-            st = (msg.x, total, bytearray())
-            self._snap_staging[g] = st
+        drv = self.drivers.get(g)
+        streaming = (drv is not None
+                     and callable(getattr(drv.fsm, "restore_begin", None)))
         self._snap_stage_tick[g] = self._ticks
-        buf = st[2]
+        if streaming:
+            # Streaming restore: frames land in the FSM (and its log) as
+            # they arrive — the receiver never buffers the export either
+            # (ADVICE r2). Total length arrives with the FINAL chunk (z).
+            sink = self._snap_staging.get(g)
+            if not isinstance(sink, _SnapSink) or sink.snap_id != msg.x:
+                self._drop_staging(g)
+                sink = _SnapSink(drv.fsm, msg.x, msg.src)
+                self._snap_staging[g] = sink
+                # _drop_staging popped the freshness stamp set above; a
+                # sink without one reads as infinitely stale to the GC.
+                self._snap_stage_tick[g] = self._ticks
+            if msg.y == sink.consumed and msg.payload:
+                if sink.consumed == 0:
+                    # First chunk may begin a stream over an older aborted
+                    # one — fail proposals like the install path does.
+                    drv.drop_waiters(NotLeader(g, msg.src))
+                try:
+                    sink.feed(msg.payload)
+                except (ValueError, OSError) as e:
+                    log.error("rejecting snapshot stream g=%d from %d: %s",
+                              g, msg.src, e)
+                    sink.abort()
+                    self._drop_staging(g)
+                    return
+            if msg.z and sink.consumed >= msg.z:
+                # Plain pop — _drop_staging would ABORT the FSM stream we
+                # are about to finish.
+                self._snap_staging.pop(g, None)
+                self._snap_stage_tick.pop(g, None)
+                try:
+                    sink.finish()
+                except (ValueError, OSError) as e:
+                    log.error("snapshot stream g=%d failed to finish: %s",
+                              g, e)
+                    sink.abort()
+                    return
+                self._adopt_snapshot(g, msg)
+                self._snap_acks.append(rpc.WireMsg(
+                    kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
+                    dst=msg.src, x=msg.x, y=sink.consumed, ok=1,
+                    inc=int(self._h_ginc[g])))
+                return
+            self._snap_acks.append(rpc.WireMsg(
+                kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
+                x=msg.x, y=sink.consumed, ok=0, inc=int(self._h_ginc[g])))
+            return
+        # Single-shot FSMs (e.g. the metadata manifest): buffer-stage. The
+        # total may only arrive with the final chunk (z) under the
+        # streaming sender, so completion is checked against msg.z.
+        st = self._snap_staging.get(g)
+        if not isinstance(st, list) or st[0] != msg.x:
+            st = [msg.x, bytearray()]
+            self._snap_staging[g] = st
+        buf = st[1]
         if msg.y == len(buf) and msg.payload:
             buf += msg.payload
-            if len(buf) > total:
-                log.warning("snapshot staging overflow g=%d (%d > %d); reset",
-                            g, len(buf), total)
-                self._snap_staging.pop(g, None)
-                return
-        if len(buf) >= total:
-            self._snap_staging.pop(g, None)
+        if msg.z and len(buf) >= msg.z:
+            self._drop_staging(g)
             if self._install_snapshot(msg, bytes(buf)):
                 self._snap_acks.append(rpc.WireMsg(
                     kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me,
-                    dst=msg.src, x=msg.x, y=total, ok=1,
+                    dst=msg.src, x=msg.x, y=len(buf), ok=1,
                     inc=int(self._h_ginc[g])))
             return
         self._snap_acks.append(rpc.WireMsg(
             kind=rpc.MSG_SNAPSHOT_ACK, group=g, src=self.me, dst=msg.src,
             x=msg.x, y=len(buf), ok=0, inc=int(self._h_ginc[g])))
+
+    def _drop_staging(self, g: int) -> None:
+        st = self._snap_staging.pop(g, None)
+        if isinstance(st, _SnapSink):
+            st.abort()
+        self._snap_stage_tick.pop(g, None)
 
     def _handle_snap_ack(self, msg: rpc.WireMsg) -> None:
         """Sender side: an ack advances the per-(group, dst) transfer
@@ -1333,12 +1797,13 @@ class RaftEngine:
             return
         if ptr[1] == -1:
             # Position-probe reply: the follower's resume offset rides in
-            # z. Build the (suffix) export and start streaming — the whole
+            # z. Open a lazy stream over the (suffix) export — the whole
             # point of the probe is that a follower that already holds a
-            # log prefix only receives the missing suffix.
+            # log prefix only receives the missing suffix, and the stream
+            # materializes at most a window of it at a time.
             g = msg.group
             drv = self.drivers.get(g)
-            exp = getattr(drv.fsm, "snapshot_export", None) if drv else None
+            exp = getattr(drv.fsm, "snapshot_export_header", None) if drv else None
             if not callable(exp):
                 self._drop_transfer(key)
                 return
@@ -1347,25 +1812,14 @@ class RaftEngine:
                 # The snapshot moved while probing; restart next round.
                 self._drop_transfer(key)
                 return
-            start = int(msg.z)
-            payload = None
-            for k2, m2 in self._snap_payload_meta.items():
-                # Concurrent catch-ups of the SAME span (several replaced
-                # replicas resuming from the same offset) share one bytes
-                # object instead of materializing a full copy per peer.
-                if k2[0] == g and m2 == (ptr[0], start):
-                    payload = self._snap_payload.get(k2)
-                    break
-            if payload is None:
-                try:
-                    payload = exp(record, start)
-                except (ValueError, OSError) as e:
-                    log.error("cannot export snapshot g=%d from %d: %s",
-                              g, start, e)
-                    self._drop_transfer(key)
-                    return
-            self._snap_payload[key] = payload
-            self._snap_payload_meta[key] = (ptr[0], start)
+            try:
+                self._snap_payload[key] = _SnapStream(
+                    drv.fsm, record, int(msg.z))
+            except (ValueError, OSError) as e:
+                log.error("cannot export snapshot g=%d from %d: %s",
+                          g, int(msg.z), e)
+                self._drop_transfer(key)
+                return
             self._snap_send_off[key] = (ptr[0], 0)
             self._snap_sent_tick.pop(key, None)  # first chunk next tick
             return
@@ -1389,7 +1843,6 @@ class RaftEngine:
     def _drop_transfer(self, key: tuple[int, int]) -> None:
         self._snap_send_off.pop(key, None)
         self._snap_payload.pop(key, None)
-        self._snap_payload_meta.pop(key, None)
         self._snap_sent_tick.pop(key, None)
         self._snap_ack_tick.pop(key, None)
 
@@ -1406,8 +1859,7 @@ class RaftEngine:
         for g in [g for g in self._snap_staging
                   if self._ticks - self._snap_stage_tick.get(g, 0)
                   > self.snap_transfer_stale_ticks]:
-            self._snap_staging.pop(g, None)
-            self._snap_stage_tick.pop(g, None)
+            self._drop_staging(g)
 
     def _drop_group_transfers(self, g: int) -> None:
         """Purge ALL transfer state touching group ``g`` (both sides): a
@@ -1415,8 +1867,7 @@ class RaftEngine:
         incarnation's export into a future topic claiming the same row."""
         for k in [k for k in self._snap_send_off if k[0] == g]:
             self._drop_transfer(k)
-        self._snap_staging.pop(g, None)
-        self._snap_stage_tick.pop(g, None)
+        self._drop_staging(g)
 
     def _install_snapshot(self, msg: rpc.WireMsg, payload: bytes | None = None) -> bool:
         """Follower side: adopt a leader snapshot we cannot reach by log
@@ -1470,6 +1921,20 @@ class RaftEngine:
                 # materialized from the sender's log; durably record only
                 # the small manifest — the restored log IS the state.
                 snap_record = drv.fsm.snapshot()
+        self._adopt_snapshot(g, msg, snap_record)
+        log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(payload))
+        return True
+
+    def _adopt_snapshot(self, g: int, msg: rpc.WireMsg,
+                        snap_record: bytes | None = None) -> None:
+        """Chain/device/term adoption after a snapshot's FSM state landed
+        (single-shot restore or a completed stream): persist the snapshot
+        record, reset the chain to the anchor, re-point the device row, and
+        adopt the member table the final chunk carried."""
+        ch = self.chains[g]
+        if snap_record is None:
+            drv = self.drivers.get(g)
+            snap_record = drv.fsm.snapshot() if drv is not None else b""
         # Persist the snapshot record BEFORE mutating the chain (same order
         # as take_snapshot): a crash in between must leave a state the
         # restart recovery can boot from — floor > GENESIS with no matching
@@ -1526,8 +1991,6 @@ class RaftEngine:
                                    slot=m.slot)
                         for m in self.members.by_id.values())
         _m_installs.inc(node=self.self_id)
-        log.info("installed snapshot g=%d at %#x (%d bytes)", g, msg.x, len(payload))
-        return True
 
     # ------------------------------------------------------------ helpers
 
@@ -1621,22 +2084,122 @@ class RaftEngine:
         in10[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
         return in10, staged, deferred, deferred_b
 
-    def _decode_outbox(self, ov, skip: set[int] | None = None) -> list:
+    def _build_inbox_sparse(self) -> tuple[
+            np.ndarray, np.ndarray, dict[int, list],
+            list[rpc.WireMsg], list[rpc.MsgBatch]]:
+        """Compact twin of :meth:`_build_inbox`: instead of filling a dense
+        (10, P, N) buffer, collect the touched groups (messages, batches,
+        proposal queues) into a sorted id vector and pack their rows into a
+        (10, K, N) bucket (K = smallest power-of-8 bucket that fits, so jit
+        shapes stay static). Padding rows carry group id P — the device
+        scatter drops them. Slot-conflict carry-over semantics are
+        identical to the dense builder."""
+        parts = []
+        if self._pending_batches:
+            parts.extend(b.group.astype(np.int64)
+                         for b in self._pending_batches)
+        if self._pending_msgs:
+            parts.append(np.fromiter((m.group for m in self._pending_msgs),
+                                     np.int64, len(self._pending_msgs)))
+        prop_groups = [g for g, lst in self._proposals.items() if lst]
+        if prop_groups:
+            parts.append(np.asarray(prop_groups, np.int64))
+        G = (np.unique(np.concatenate(parts)) if parts
+             else np.empty(0, np.int64))
+        K = 256
+        while K < len(G):
+            K *= 8
+        K = min(K, self.P) if self.P >= 256 else self.P
+        if K < len(G):  # P < 256 and all groups touched
+            K = len(G)
+        idx = np.full(K, self.P, np.int32)
+        idx[:len(G)] = G
+        vals = np.zeros((10, K, self.N), np.int32)
+        staged: dict[int, list] = {}
+        deferred: list[rpc.WireMsg] = []
+        deferred_b: list[rpc.MsgBatch] = []
+        for b in self._pending_batches:
+            rows = np.searchsorted(G, b.group)
+            free = vals[0, rows, b.src] == 0
+            if not free.all():
+                deferred_b.append(b.take(~free))
+                b = b.take(free)
+                if not len(b):
+                    continue
+                rows = np.searchsorted(G, b.group)
+            vals[0, rows, b.src] = b.kind_col
+            vals[1, rows, b.src] = b.term
+            vals[2, rows, b.src] = b.x >> 32
+            vals[3, rows, b.src] = b.x & 0xFFFFFFFF
+            vals[4, rows, b.src] = b.y >> 32
+            vals[5, rows, b.src] = b.y & 0xFFFFFFFF
+            vals[6, rows, b.src] = b.z >> 32
+            vals[7, rows, b.src] = b.z & 0xFFFFFFFF
+            vals[8, rows, b.src] = b.ok
+            for grp, blks in b.blocks.items():
+                staged.setdefault(grp, []).extend(blks)
+        msgs = self._pending_msgs
+        if msgs:
+            keep: list[rpc.WireMsg] = []
+            seen: set[tuple[int, int]] = set()
+            rows_kept: list[int] = []
+            for m in msgs:
+                row = int(np.searchsorted(G, m.group))
+                key = (m.group, m.src)
+                if key in seen or vals[0, row, m.src] != rpc.MSG_NONE:
+                    deferred.append(m)
+                    continue
+                seen.add(key)
+                keep.append(m)
+                rows_kept.append(row)
+                if m.kind == rpc.MSG_APPEND and m.blocks:
+                    staged.setdefault(m.group, []).extend(m.blocks)
+            if keep:
+                k = len(keep)
+                gi = np.asarray(rows_kept, np.intp)
+                si = np.fromiter((m.src for m in keep), np.intp, k)
+                x = np.fromiter((m.x for m in keep), np.int64, k)
+                y = np.fromiter((m.y for m in keep), np.int64, k)
+                z = np.fromiter((m.z for m in keep), np.int64, k)
+                vals[0, gi, si] = np.fromiter((m.kind for m in keep), np.int32, k)
+                vals[1, gi, si] = np.fromiter((m.term for m in keep), np.int32, k)
+                vals[2, gi, si] = x >> 32
+                vals[3, gi, si] = x & 0xFFFFFFFF
+                vals[4, gi, si] = y >> 32
+                vals[5, gi, si] = y & 0xFFFFFFFF
+                vals[6, gi, si] = z >> 32
+                vals[7, gi, si] = z & 0xFFFFFFFF
+                vals[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
+        # Per-(group, src) delivery stamp (ISR liveness), sparse form of the
+        # dense path's full-array mask.
+        gi_loc, si_loc = np.nonzero(vals[0])
+        if len(gi_loc):
+            self._h_last_seen[idx[gi_loc], si_loc] = self._ticks
+        for g in prop_groups:
+            vals[9, np.searchsorted(G, g), 0] = len(self._proposals[g])
+        return idx, vals, staged, deferred, deferred_b
+
+    def _decode_outbox(self, ov, groups, skip: set[int] | None = None) -> list:
         """Decode the packed outbox into ONE columnar MsgBatch per peer (plus
         any InstallSnapshot WireMsgs). The batch IS the wire form — per-tick
         consensus traffic to a peer is a single binary frame end to end; the
         only per-entry Python work left is for AEs that carry payload spans.
+
+        ``ov`` is COMPACT: (9, R, N) covering only the processed rows, with
+        ``groups`` (R,) mapping each row to its group id — the dense form
+        is just R == P with groups == arange(P).
         """
-        # ov is the host-side (9, P, N) slice of the tick's single flat fetch.
         kind = ov[0]
         if skip:
-            # Mid-tick-recycled rows: their outbox was computed by the dead
-            # incarnation but would be stamped with the new one — drop it.
-            kind = kind.copy()
-            kind[list(skip)] = 0
+            rows = [i for i, g in enumerate(groups) if int(g) in skip]
+            if rows:
+                # Mid-tick-recycled rows: their outbox was computed by the
+                # dead incarnation but would be stamped with the new one.
+                kind = kind.copy()
+                kind[rows] = 0
         if not kind.any():
             return []
-        gi, di = np.nonzero(kind)
+        ri, di = np.nonzero(kind)
         i64 = np.int64
         xcol = (ov[2].astype(i64) << 32) | ov[3].astype(i64)
         ycol = (ov[4].astype(i64) << 32) | ov[5].astype(i64)
@@ -1647,13 +2210,14 @@ class RaftEngine:
             sel = di == dst
             if not sel.any():
                 continue
-            g = gi[sel].astype(np.intp)
-            kcol = kind[g, dst].astype(np.int32)
-            tcol = ov[1][g, dst].astype(i64)
-            okcol = ov[8][g, dst].astype(np.int32)
-            bx = xcol[g, dst]
-            by = ycol[g, dst]
-            bz = zcol[g, dst]
+            r = ri[sel].astype(np.intp)
+            g = groups[r].astype(np.intp)
+            kcol = kind[r, dst].astype(np.int32)
+            tcol = ov[1][r, dst].astype(i64)
+            okcol = ov[8][r, dst].astype(np.int32)
+            bx = xcol[r, dst]
+            by = ycol[r, dst]
+            bz = zcol[r, dst]
             batch = rpc.MsgBatch(self.me, dst, g, kcol, tcol, bx, by, bz,
                                  okcol, inc=self._h_ginc[g])
             # AE entries with a non-empty span need chain payloads attached.
@@ -1755,31 +2319,40 @@ class RaftEngine:
             # rejected by every receiver. Defer until re-wiring.
             log.warning("deferring snapshot send g=%d: no FSM registered", g)
             return None
-        exp = getattr(drv.fsm, "snapshot_export", None) if drv else None
+        exp = getattr(drv.fsm, "snapshot_export_header", None) if drv else None
         ptr = self._snap_send_off.get(key)
         if callable(exp):
-            payload = self._snap_payload.get(key)
-            if ptr is None or (ptr[1] >= 0 and payload is None):
+            stream = self._snap_payload.get(key)
+            if ptr is None or ptr[1] == -1 or stream is None:
+                # No transfer (or probe outstanding with its ack lost):
+                # (re-)probe the follower's resume position.
                 return self._probe_msg(g, dst, term, snap_id)
-            if ptr[1] == -1:
-                # Probe outstanding, ack lost: re-probe (at the current
-                # snapshot — nothing is in flight yet to pin).
-                return self._probe_msg(g, dst, term, snap_id)
-            # In-flight transfer: keep shipping ITS payload (ptr[0] may be
+            # In-flight transfer: keep shipping ITS stream (ptr[0] may be
             # an older, pinned snapshot id).
             snap_id = ptr[0]
-            data = payload
-        total = len(data)
-        off = ptr[1] if ptr is not None and ptr[0] == snap_id and ptr[1] >= 0 else 0
-        if off >= total and total > 0:
-            # Fully sent but the follower is still below the floor (final
-            # ack lost, or the follower restarted): restart the transfer.
-            if callable(exp):
-                return self._probe_msg(g, dst, term,
-                                       self.chains[g].floor)
-            off = 0
-        chunk = data[off:off + self.snap_chunk_bytes]
-        final = off + len(chunk) >= total
+            off = ptr[1]
+            try:
+                chunk, total = stream.read_at(off, self.snap_chunk_bytes,
+                                              self.snap_window_bytes)
+            except (ValueError, OSError) as e:
+                log.error("snapshot stream g=%d->%d failed: %s", g, dst, e)
+                self._drop_transfer(key)
+                return None
+            # An exhausted stream still (re-)sends its empty FINAL chunk:
+            # the total in z is what lets the receiver finish, and a lost
+            # final ack just means re-sending it after the throttle window
+            # (a restarted follower's regressed ack drops the transfer via
+            # _handle_snap_ack and re-probes fresh).
+            final = total > 0
+        else:
+            # Single-shot record (e.g. the metadata manifest): the bytes
+            # ARE the payload; chunk by byte offset.
+            off = ptr[1] if ptr is not None and ptr[0] == snap_id and ptr[1] >= 0 else 0
+            if off >= len(data) and len(data) > 0:
+                off = 0  # restart (final ack lost / follower restarted)
+            chunk = data[off:off + self.snap_chunk_bytes]
+            final = off + len(chunk) >= len(data)
+            total = len(data) if final else 0
         self._snap_send_off[key] = (snap_id, off)
         self._snap_ack_tick.setdefault(key, self._ticks)
         self._snap_sent_tick[key] = self._ticks

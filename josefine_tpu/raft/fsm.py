@@ -22,6 +22,14 @@ from josefine_tpu.utils.tracing import get_logger
 log = get_logger("raft.fsm")
 
 
+class ReplicaDiverged(Exception):
+    """Raised by an FSM whose local durable state provably cannot be the
+    fold of the committed sequence (e.g. a torn-append skip found a foreign
+    blob at the tail). The engine reacts by resetting the group to an empty
+    replica (with vote parole) and letting the leader re-sync it — the
+    divergence is local and unrecoverable, never something to paper over."""
+
+
 class Fsm(Protocol):
     """Apply one committed payload, return the response bytes.
 

@@ -53,6 +53,13 @@ MSG_BATCH = 13
 # Snapshot transfer ack: x = snapshot block id, y = bytes staged so far,
 # ok = 1 once the snapshot installed (sender drops its transfer pointer).
 MSG_SNAPSHOT_ACK = 14
+# Transport keepalive: sent once per tick to any peer that would otherwise
+# receive nothing this tick. Feeds the receiver's per-slot liveness vector
+# (peer_fresh), which stands in for per-group heartbeats so a leader of
+# 100k groups can stagger its AE broadcasts (hb_ticks >> 1) without every
+# follower group's election timer firing in between. src is the sender's
+# slot; no other fields are meaningful.
+MSG_PING = 15
 
 
 @dataclass

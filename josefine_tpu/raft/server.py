@@ -56,6 +56,7 @@ class JosefineRaft:
         params: StepParams | None = None,
         shutdown: Shutdown | None = None,
         backend: str = "jax",
+        mesh=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -80,6 +81,7 @@ class JosefineRaft:
             max_nodes=config.max_nodes,
             backend=backend,
             max_append_entries=config.max_append_entries,
+            mesh=mesh,
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime
@@ -302,10 +304,23 @@ class JosefineRaft:
                         self.transport.add_peer(ch.node_id, (ch.ip, ch.port))
                     elif ch.op == membership.REMOVE:
                         self.transport.remove_peer(ch.node_id)
+                pinged: set[int] = set()
                 for m in res.outbound:
                     dst_id = self.engine.node_ids[m.dst]
                     if dst_id is not None:
                         self.transport.send(dst_id, m)
+                        pinged.add(m.dst)
+                # Aggregate keepalive: any peer that received nothing this
+                # tick gets a MSG_PING so its engine's peer_fresh vector
+                # keeps our groups' election timers parked (staggered
+                # heartbeats make empty ticks the norm at large P).
+                for slot in self.engine.members.active_slots():
+                    if slot == self.engine.me or slot in pinged:
+                        continue
+                    dst_id = self.engine.node_ids[slot]
+                    if dst_id is not None:
+                        self.transport.send(dst_id, rpc.WireMsg(
+                            kind=rpc.MSG_PING, src=self.engine.me, dst=slot))
                 elapsed = asyncio.get_running_loop().time() - t0
                 await asyncio.sleep(max(0.0, interval - elapsed))
         except asyncio.CancelledError:

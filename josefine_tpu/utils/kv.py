@@ -64,14 +64,20 @@ class SqliteKV(KV):
     per connection (the engine's tick loop is single-threaded, like the
     reference's actor-owned sled handles)."""
 
-    def __init__(self, path: str | os.PathLike):
+    def __init__(self, path: str | os.PathLike, full_sync: bool = False):
         path = os.fspath(path)
         if os.path.dirname(path):
             os.makedirs(os.path.dirname(path), exist_ok=True)
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         self._db.execute("PRAGMA journal_mode=WAL")
-        self._db.execute("PRAGMA synchronous=NORMAL")
+        # Crash model (see ARCHITECTURE.md "Durability"): NORMAL survives
+        # process crash (every chaos suite's model — WAL commits are
+        # ordered and atomic) but the last commits can be lost on OS/power
+        # failure; FULL fsyncs the WAL per commit for power-loss
+        # durability, at a measured per-put cost (bench_log.py --fsync).
+        self._db.execute("PRAGMA synchronous=%s"
+                         % ("FULL" if full_sync else "NORMAL"))
         self._db.execute("CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)")
         self._db.commit()
 
@@ -122,6 +128,6 @@ class SqliteKV(KV):
             self._db.close()
 
 
-def open_kv(path: str | None) -> KV:
+def open_kv(path: str | None, full_sync: bool = False) -> KV:
     """None -> in-memory (tests); path -> durable sqlite."""
-    return MemKV() if path is None else SqliteKV(path)
+    return MemKV() if path is None else SqliteKV(path, full_sync=full_sync)

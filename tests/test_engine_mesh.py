@@ -1,0 +1,128 @@
+"""The LIVE engine on a multi-chip mesh (round-2 verdict item 4).
+
+parallel/sharded.py covers the fully device-resident simulation; these
+tests put the PRODUCT path — RaftEngine's bridge, chains, FSMs, wire
+routing — on a virtual multi-device mesh with the partition axis sharded
+(pure data parallelism: consensus groups are independent, so the engine
+kernel needs no collectives; only the sparse-IO gather/scatter crosses
+shards).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from test_integration import NodeManager, make_batch
+
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+P = 96
+
+
+def _mesh(k):
+    devs = jax.devices()
+    assert len(devs) >= k, f"conftest provides 8 virtual devices, saw {len(devs)}"
+    return Mesh(np.array(devs[:k]), ("p",))
+
+
+def _mk(mesh, sparse):
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=4)
+    return [RaftEngine(MemKV(), [1, 2, 3], i + 1, groups=P, params=params,
+                       sparse_io=sparse, mesh=mesh) for i in range(3)]
+
+
+def _route(cluster):
+    out = []
+    for e in cluster:
+        out.extend(e.tick().outbound)
+    for m in out:
+        cluster[m.dst].receive(m)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("shards,sparse", [(2, False), (8, True)])
+async def test_mesh_engine_matches_single_device(shards, sparse):
+    """Engine clusters on a sharded mesh must be bit-identical to the
+    single-device engine, tick for tick, through elections and a live
+    proposal lane."""
+    single, meshed = _mk(None, sparse), _mk(_mesh(shards), sparse)
+    futs = []
+    for t in range(200):
+        _route(single)
+        _route(meshed)
+        if t == 60:
+            for g in range(0, P, 9):
+                for cluster in (single, meshed):
+                    for e in cluster:
+                        if e.is_leader(g):
+                            futs.append(e.propose(g, b"m-%d" % g))
+                            break
+        await asyncio.sleep(0)
+    for f in futs:
+        assert f.done() and not f.exception(), f
+    for g in range(P):
+        assert [e.chains[g].head for e in single] == \
+               [e.chains[g].head for e in meshed], f"heads diverge g={g}"
+        assert [e.chains[g].committed for e in single] == \
+               [e.chains[g].committed for e in meshed], f"commits g={g}"
+    assert sum(int((e._h_role == 2).sum()) for e in meshed) == P
+
+
+@pytest.mark.asyncio
+async def test_partition_groups_end_to_end_on_mesh(tmp_path):
+    """Full product on a 2-device mesh: create a replicated topic whose
+    partitions ride live consensus-group rows, produce through Raft, and
+    fetch identical bytes back — the engine path (bridge + chains +
+    PartitionFsm), not just the raw kernel."""
+    async with NodeManager(3, tmp_path, partitions=4, mesh_shards=2) as mgr:
+        await mgr.wait_registered(3)
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            r = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "meshed", "num_partitions": 2,
+                            "replication_factor": 3, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False}, timeout=20.0), 25)
+            assert r["topics"][0]["error_code"] == ErrorCode.NONE
+            # Find partition 0's leader, produce, fetch back.
+            for _ in range(200):
+                md = await asyncio.wait_for(cl.send(
+                    ApiKey.METADATA, 1, {"topics": [{"name": "meshed"}]}), 10)
+                parts = md["topics"][0].get("partitions") or []
+                if len(parts) == 2 and all(p["leader_id"] >= 1 for p in parts):
+                    break
+                await asyncio.sleep(0.1)
+            else:
+                raise AssertionError("leaders never settled on mesh engines")
+            p0 = parts[0]
+            lp = mgr.broker_ports[p0["leader_id"] - 1]
+            c2 = await kafka_client.connect("127.0.0.1", lp)
+            try:
+                pr = await asyncio.wait_for(c2.send(ApiKey.PRODUCE, 3, {
+                    "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                    "topics": [{"name": "meshed", "partitions": [
+                        {"index": p0["partition_index"],
+                         "records": make_batch(b"mesh-payload", 1)}]}]}), 15)
+                rp = pr["responses"][0]["partitions"][0]
+                assert rp["error_code"] == 0, rp
+                fr = await asyncio.wait_for(c2.send(ApiKey.FETCH, 4, {
+                    "replica_id": -1, "max_wait_ms": 0, "min_bytes": 1,
+                    "max_bytes": 1 << 20, "isolation_level": 0,
+                    "topics": [{"topic": "meshed", "partitions": [
+                        {"partition": p0["partition_index"], "fetch_offset": 0,
+                         "partition_max_bytes": 1 << 20}]}]}), 15)
+                fp = fr["responses"][0]["partitions"][0]
+                assert fp["records"].endswith(b"mesh-payload")
+            finally:
+                await c2.close()
+        finally:
+            await cl.close()

@@ -70,6 +70,27 @@ def test_store_release_ack_reuse_lifecycle():
     assert store.group_incarnation(3) == 2
 
 
+def test_prune_drains_unwedges_rows_for_removed_brokers():
+    """A drain pinned to a broker that left the cluster must complete (or
+    free outright) once the membership change applies — otherwise the row
+    is wedged out of the claimable pool forever (ADVICE r2 low). Wired at
+    conf-REMOVE apply (engine.on_conf_applied -> Node._on_conf_applied)
+    and reconciled once at startup."""
+    from josefine_tpu.broker.state import Store
+    from josefine_tpu.utils.kv import MemKV
+
+    st = Store(MemKV())
+    st.release_group(5, [1, 2, 3])
+    st.release_group(6, [3])
+    freed = st.prune_drains([1, 2])          # broker 3 removed
+    assert freed == [6]                      # waited only on 3 -> freed
+    assert 6 in st._galloc_free_rows()
+    assert st.ack_group_release(5, 1) is False
+    assert st.ack_group_release(5, 2) is True   # 3 pruned; 1+2 complete it
+    assert 5 in st._galloc_free_rows()
+    assert st.prune_drains([1, 2]) == []     # idempotent re-prune
+
+
 def test_store_recycles_lowest_row_first():
     store = Store(MemKV())
     pool = 5

@@ -131,6 +131,50 @@ def test_multi_batch_field_coherence_gate():
     assert records.validate_producer_coherence(non_idem2) is None
 
 
+def test_torn_tail_skip_verifies_bytes(tmp_path):
+    """The boot-time torn-append detector (log one append ahead of the
+    position record) may only SKIP the first replayed block if the log
+    tail really is that block's record. A genuine tear re-acks in place; a
+    FOREIGN tail (anything else wrote the log) must raise ReplicaDiverged
+    so the engine resets the replica — silently skipping there drops a
+    committed record from this replica forever (chaos seed 23)."""
+    import pytest as _pytest
+
+    from josefine_tpu.raft.fsm import ReplicaDiverged
+
+    # Genuine tear: append block 1's record, then lose the position record
+    # (simulated by a fresh KV); replay of block 1 skips and re-acks base 0.
+    kv = MemKV()
+    pf = PartitionFsm(kv, 1, Log(tmp_path / "a"))
+    kv.put(pf._key, pf._record())                    # position: applied 0, end 0
+    blk = _blk(1, b"first")
+    pf.log.append(records.set_base_offset(blk.data, 0), count=1)
+    pf2 = PartitionFsm(kv, 1, Log(tmp_path / "a"))   # detects the tear
+    r = decode_produce_result(pf2.transition_block(blk))
+    assert r == (0, 0)
+    assert pf2.log.next_offset() == 1                # no double append
+
+    # Foreign tail: the unrecorded append is NOT the replayed block.
+    kv2 = MemKV()
+    pf3 = PartitionFsm(kv2, 2, Log(tmp_path / "b"))
+    kv2.put(pf3._key, pf3._record())
+    pf3.log.append(records.set_base_offset(_blk(9, b"alien").data, 0), count=1)
+    pf4 = PartitionFsm(kv2, 2, Log(tmp_path / "b"))
+    with _pytest.raises(ReplicaDiverged):
+        pf4.transition_block(_blk(1, b"first"))
+
+    # No position record at all but a non-empty log: the binding must
+    # start from a virgin log — reset to empty rather than fold committed
+    # records on top of foreign content.
+    kv3 = MemKV()
+    pf5 = PartitionFsm(kv3, 3, Log(tmp_path / "c"))
+    pf5.log.append(records.set_base_offset(_blk(9, b"alien").data, 0), count=1)
+    pf6 = PartitionFsm(kv3, 3, Log(tmp_path / "c"))
+    assert pf6.log.next_offset() == 0                # wiped at bind time
+    r = decode_produce_result(pf6.transition_block(_blk(1, b"first")))
+    assert r == (0, 0)
+
+
 def test_dedup_state_survives_restart_and_snapshot(tmp_path):
     kv = MemKV()
     pf = PartitionFsm(kv, 1, Log(tmp_path / "a"))

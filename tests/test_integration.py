@@ -36,7 +36,8 @@ def free_ports(n):
 class NodeManager:
     """N full nodes in one event loop (reference tests/josefine.rs:13-99)."""
 
-    def __init__(self, n, tmp_path, tick_ms=30, partitions=1, in_memory=True):
+    def __init__(self, n, tmp_path, tick_ms=30, partitions=1, in_memory=True,
+                 mesh_shards=0):
         raft_ports = free_ports(n)
         broker_ports = free_ports(n)
         self.nodes = []
@@ -57,7 +58,8 @@ class NodeManager:
                                     port=broker_ports[i],
                                     state_file=str(tmp_path / f"node-{node_id}/state.db"),
                                     data_directory=str(tmp_path / f"node-{node_id}/data")),
-                engine=EngineConfig(partitions=partitions),
+                engine=EngineConfig(partitions=partitions,
+                                    mesh_shards=mesh_shards),
             )
             self.configs.append(cfg)
             self.nodes.append(Node(cfg, in_memory=in_memory))
@@ -69,7 +71,8 @@ class NodeManager:
         return self
 
     async def __aexit__(self, *exc):
-        await asyncio.gather(*(n.stop() for n in self.nodes), return_exceptions=True)
+        await asyncio.gather(*(n.stop() for n in self.nodes if n is not None),
+                             return_exceptions=True)
 
     async def wait_registered(self, count=None, timeout=20.0):
         """Block until every node's self-registration has replicated."""

@@ -412,7 +412,11 @@ def test_log_sync_is_chunked(tmp_path):
                 break
         offsets = sorted({c[0] for c in chunks})
         assert len(offsets) >= 2, chunks  # actually transferred in pieces
-        assert all(c[2] == export_len for c in chunks)
+        # Streaming sender: only the FINAL chunk knows (and carries) the
+        # total; non-final chunks ship z=0.
+        finals = [c for c in chunks if c[2]]
+        assert finals and all(c[2] == export_len for c in finals), chunks
+        assert all(c[0] + c[1] == c[2] for c in finals), chunks
         _run(engines, 20)
         assert (pfsms[follower].log.read_from(0, 1 << 20)
                 == pfsms[lead].log.read_from(0, 1 << 20))
@@ -601,8 +605,7 @@ def test_duplicate_ack_does_not_kill_transfer(tmp_path):
         e = RaftEngine(kv, [1, 2], 1, groups=2, params=PARAMS)
         key = (1, 1)
         e._snap_send_off[key] = (42, 256)
-        e._snap_payload[key] = b"x" * 1024
-        e._snap_payload_meta[key] = (42, 0)
+        e._snap_payload[key] = object()  # stands in for the live stream
 
         dup = rpc.WireMsg(kind=rpc.MSG_SNAPSHOT_ACK, group=1, src=1, dst=0,
                           x=42, y=256, ok=0)
