@@ -180,10 +180,13 @@ async def main():
         results.append(r)
         print(json.dumps(r))
 
+    import jax
+
     name = "engine_packed_step" if args.kernel else "engine_host_bridge"
     out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
     with open(out_path, "w") as f:
-        json.dump({"bench": name, "results": results}, f, indent=1)
+        json.dump({"bench": name, "device": str(jax.devices()[0]),
+                   "results": results}, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -72,8 +72,14 @@ class RaftConfig:
             raise ValueError("raft.port must be > 1023")
         if self.heartbeat_timeout_ms < 10:
             raise ValueError("raft.heartbeat_timeout_ms must be >= 10ms")
-        if self.election_timeout_min_ms < self.heartbeat_timeout_ms:
-            raise ValueError("election timeout must be >= heartbeat timeout")
+        if self.election_timeout_min_ms < self.tick_ms:
+            raise ValueError("election timeout must be >= tick interval")
+        # NOTE: election timeout may legally be SHORTER than the heartbeat
+        # interval — the classic Raft constraint no longer applies because
+        # transport-level keepalive (MSG_PING / any peer traffic) resets
+        # follower election timers between heartbeats (see node_step
+        # peer_fresh). Staggering heartbeats far beyond the election
+        # timeout is exactly the scaled configuration for 100k groups.
         if self.max_nodes and self.max_nodes < len(self.nodes) + 1:
             raise ValueError("raft.max_nodes must cover the configured nodes")
         if self.election_timeout_max_ms < self.election_timeout_min_ms:

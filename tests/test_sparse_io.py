@@ -84,6 +84,74 @@ async def test_sparse_matches_dense(k_out, split):
 
 
 @pytest.mark.asyncio
+async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
+    """Full-stack twin of the engine-level keepalive test: a 3-node cluster
+    whose heartbeat interval is far ABOVE the election timeout must stay
+    term-stable (the server loop's MSG_PING keepalive carries liveness
+    between heartbeats) and still serve a replicated produce/fetch."""
+    from test_integration import NodeManager, make_batch
+
+    from josefine_tpu.kafka import client as kafka_client
+    from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+
+    # tick 30 ms, election 90-240 ms, heartbeats only every ~1.9 s: without
+    # the aggregate keepalive every group would re-elect ~8x per heartbeat
+    # interval and terms would climb continuously.
+    async with NodeManager(3, tmp_path, partitions=2,
+                           heartbeat_ms=64 * 30) as mgr:
+        await mgr.wait_registered(3)
+        cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
+        try:
+            r = await asyncio.wait_for(cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "ka", "num_partitions": 1,
+                            "replication_factor": 3, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False}, timeout=20.0), 25)
+            assert r["topics"][0]["error_code"] == ErrorCode.NONE
+            # Settle until the partition's CONSENSUS GROUP has elected (the
+            # metadata leader falls back to the static assignment before
+            # the group's first election — that is not stability yet).
+            for _ in range(300):
+                p = mgr.nodes[0].store.get_partition("ka", 0)
+                if (p is not None and p.group >= 1
+                        and any(n.raft.engine.is_leader(p.group)
+                                for n in mgr.nodes)):
+                    g = p.group
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("partition group never elected")
+            md = await asyncio.wait_for(cl.send(
+                ApiKey.METADATA, 1, {"topics": [{"name": "ka"}]}), 10)
+            leader0 = md["topics"][0]["partitions"][0]["leader_id"]
+            terms0 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
+                      for n in mgr.nodes]
+            # A quiet stretch spanning MANY election timeouts (90-240 ms)
+            # both within and across heartbeat intervals (~1.9 s).
+            await asyncio.sleep(3.0)
+            terms1 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
+                      for n in mgr.nodes]
+            assert terms1 == terms0, (
+                f"terms churned under keepalive: {terms0} -> {terms1}")
+            md = await asyncio.wait_for(cl.send(
+                ApiKey.METADATA, 1, {"topics": [{"name": "ka"}]}), 10)
+            assert md["topics"][0]["partitions"][0]["leader_id"] == leader0
+            # The data plane still works at this cadence.
+            lp = mgr.broker_ports[leader0 - 1]
+            c2 = await kafka_client.connect("127.0.0.1", lp)
+            try:
+                pr = await asyncio.wait_for(c2.send(ApiKey.PRODUCE, 3, {
+                    "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                    "topics": [{"name": "ka", "partitions": [
+                        {"index": 0, "records": make_batch(b"ka-payload", 1)}]}]}), 15)
+                assert pr["responses"][0]["partitions"][0]["error_code"] == 0
+            finally:
+                await c2.close()
+        finally:
+            await cl.close()
+
+
+@pytest.mark.asyncio
 async def test_staggered_heartbeats_keepalive_holds_timers():
     """With hb_ticks far above the election timeout, followers would
     normally campaign between heartbeats; the aggregate keepalive (any
