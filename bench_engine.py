@@ -184,9 +184,23 @@ async def main():
 
     name = "engine_packed_step" if args.kernel else "engine_host_bridge"
     out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
+    # Merge by P with any existing same-device results so a partial-size
+    # rerun never silently drops rows the README cites.
+    device = str(jax.devices()[0])
+    merged = {r["P"]: r for r in results}
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        for r in prev.get("results", []):
+            # Same-device rows only (older files carried device per row).
+            if prev.get("device", r.get("device")) == device and "P" in r:
+                merged.setdefault(r["P"], r)
+    except (OSError, ValueError, AttributeError, KeyError, TypeError):
+        pass
     with open(out_path, "w") as f:
-        json.dump({"bench": name, "device": str(jax.devices()[0]),
-                   "results": results}, f, indent=1)
+        json.dump({"bench": name, "device": device,
+                   "results": [merged[p] for p in sorted(merged)]},
+                  f, indent=1)
 
 
 if __name__ == "__main__":

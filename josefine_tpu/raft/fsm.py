@@ -17,7 +17,7 @@ import asyncio
 from typing import Protocol
 
 from josefine_tpu.raft.chain import Block
-from josefine_tpu.utils.tracing import get_logger
+from josefine_tpu.utils.tracing import TRACE, get_logger
 
 log = get_logger("raft.fsm")
 
@@ -89,6 +89,7 @@ class Driver:
         # data-plane PartitionFsm's exact-once log append) expose
         # transition_block(blk); plain FSMs get the payload only.
         tb = getattr(self.fsm, "transition_block", None)
+        trace = log.isEnabledFor(TRACE)
         for blk in blocks:
             if not blk.data:  # genesis / no-op blocks carry no payload
                 result = b""
@@ -96,6 +97,13 @@ class Driver:
                 result = tb(blk)
             else:
                 result = self.fsm.transition(blk.data)
+            if trace:
+                # Per-apply span (the reference instruments every method
+                # with #[tracing::instrument]; here the apply seam is the
+                # one whose history answers "what did this replica fold").
+                log.log(TRACE, "apply %s blk=%#x len=%d -> %d waiters=%d",
+                        type(self.fsm).__name__, blk.id, len(blk.data),
+                        len(result), len(self._waiters))
             fut = self._waiters.pop(blk.id, None)
             if fut is not None and not fut.done():
                 fut.set_result(result)

@@ -124,8 +124,18 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
             md = await asyncio.wait_for(cl.send(
                 ApiKey.METADATA, 1, {"topics": [{"name": "ka"}]}), 10)
             leader0 = md["topics"][0]["partitions"][0]["leader_id"]
-            terms0 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
-                      for n in mgr.nodes]
+            # Read the baseline only once all three nodes agree on the
+            # group's term — a follower that did not grant the winning
+            # vote adopts the new term on the first post-election AE, a
+            # tick or two after is_leader flips.
+            for _ in range(100):
+                terms0 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
+                          for n in mgr.nodes]
+                if terms0[0] == terms0[1] == terms0[2]:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError(f"terms never settled: {terms0}")
             # A quiet stretch spanning MANY election timeouts (90-240 ms)
             # both within and across heartbeat intervals (~1.9 s).
             await asyncio.sleep(3.0)
