@@ -26,17 +26,37 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import time
 
 import numpy as np
 
+from bench_backend import configure_jax, ensure_backend, run_guarded
+
 _pre = argparse.ArgumentParser(add_help=False)
 _pre.add_argument("--platform", default=None, help="jax platform override (e.g. cpu)")
 _platform = _pre.parse_known_args()[0].platform
-if _platform:
+_preset = os.environ.get("JOSEFINE_BENCH_PLATFORM")
+if _platform and not _preset:
     import jax
 
     jax.config.update("jax_platforms", _platform)
+    _BACKEND = {"backend_probe": f"skipped (--platform {_platform})", "platform": _platform}
+elif _preset:
+    # A run_guarded CPU re-exec (or explicit preset) outranks --platform:
+    # the re-exec exists precisely because the requested platform hung.
+    import jax
+
+    configure_jax()
+    _BACKEND = {"backend_probe": f"skipped (JOSEFINE_BENCH_PLATFORM={_preset} preset)",
+                "platform": _preset}
+else:
+    # No explicit platform: probe backend health before jax imports so a
+    # hung/broken device tunnel degrades to a labeled CPU run, not a crash.
+    _BACKEND = ensure_backend()
+    import jax
+
+    configure_jax()
 
 from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import RaftEngine
@@ -47,7 +67,7 @@ PROPOSALS_PER_TICK = 256  # distinct groups offered one payload each tick
 PAYLOAD = b"x" * 64
 
 
-async def bench_one(P: int, ticks: int, warmup: int) -> dict:
+async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
     # leader is 200k messages/tick of pure liveness noise). Election
@@ -64,13 +84,18 @@ async def bench_one(P: int, ticks: int, warmup: int) -> dict:
     rng = np.random.default_rng(0)
     proposed = committed = 0
 
+    executed = [0] * N  # device ticks actually run per engine
+
     def one_tick(live: bool):
         nonlocal proposed, committed
         outbound = []
         # Split-phase: dispatch all three engines' device steps before
         # fetching any result, so their (tunnel) round trips overlap.
-        handles = [e.tick_begin() for e in engines]
-        for e, h in zip(engines, handles):
+        # Each engine applies the adaptive window policy (single ticks
+        # until leaders exist, then the full fused window).
+        handles = [e.tick_begin(e.suggest_window(window)) for e in engines]
+        for i, (e, h) in enumerate(zip(engines, handles)):
+            executed[i] += h["window"]
             res = e.tick_finish(h)
             outbound.extend(res.outbound)
             committed += len(res.committed)
@@ -90,23 +115,33 @@ async def bench_one(P: int, ticks: int, warmup: int) -> dict:
     leaders = sum(int((e._h_role == 2).sum()) for e in engines)
 
     proposed = committed = 0
+    executed = [0] * N
     t0 = time.perf_counter()
     for _ in range(ticks):
         one_tick(live=True)
     dt = time.perf_counter() - t0
+    # Windows each dispatch ACTUALLY executed during the timed loop
+    # (suggest_window / tick_begin may clamp below the requested --window);
+    # min across the cluster's engines is the conservative tick count.
+    # Snapshot before the drain loop below adds more.
+    timed_executed = list(executed)
+    dev_ticks = min(timed_executed) if min(timed_executed) else ticks
 
     # Let in-flight commits drain so the commit count is meaningful.
     for _ in range(20):
         one_tick(live=False)
-
     return {
         "P": P,
         "nodes": N,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
-        "ticks": ticks,
-        "ticks_per_sec": round(ticks / dt, 2),
-        "ms_per_tick": round(1000 * dt / ticks, 2),
+        "ticks": dev_ticks,
+        "window": window,
+        "window_executed_avg": round(sum(timed_executed) / (N * ticks), 2),
+        "dispatch_rounds": ticks,
+        "ticks_per_sec": round(dev_ticks / dt, 2),
+        "ms_per_tick": round(1000 * dt / dev_ticks, 2),
+        "ms_per_dispatch_round": round(1000 * dt / ticks, 2),
         "proposed": proposed,
         "committed_group_advances": committed,
         "proposals_per_sec": round(proposed / dt, 1),
@@ -161,6 +196,9 @@ async def main():
     ap.add_argument("--sizes", default="1000,10000,100000")
     ap.add_argument("--ticks", type=int, default=None)
     ap.add_argument("--warmup", type=int, default=40)
+    ap.add_argument("--window", type=int, default=1,
+                    help="fused ticks per dispatch in steady state "
+                         "(engine.suggest_window drops to 1 during elections)")
     ap.add_argument("--kernel", action="store_true",
                     help="time the bare packed step only (no cluster, no wire)")
     args = ap.parse_args()
@@ -176,7 +214,7 @@ async def main():
                      else max(30, 3_000_000 // P))
             if args.ticks is None:
                 ticks = min(200, ticks)
-            r = await bench_one(P, ticks, args.warmup)
+            r = await bench_one(P, ticks, args.warmup, window=args.window)
         results.append(r)
         print(json.dumps(r))
 
@@ -184,9 +222,15 @@ async def main():
 
     name = "engine_packed_step" if args.kernel else "engine_host_bridge"
     out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
+    # A CPU run writes a suffixed artifact so it can never clobber
+    # device-measured rows (the merge below only keeps same-device rows).
+    if jax.default_backend() == "cpu":
+        out_path = out_path.replace(".json", "_cpu.json")
     # Merge by P with any existing same-device results so a partial-size
     # rerun never silently drops rows the README cites.
     device = str(jax.devices()[0])
+    for r in results:
+        r["backend"] = _BACKEND
     merged = {r["P"]: r for r in results}
     try:
         with open(out_path) as f:
@@ -204,4 +248,6 @@ async def main():
 
 
 if __name__ == "__main__":
-    asyncio.run(main())
+    run_guarded(lambda: asyncio.run(main()),
+                metric="engine_host_bridge", unit="ticks/s",
+                backend_info=_BACKEND)

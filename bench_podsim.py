@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Pod-scale sharded simulation: the BASELINE row-5 stand-in.
+
+BASELINE.md config 5 calls for "1M partitions across v5e-64, psum vote
+aggregation over ICI". Real multi-chip hardware is not reachable from this
+environment (one tunneled chip), so this bench runs the SAME sharded
+program — ``parallel/sharded.py``'s shard_map'd cluster step, 'p'-axis data
+parallelism, per-tick all_to_all delivery when the node axis is split — on a
+virtual CPU device mesh, exactly as the driver's ``dryrun_multichip`` does,
+and scales it to the full 1M-partition shape.
+
+Output: one weak-scaling row per device count (P/device held constant, so
+the top row IS the 1M-partition config on 8 devices), with per-shard memory
+accounting. Wall-clock ticks/s on virtual devices measures the XLA CPU
+backend on one physical core — it validates correctness, memory layout, and
+the sharded program at scale, NOT interconnect performance (all_to_all over
+virtual devices is a memcpy, and all 8 "devices" share this box's single
+core, so expect wall time to grow ~linearly with total P instead of staying
+flat — on real chips each shard would step its 131k groups in parallel).
+
+Memory wall math (why 1M is nowhere near the limit): one 5-node group costs
+~760 B of state + ~900 B of in-flight inbox = ~1.7 KB; 1M groups ~1.7 GB,
+or ~27 MB/chip sharded across a v5e-64 — the (P, N, N) progress bricks the
+VERDICT asked to budget are the 400 B/group match/nxt share of that.
+
+Usage: python bench_podsim.py [--per-device 131072] [--devices 1,2,4,8]
+                              [--ticks 10] [--warmup 15]
+Writes MULTICHIP_podsim.json and prints one JSON line per row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+# 8 virtual CPU devices, forced before jax initializes (the sandbox
+# sitecustomize pins JAX_PLATFORMS=axon; config.update after import is what
+# sticks — see tests/conftest.py).
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import LEADER, step_params
+from josefine_tpu.parallel import make_mesh, make_sharded_cluster_step, place
+from josefine_tpu.parallel.sharded import state_spec
+
+
+def tree_bytes(tree) -> int:
+    return sum(a.nbytes for a in jax.tree.leaves(tree))
+
+
+def bench_row(per_device: int, devices: int, ticks: int, warmup: int,
+              N: int = 5) -> dict:
+    P = per_device * devices
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1,
+                         auto_proposals=2)
+    mesh = make_mesh(devices, 1)
+    step = make_sharded_cluster_step(mesh, N)
+
+    t0 = time.perf_counter()
+    state, member = cr.init_state(P, N, base_seed=0, params=params)
+    inbox = cr.empty_inbox(P, N)
+    proposals = jnp.zeros((P, N), jnp.int32)
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    state = place(state, mesh)
+    inbox = place(inbox, mesh)
+    # member rides p-sharded with the node axis whole (the step's in_spec).
+    member = jax.device_put(member, NamedSharding(mesh, PS("p", None)))
+    proposals = place(proposals, mesh)
+    state_b, inbox_b = tree_bytes(state), tree_bytes(inbox)
+    init_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        state, inbox, met = step(params, member, state, inbox, proposals)
+    jax.block_until_ready(jax.tree.leaves(state))
+    warm_s = time.perf_counter() - t0
+
+    accepted = 0
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        state, inbox, met = step(params, member, state, inbox, proposals)
+        # Host-side int sum each tick forces completion (async dispatch
+        # cannot fake it) and doubles as the progress metric.
+        accepted += int(np.asarray(met.accepted_msgs).astype(np.int64).sum())
+    dt = time.perf_counter() - t0
+
+    roles = np.asarray(state.role)
+    led = int(((roles == LEADER).sum(axis=1) == 1).sum())
+    return {
+        "devices": devices,
+        "P": P,
+        "per_device": per_device,
+        "nodes_per_group": N,
+        "ticks": ticks,
+        "ticks_per_sec": round(ticks / dt, 3),
+        "group_ticks_per_sec": round(P * ticks / dt, 1),
+        "accepted_msgs_per_sec": round(accepted / dt, 1),
+        "groups_with_one_leader": led,
+        "leader_fraction": round(led / P, 4),
+        "state_bytes_per_shard": state_b // devices,
+        "inbox_bytes_per_shard": inbox_b // devices,
+        "bytes_per_group": (state_b + inbox_b) // P,
+        "compile_plus_warmup_s": round(warm_s, 2),
+        "init_s": round(init_s, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--per-device", type=int, default=131072)
+    ap.add_argument("--devices", default="1,2,4,8")
+    ap.add_argument("--ticks", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=15)
+    args = ap.parse_args()
+
+    rows = []
+    for d in (int(x) for x in args.devices.split(",")):
+        r = bench_row(args.per_device, d, args.ticks, args.warmup)
+        rows.append(r)
+        print(json.dumps(r))
+
+    top = rows[-1]
+    out = {
+        "bench": "pod_sharded_simulation",
+        "backend": "cpu-virtual-mesh (8 devices on 1 physical core; "
+                   "validates the sharded program + memory layout, not "
+                   "interconnect perf)",
+        "sharding": "shard_map over ('p','n') mesh, p-axis data parallel",
+        "weak_scaling_note": "P/device held constant per row; on shared-"
+                             "core virtual devices wall time grows with "
+                             "total P (no parallel hardware underneath)",
+        "max_P": top["P"],
+        "results": rows,
+    }
+    with open("MULTICHIP_podsim.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    from bench_backend import run_guarded
+
+    run_guarded(main, metric="pod_sharded_simulation", unit="ticks/s",
+                deadline_s=3000)

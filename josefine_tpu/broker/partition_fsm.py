@@ -517,6 +517,14 @@ def _decode_pids(raw: bytes) -> dict[int, list]:
         d = json.loads(raw)
         out: dict[int, list] = {}
         for k, v in d.items():
+            if len(v) == 5 and not isinstance(v[2], list):
+                # Pre-window on-disk shape ([epoch, seq, count, base, blk],
+                # one flat record per pid): accept as a one-entry window so
+                # a cross-version restart upgrades in place instead of
+                # silently wiping the replica for a full re-sync.
+                epoch, seq, count, base, blk = (int(x) for x in v)
+                out[int(k)] = [epoch, blk, [[seq, count, base]]]
+                continue
             epoch, blk, window = int(v[0]), int(v[1]), v[2]
             if not window or len(window) > _DEDUP_WINDOW:
                 raise ValueError(f"window size {len(window)} for pid {k}")

@@ -55,6 +55,20 @@ class RaftConfig:
     # SURVEY.md quirk 9; its hot path hardcodes MAX_INFLIGHT=5).
     # The reference's commit_timeout_ms knob (also dead there) is dropped.
     max_append_entries: int = 64
+    # Multi-tick device windows: the server loop folds up to this many
+    # ticks into one device dispatch while the cluster is in steady state
+    # (RaftEngine.suggest_window drops to single ticks during elections,
+    # snapshot transfers, and parole). 1 = off. Message reaction latency
+    # scales with the window; dispatch count scales with 1/window. The
+    # effective window is additionally clamped to the heartbeat interval
+    # in ticks (heartbeat_timeout_ms / tick_ms) — the window merge's
+    # lossless bound — so raising window_ticks without staggering
+    # heartbeats has no effect. Must be the SAME on every node of a
+    # cluster: each engine's keepalive freshness horizon assumes peers
+    # ping at most one steady-state window apart (engine._peer_fresh), so
+    # a node configured with a smaller window than its peers would judge
+    # them stale and fire spurious elections.
+    window_ticks: int = 1
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120
@@ -80,10 +94,25 @@ class RaftConfig:
         # follower election timers between heartbeats (see node_step
         # peer_fresh). Staggering heartbeats far beyond the election
         # timeout is exactly the scaled configuration for 100k groups.
+        # The keepalive is emitted by RaftEngine.tick_finish itself (not by
+        # the server loop), so this holds for ANY driver — embedded engines
+        # with manual routing (bench clusters, dryrun_multichip) included.
         if self.max_nodes and self.max_nodes < len(self.nodes) + 1:
             raise ValueError("raft.max_nodes must cover the configured nodes")
+        # Device-kernel envelope: the consensus step materializes (P, N, N)
+        # progress bricks and an O(N^2) commit-compare matrix per group
+        # (models/chained_raft.py module docs) — sized for Kafka-style
+        # replication factors, not wide clusters. Reject at config time
+        # rather than letting memory blow up at engine init.
+        if max(self.max_nodes, len(self.nodes) + 1) > 8:
+            raise ValueError(
+                "cluster size (nodes incl. self, or max_nodes) must be <= 8: "
+                "the consensus kernel's (P, N, N) progress state is sized "
+                "for replication-factor-scale N")
         if self.election_timeout_max_ms < self.election_timeout_min_ms:
             raise ValueError("election_timeout_max_ms < election_timeout_min_ms")
+        if self.window_ticks < 1:
+            raise ValueError("raft.window_ticks must be >= 1")
         for n in self.nodes:
             if n.id == self.id:
                 raise ValueError(f"raft.nodes must not contain self (id {n.id})")

@@ -56,6 +56,30 @@ class KafkaClient:
         finally:
             self._pending.pop(corr, None)
 
+    async def send_raw(self, api_key: int, api_version: int, body: dict,
+                       timeout: float = 10.0) -> tuple[bytes, bytes]:
+        """Send one request and return the RAW (request, response) payload
+        bytes (no length prefix, correlation ids intact). Fixture-capture
+        path (tools/capture_fixtures.py): the response bytes come from the
+        peer verbatim, so frames captured against a real broker are
+        independent of this codec's decoder."""
+        if self._writer is None:
+            raise ConnectionError("not connected")
+        corr = next(self._corr)
+        fut = asyncio.get_running_loop().create_future()
+        # Sentinel api_key -1: the read loop resolves the future with the
+        # raw payload instead of decoding.
+        self._pending[corr] = (-1, api_version, fut)
+        payload = codec.encode_request(api_key, api_version, corr,
+                                       self.client_id, body)
+        self._writer.write(codec.frame(payload))
+        await self._writer.drain()
+        try:
+            resp = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(corr, None)
+        return payload, resp
+
     async def _read_loop(self) -> None:
         try:
             while True:
@@ -70,6 +94,10 @@ class KafkaClient:
                     log.warning("response for unknown correlation id %d", corr)
                     continue
                 api_key, api_version, fut = entry
+                if api_key == -1:  # raw capture (send_raw)
+                    if not fut.done():
+                        fut.set_result(bytes(payload))
+                    continue
                 try:
                     d = codec.decode_response(api_key, api_version, payload)
                     if not fut.done():

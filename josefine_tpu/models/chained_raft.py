@@ -431,9 +431,11 @@ def node_step(
     return st, out, metrics
 
 
-# vmap over the node axis, then the partition axis.
-_over_nodes = jax.vmap(node_step, in_axes=(None, None, 0, 0, 0, 0))
-_over_parts = jax.vmap(_over_nodes, in_axes=(None, 0, None, 0, 0, 0))
+# vmap over the node axis, then the partition axis. ``peer_fresh`` is a
+# cluster-wide [N] vector (node-slot transport liveness), broadcast over both
+# axes; passing None threads through vmap untouched (no leaves).
+_over_nodes = jax.vmap(node_step, in_axes=(None, None, 0, 0, 0, 0, None))
+_over_parts = jax.vmap(_over_nodes, in_axes=(None, 0, None, 0, 0, 0, None))
 
 
 def cluster_step_impl(
@@ -442,6 +444,7 @@ def cluster_step_impl(
     state: NodeState,      # leaves (P, N) / (P, N, N)
     inbox: Msgs,           # leaves (P, N_dst, N_src)
     proposals: jnp.ndarray,  # i32 (P, N)
+    peer_fresh: jnp.ndarray | None = None,  # bool/i32 [N], broadcast over P
 ):
     """One lockstep tick of P independent Raft groups of N nodes.
 
@@ -449,10 +452,13 @@ def cluster_step_impl(
     the (dst, src) transpose — messages sent at tick t arrive at tick t+1.
     This *is* the cluster transport for the simulated/batched mode (the
     reference's ``src/raft/tcp.rs`` full-mesh TCP, reduced to a permutation).
+    ``peer_fresh`` models the engine path's aggregate keepalive in-sim: slot
+    j fresh means every group's node j was heard by the transport this tick.
     """
     N = member.shape[-1]
     me = jnp.arange(N, dtype=_I32)
-    st, out, met = _over_parts(params, member, me, state, inbox, proposals)
+    st, out, met = _over_parts(params, member, me, state, inbox, proposals,
+                               peer_fresh)
     next_inbox = jax.tree.map(lambda a: jnp.swapaxes(a, 1, 2), out)
     return st, next_inbox, met
 
@@ -472,6 +478,7 @@ def run_ticks(
     inbox: Msgs,
     proposals: jnp.ndarray,
     ticks: int,
+    peer_fresh: jnp.ndarray | None = None,
 ):
     """Run ``ticks`` lockstep ticks under one ``lax.scan`` (one dispatch).
 
@@ -488,7 +495,8 @@ def run_ticks(
 
     def body(carry, _):
         st, ib = carry
-        st, ib, met = cluster_step_impl(params, member, st, ib, proposals)
+        st, ib, met = cluster_step_impl(params, member, st, ib, proposals,
+                                        peer_fresh)
         return (st, ib), jax.tree.map(lambda a: jnp.sum(a, dtype=_I32), met)
 
     (state, inbox), mets = jax.lax.scan(body, (state, inbox), None, length=ticks)

@@ -107,13 +107,16 @@ def _sel(pred2, a, b):
     return jax.tree.map(one, a, b)
 
 
-def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
+def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs,
+               peer_fresh=None):
     """One lockstep tick of a (nodes N x partitions T) tile.
 
     Hand-vectorized twin of ``chained_raft.node_step`` (same statement
     order, same semantics — see module docstring). Shapes: scalar-per-node
     state leaves (N, T); votes/match/nxt (N, N_peer, T); inbox/outbox
-    (N_dst, N_src, T) / outbox indexed [sender, dst].
+    (N_dst, N_src, T) / outbox indexed [sender, dst]. ``peer_fresh`` is a
+    length-N sequence of scalar i32 0/1 flags (node-slot transport
+    liveness, constant over the window), or None for no keepalive.
 
     ALL leaves (including the logically-boolean ``alive``/``votes``/
     ``member``) are **int32** 0/1 masks: Mosaic cannot select between
@@ -247,6 +250,17 @@ def _tile_step(params: StepParams, member, props, st: NodeState, ib: Msgs):
     pv = params.prevote == 1
     is_leader = st.role == LEADER
     elapsed = jnp.where(is_leader, 0, st.elapsed + 1)
+    if peer_fresh is not None:
+        # Aggregate keepalive — exact twin of node_step's peer_fresh reset
+        # (see its comment for the lease semantics and the hb_elapsed
+        # staleness bound). ``peer_fresh[leader]`` becomes a static unrolled
+        # select over the N slots (no dynamic gather in Mosaic).
+        pf_l = jnp.zeros((N, T), _I32)
+        for j in range(N):
+            pf_l = jnp.where(st.leader == j, peer_fresh[j], pf_l)
+        ka = ((st.leader >= 0) & (pf_l != 0)
+              & (st.hb_elapsed < params.hb_ticks * 8))
+        elapsed = jnp.where(ka, 0, elapsed)
     timed_out = alive_b & member_b & ~is_leader & (elapsed >= st.timeout)
     new_term = jnp.where(timed_out & ~pv, st.term + 1, st.term)
     me2 = jax.lax.broadcasted_iota(_I32, (N, T), 0)
@@ -386,6 +400,10 @@ def _kernel(params_ref, member_ref, props_ref, *refs, n_state: int, n_inbox: int
     met_ref = refs[-1]
 
     params = StepParams(*(params_ref[0, k] for k in range(_N_PARAMS)))
+    # peer_fresh rides the same SMEM row, one i32 0/1 per node slot (None
+    # was encoded as all-zeros by the host wrapper — identical semantics:
+    # the keepalive predicate can never fire).
+    peer_fresh = tuple(params_ref[0, _N_PARAMS + j] for j in range(N))
     member_i = member_ref[:]             # (N, T) i32; bool -> != 0 per tick
     props = props_ref[:]                 # (N, T) i32
 
@@ -398,7 +416,7 @@ def _kernel(params_ref, member_ref, props_ref, *refs, n_state: int, n_inbox: int
         st_leaves, ib_leaves, acc = carry
         st = jax.tree.unflatten(state_def, st_leaves)
         ib = jax.tree.unflatten(inbox_def, ib_leaves)
-        st, out, met = _tile_step(params, member_i, props, st, ib)
+        st, out, met = _tile_step(params, member_i, props, st, ib, peer_fresh)
         # Delivery: next_inbox[dst, src] = out[src, dst] — swap the two
         # leading (non-lane) axes.
         ib2 = jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), out)
@@ -420,8 +438,8 @@ def _kernel(params_ref, member_ref, props_ref, *refs, n_state: int, n_inbox: int
 
 
 @functools.partial(jax.jit, static_argnames=("ticks", "tile", "interpret"))
-def _run_window(params, member, state, inbox, proposals, *, ticks: int,
-                tile: int, interpret: bool):
+def _run_window(params, member, state, inbox, proposals, peer_fresh, *,
+                ticks: int, tile: int, interpret: bool):
     P, N = member.shape
 
     # --- lane layout + pad P to a tile multiple (padded rows: member False,
@@ -450,8 +468,15 @@ def _run_window(params, member, state, inbox, proposals, *, ticks: int,
     state_io = [l.astype(_I32) for l in state_leaves]
     inbox_io = [l.astype(_I32) for l in inbox_leaves]
 
-    pk = jnp.stack([params.timeout_min, params.timeout_max, params.hb_ticks,
-                    params.auto_proposals, params.prevote]).reshape(1, _N_PARAMS)
+    # Params + peer_fresh share one SMEM row: [5 scalar params | N 0/1
+    # keepalive flags]. None encodes as zeros (keepalive can never fire).
+    pf = (jnp.zeros((N,), _I32) if peer_fresh is None
+          else jnp.asarray(peer_fresh).astype(_I32).reshape(N))
+    pk = jnp.concatenate([
+        jnp.stack([params.timeout_min, params.timeout_max, params.hb_ticks,
+                   params.auto_proposals, params.prevote]).astype(_I32),
+        pf,
+    ]).reshape(1, _N_PARAMS + N)
 
     def vspec(a):
         nd = a.ndim
@@ -462,7 +487,8 @@ def _run_window(params, member, state, inbox, proposals, *, ticks: int,
         )
 
     in_specs = (
-        [pl.BlockSpec((1, _N_PARAMS), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        [pl.BlockSpec((1, _N_PARAMS + N), lambda i: (0, 0),
+                      memory_space=pltpu.SMEM),
          vspec(member_t), vspec(props_t)]
         + [vspec(a) for a in state_io]
         + [vspec(a) for a in inbox_io]
@@ -508,17 +534,19 @@ def _run_window(params, member, state, inbox, proposals, *, ticks: int,
 
 
 def run_ticks_fused(params, member, state, inbox, proposals, ticks: int,
-                    tile: int = 512, interpret: bool = False):
+                    tile: int = 512, interpret: bool = False,
+                    peer_fresh=None):
     """Run ``ticks`` lockstep ticks in one fused kernel launch per tile.
 
     Same contract as :func:`chained_raft.run_ticks` (``proposals`` re-offered
-    every tick) except metrics come back as a dict of **window totals**
+    every tick; optional ``peer_fresh`` [N] keepalive flags held constant
+    over the window) except metrics come back as a dict of **window totals**
     (int64 host scalars summed across tiles) instead of per-tick vectors:
     keys ``accepted_blocks, accepted_msgs, minted, commit_delta,
     became_leader``. Inputs/outputs use the standard (P, ...) layout.
     """
     state, inbox, tile_metrics = _run_window(
-        params, member, state, inbox, proposals,
+        params, member, state, inbox, proposals, peer_fresh,
         ticks=int(ticks), tile=int(tile), interpret=bool(interpret))
     tm = np.asarray(tile_metrics).astype(np.int64).sum(axis=(0, 1))
     totals = {f: int(tm[i]) for i, f in enumerate(_METRIC_FIELDS)}

@@ -293,10 +293,15 @@ class JosefineRaft:
         """The event loop (reference server.rs:120-161): fixed cadence, each
         iteration steps the engine once and flushes its outbox."""
         interval = self.config.tick_ms / 1000
+        max_window = max(1, int(getattr(self.config, "window_ticks", 1)))
         try:
             while not self.shutdown.is_shutdown:
                 t0 = asyncio.get_running_loop().time()
-                res = self.engine.tick()
+                # Steady-state clusters fold up to window_ticks ticks into
+                # one device dispatch; elections/snapshots/parole drop back
+                # to single ticks (engine.suggest_window).
+                w = self.engine.suggest_window(max_window)
+                res = self.engine.tick(window=w)
                 for ch in res.conf_changes:
                     if ch.node_id == self.config.id:
                         continue
@@ -304,25 +309,15 @@ class JosefineRaft:
                         self.transport.add_peer(ch.node_id, (ch.ip, ch.port))
                     elif ch.op == membership.REMOVE:
                         self.transport.remove_peer(ch.node_id)
-                pinged: set[int] = set()
+                # Keepalive pings ride res.outbound — the engine emits them
+                # itself (tick_finish), so every driver loop gets them.
                 for m in res.outbound:
                     dst_id = self.engine.node_ids[m.dst]
                     if dst_id is not None:
                         self.transport.send(dst_id, m)
-                        pinged.add(m.dst)
-                # Aggregate keepalive: any peer that received nothing this
-                # tick gets a MSG_PING so its engine's peer_fresh vector
-                # keeps our groups' election timers parked (staggered
-                # heartbeats make empty ticks the norm at large P).
-                for slot in self.engine.members.active_slots():
-                    if slot == self.engine.me or slot in pinged:
-                        continue
-                    dst_id = self.engine.node_ids[slot]
-                    if dst_id is not None:
-                        self.transport.send(dst_id, rpc.WireMsg(
-                            kind=rpc.MSG_PING, src=self.engine.me, dst=slot))
                 elapsed = asyncio.get_running_loop().time() - t0
-                await asyncio.sleep(max(0.0, interval - elapsed))
+                # A w-tick window covers w tick intervals of wall time.
+                await asyncio.sleep(max(0.0, interval * w - elapsed))
         except asyncio.CancelledError:
             pass
         except Exception:

@@ -288,3 +288,23 @@ async def test_init_producer_id_and_idempotent_produce_e2e(tmp_path):
                 await cl2.close()
         finally:
             await cl.close()
+
+
+def test_decode_pids_accepts_pre_window_record_shape():
+    """Cross-version restart (ADVICE r3): a position record written by the
+    flat pre-window dedup format ([epoch, seq, count, base, blk] per pid)
+    must decode as a one-entry window instead of raising — raising would
+    silently wipe the replica for a full re-sync on every upgrade."""
+    from josefine_tpu.broker.partition_fsm import _decode_pids, _encode_pids
+
+    old = b'{"7":[3,41,8,1200,9000215]}'  # epoch 3, seq 41, count 8, base 1200
+    got = _decode_pids(old)
+    assert got == {7: [3, 9000215, [[41, 8, 1200]]]}
+    # Round-trips through the current encoder from here on.
+    assert _decode_pids(_encode_pids(got)) == got
+
+    # Mixed maps (one pid migrated, one already windowed) decode too.
+    mixed = b'{"1":[2,10,4,100,77],"2":[5,88,[[6,2,50],[8,3,52]]]}'
+    got = _decode_pids(mixed)
+    assert got[1] == [2, 77, [[10, 4, 100]]]
+    assert got[2] == [5, 88, [[6, 2, 50], [8, 3, 52]]]

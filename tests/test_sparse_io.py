@@ -94,11 +94,16 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
     from josefine_tpu.kafka import client as kafka_client
     from josefine_tpu.kafka.codec import ApiKey, ErrorCode
 
-    # tick 30 ms, election 90-240 ms, heartbeats only every ~1.9 s: without
-    # the aggregate keepalive every group would re-elect ~8x per heartbeat
-    # interval and terms would climb continuously.
+    # tick 30 ms, election 450-900 ms, heartbeats only every ~3.8 s: without
+    # the aggregate keepalive every group would re-elect ~4-8x per heartbeat
+    # interval and terms would climb continuously. Election timeouts are
+    # deliberately wide (15-30 ticks, not the usual 3-8) so a starved CI
+    # runner stalling the event loop for a few hundred ms cannot fire a
+    # spurious election and flake the term-stability assertion below
+    # (ADVICE r3: this test was load/order flaky at 90-240 ms timeouts).
     async with NodeManager(3, tmp_path, partitions=2,
-                           heartbeat_ms=64 * 30) as mgr:
+                           heartbeat_ms=128 * 30,
+                           election_ticks=(15, 30)) as mgr:
         await mgr.wait_registered(3)
         cl = await kafka_client.connect("127.0.0.1", mgr.broker_ports[0])
         try:
@@ -136,9 +141,9 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
                 await asyncio.sleep(0.05)
             else:
                 raise AssertionError(f"terms never settled: {terms0}")
-            # A quiet stretch spanning MANY election timeouts (90-240 ms)
-            # both within and across heartbeat intervals (~1.9 s).
-            await asyncio.sleep(3.0)
+            # A quiet stretch spanning MANY election timeouts (450-900 ms)
+            # both within and across heartbeat intervals (~3.8 s).
+            await asyncio.sleep(4.5)
             terms1 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
                       for n in mgr.nodes]
             assert terms1 == terms0, (

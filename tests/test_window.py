@@ -1,0 +1,164 @@
+"""Multi-tick device windows (engine.tick(window=K)).
+
+The window step folds K ticks into one dispatch with a last-writer-wins
+outbox merge (see engine.py commentary above _window_step_fn). These suites
+pin it three ways: the jax and python backends must agree BIT-EXACTLY while
+stepping windows (the differential seam that guards all three step
+implementations), a quiet keepalive-vouched cluster must stay term-stable
+across long windows, and the full propose->commit->re-elect lifecycle must
+work at window > 1.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from josefine_tpu.models.types import LEADER, step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+
+class ListFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data):
+        self.applied.append(bytes(data))
+        return b"ok:" + data
+
+
+def make_cluster(backend, sparse, groups=6, hb_ticks=8):
+    ids3 = [1, 2, 3]
+    fsms = [ListFsm() for _ in ids3]
+    engines = [
+        RaftEngine(MemKV(), ids3, ids3[i], groups=groups, fsms={0: fsms[i]},
+                   params=step_params(timeout_min=3, timeout_max=8,
+                                      hb_ticks=hb_ticks),
+                   base_seed=i, backend=backend, sparse_io=sparse)
+        for i in range(3)
+    ]
+    return engines, fsms
+
+
+def run_windows(engines, n, window, inject=None, adaptive=True):
+    """Step all engines n windows, routing outbound between them. ``inject``
+    is an optional callable(window_index) -> list[(engine_idx, group,
+    payload)] of proposals submitted before that window. With ``adaptive``
+    each engine applies its own suggest_window policy (the product loop),
+    dropping to single ticks while any group is leaderless."""
+    futs = []
+    for w in range(n):
+        for ei, g, payload in (inject(w) if inject else []):
+            if engines[ei].is_leader(g):
+                futs.append(engines[ei].propose(g, payload))
+        results = [
+            e.tick(window=e.suggest_window(window) if adaptive else window)
+            for e in engines
+        ]
+        for res in results:
+            for m in res.outbound:
+                engines[m.dst].receive(m)
+    return futs
+
+
+def mirror_snapshot(e):
+    return (e._h_term.copy(), e._h_voted.copy(), e._h_role.copy(),
+            e._h_leader.copy(), e._h_head.copy(), e._h_commit.copy())
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_windowed_differential_jax_vs_python(sparse):
+    """jax windows == python windows, every mirror integer, every window —
+    the same exact-equality bar the single-tick differential suite sets."""
+    async def main():
+        jx, jfsms = make_cluster("jax", sparse)
+        py, pfsms = make_cluster("python", sparse)
+
+        def inject(w):
+            # A deterministic proposal drizzle: two groups, every 3rd window.
+            if w % 3 == 0:
+                return [(ei, g, b"w%d-g%d" % (w, g))
+                        for ei in range(3) for g in (0, 1)]
+            return []
+
+        for w in range(25):
+            run_windows(jx, 1, window=4, inject=inject if w else None)
+            run_windows(py, 1, window=4, inject=inject if w else None)
+            for e_j, e_p in zip(jx, py):
+                for a, b in zip(mirror_snapshot(e_j), mirror_snapshot(e_p)):
+                    np.testing.assert_array_equal(a, b, err_msg=f"window {w}")
+        # The replicated outcome is identical too.
+        assert [f.applied for f in jfsms] == [f.applied for f in pfsms]
+        assert any(f.applied for f in jfsms)
+
+    asyncio.run(main())
+
+
+def test_windowed_quiet_cluster_stays_term_stable():
+    """Keepalive across windows: staggered heartbeats (hb 8 >> timeout 3-8)
+    plus K=4 windows — 40 quiet windows (160 ticks) must not move any term."""
+    async def main():
+        engines, _ = make_cluster("jax", sparse=False, hb_ticks=8)
+        run_windows(engines, 40, window=1)  # settle
+        assert sum(e.is_leader(0) for e in engines) == 1
+        # Steady state: the adaptive policy opens the window fully.
+        assert all(e.suggest_window(4) == 4 for e in engines)
+        terms0 = [e._h_term.copy() for e in engines]
+        run_windows(engines, 40, window=4)
+        for e, t0 in zip(engines, terms0):
+            np.testing.assert_array_equal(e._h_term, t0)
+
+    asyncio.run(main())
+
+
+def test_windowed_commit_and_reelection():
+    async def main():
+        engines, fsms = make_cluster("jax", sparse=False)
+        run_windows(engines, 30, window=2)
+        leads = [i for i, e in enumerate(engines) if e.is_leader(0)]
+        assert len(leads) == 1
+        lead = leads[0]
+        fut = engines[lead].propose(0, b"windowed-payload")
+        run_windows(engines, 8, window=2)
+        assert (await fut) == b"ok:windowed-payload"
+        live = [e for i, e in enumerate(engines) if i != lead]
+        # Crash the leader (stop ticking it); the survivors re-elect even
+        # though every dispatch covers 2 ticks.
+        for _ in range(60):
+            results = [e.tick(window=e.suggest_window(2)) for e in live]
+            for res in results:
+                for m in res.outbound:
+                    if m.dst != engines[lead].me:
+                        next(e for e in live if e.me == m.dst).receive(m)
+            if sum(e.is_leader(0) for e in live) == 1:
+                break
+        assert sum(e.is_leader(0) for e in live) == 1
+        # And the new leader still commits.
+        nl = next(e for e in live if e.is_leader(0))
+        fut2 = nl.propose(0, b"after-failover")
+        for _ in range(12):
+            results = [e.tick(window=e.suggest_window(2)) for e in live]
+            for res in results:
+                for m in res.outbound:
+                    if m.dst != engines[lead].me:
+                        next(e for e in live if e.me == m.dst).receive(m)
+        assert (await fut2) == b"ok:after-failover"
+
+    asyncio.run(main())
+
+
+def test_window_clamped_to_hb_ticks_and_parole():
+    async def main():
+        engines, _ = make_cluster("jax", sparse=False, hb_ticks=4)
+        e = engines[0]
+        h = e.tick_begin(window=64)
+        assert h["window"] == 4  # clamped to hb_ticks (lossless-merge bound)
+        e.tick_finish(h)
+        e._parole[1] = 123
+        h = e.tick_begin(window=4)
+        assert h["window"] == 1  # parole hold is re-asserted per dispatch
+        e.tick_finish(h)
+        e._parole.clear()
+
+    asyncio.run(main())
