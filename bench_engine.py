@@ -180,12 +180,41 @@ def bench_kernel(P: int, iters: int) -> dict:
         st, flat = e._step(e.params, e.member, e._me_dev, st, in10_dev)
         jax.block_until_ready(flat)
     dt_c = time.perf_counter() - t0
+
+    # Sparse product tick at idle: single-member groups (each elects
+    # itself, no peers -> no traffic after settling), driven through the
+    # REAL tick_begin/tick_finish path. Reports the measured per-tick
+    # transfer bytes so "idle groups cost (almost) zero bytes" is a fact
+    # with a number: the upload is the touched-row bucket (empty when
+    # idle), the fetch is the fixed-capacity compacted buffer — the sparse
+    # bridge's floor — vs the dense (10+9N)*P*4-byte tensors.
+    es = RaftEngine(MemKV(), [0], 0, groups=P,
+                    params=step_params(timeout_min=3, timeout_max=8,
+                                       hb_ticks=16),
+                    sparse_io=True)
+    for _ in range(8):
+        es.tick()  # settle: every group elects itself
+    it2 = max(10, iters // 2)
+    up = fetch = 0
+    t0 = time.perf_counter()
+    for _ in range(it2):
+        h = es.tick_begin()
+        up += h["upload_bytes"]
+        fetch += h["fetch_bytes"]
+        es.tick_finish(h)
+    dt_s = time.perf_counter() - t0
+
     return {
         "P": P,
         "iters": iters,
         "ms_per_step": round(1000 * dt / iters, 2),
         "ms_per_step_compute_only": round(1000 * dt_c / iters, 2),
         "steps_per_sec": round(iters / dt, 2),
+        "sparse_idle_ms_per_tick": round(1000 * dt_s / it2, 2),
+        "sparse_idle_upload_bytes_per_tick": up // it2,
+        "sparse_idle_fetch_bytes_per_tick": fetch // it2,
+        "dense_upload_bytes_per_tick": int(in10.nbytes),
+        "dense_fetch_bytes_per_tick": int(np.prod(np.asarray(flat).shape)) * 4,
         "device": str(jax.devices()[0]),
     }
 

@@ -131,7 +131,18 @@ def main():
         rows.append(r)
         print(json.dumps(r))
 
-    top = rows[-1]
+    # Merge with existing rows by (devices, per_device) so the scaling
+    # table and the big-P execution proof can come from separate runs
+    # (the 1M row alone is ~1000 s/tick on this 1-core box).
+    merged = {(r["devices"], r["per_device"]): r for r in rows}
+    try:
+        with open("MULTICHIP_podsim.json") as f:
+            prev = json.load(f)
+        for r in prev.get("results", []):
+            merged.setdefault((r["devices"], r["per_device"]), r)
+    except (OSError, ValueError, KeyError, TypeError):
+        pass
+    allrows = [merged[k] for k in sorted(merged)]
     out = {
         "bench": "pod_sharded_simulation",
         "backend": "cpu-virtual-mesh (8 devices on 1 physical core; "
@@ -140,9 +151,18 @@ def main():
         "sharding": "shard_map over ('p','n') mesh, p-axis data parallel",
         "weak_scaling_note": "P/device held constant per row; on shared-"
                              "core virtual devices wall time grows with "
-                             "total P (no parallel hardware underneath)",
-        "max_P": top["P"],
-        "results": rows,
+                             "total P (no parallel hardware underneath). "
+                             "For scale: the real v5e chip steps 100k "
+                             "groups at ~2.6 ms/tick (BENCH_r02 390 "
+                             "ticks/s) = ~26 ns/group-tick, ~40,000x this "
+                             "box's ~1 ms/group-tick.",
+        "memory_wall": "~1.57 KB/group measured (state+inbox); 1M groups "
+                       "= ~1.6 GB total = ~26 MB/chip sharded over a "
+                       "v5e-64 — two orders of magnitude under the 16 GB "
+                       "HBM/chip budget; the (P,N,N) match/nxt progress "
+                       "bricks are the ~400 B/group share.",
+        "max_P": max(r["P"] for r in allrows),
+        "results": allrows,
     }
     with open("MULTICHIP_podsim.json", "w") as f:
         json.dump(out, f, indent=1)

@@ -1036,7 +1036,13 @@ class RaftEngine:
                 self.params, self.member, self._me_dev, self.state,
                 jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
             h = {"mode": "sparse", "flat": flat, "sv": sv_dev, "ov": ov_dev,
-                 "staged": staged, "k_out": self._k_out, "window": window}
+                 "staged": staged, "k_out": self._k_out, "window": window,
+                 # Transfer accounting (benchable without extra fetches:
+                 # shapes are known host-side). Upload = the bucketed
+                 # touched-row scatter; fetch = the compacted flat buffer.
+                 "upload_bytes": int(np.asarray(idx).nbytes
+                                     + np.asarray(vals).nbytes),
+                 "fetch_bytes": int(np.prod(flat.shape)) * 4}
         else:
             in10, staged, deferred, deferred_b = self._build_inbox()
             for g, lst in self._proposals.items():
@@ -1049,7 +1055,9 @@ class RaftEngine:
                 self.params, self.member, self._me_dev, self.state, in10,
                 jnp.asarray(pf))
             h = {"mode": "dense", "flat": flat, "staged": staged,
-                 "window": window}
+                 "window": window,
+                 "upload_bytes": int(in10.nbytes),
+                 "fetch_bytes": int(np.prod(flat.shape)) * 4}
         self.state = new_state
         self._pending_msgs = deferred
         self._pending_batches = deferred_b
@@ -1081,6 +1089,10 @@ class RaftEngine:
                 # just a bigger transfer — and grow the bucket.
                 sv = np.asarray(h["sv"]).astype(np.int64, copy=False)
                 ov = np.asarray(h["ov"])
+                # Transfer accounting must cover the fallback fetch too —
+                # it is exactly the worst-case transfer the sparse floor
+                # numbers would otherwise hide.
+                h["fetch_bytes"] += sv.nbytes + ov.nbytes
                 dense = True
                 while self._k_out < min(self.P, total):
                     self._k_out = min(self.P, self._k_out * 8)

@@ -49,10 +49,18 @@ class SnapFsm:
 
 
 class Chaos:
-    """One chaotic cluster run with deterministic randomness."""
+    """One chaotic cluster run with deterministic randomness.
 
-    def __init__(self, seed: int):
+    ``window``/``params`` let the windowed-dispatch suite
+    (tests/test_window.py) reuse this harness instead of growing a second
+    fault model: live engines then step ``suggest_window(window)`` fused
+    ticks per dispatch (params must allow it — the window clamps to
+    hb_ticks)."""
+
+    def __init__(self, seed: int, window: int = 1, params=PARAMS):
         self.rng = random.Random(seed)
+        self.window = window
+        self.params = params
         self.ids = [1, 2, 3]
         self.kvs = [MemKV() for _ in range(N_NODES)]
         # One FSM per (node, group): apply order is only defined per group.
@@ -72,7 +80,7 @@ class Chaos:
         return RaftEngine(
             self.kvs[i], self.ids, self.ids[i], groups=GROUPS,
             fsms={g: self.fsms[i][g] for g in range(GROUPS)},
-            params=PARAMS, base_seed=100 + i,
+            params=self.params, base_seed=100 + i,
             snapshot_threshold=6,
         )
 
@@ -128,7 +136,7 @@ class Chaos:
         for i, e in enumerate(self.engines):
             if i in self.down:
                 continue
-            res = e.tick()
+            res = e.tick(window=e.suggest_window(self.window))
             for m in expand_outbound(res.outbound):
                 for _ in range(2 if self.rng.random() < 0.05 else 1):  # dup
                     r = self.rng.random()

@@ -1,7 +1,7 @@
 """Sparse packed-IO differential: the compacted host<->device bridge must
 be behaviorally identical to the dense one.
 
-The sparse contract (engine `_sparse_step_fn` / `_build_inbox_sparse`)
+The sparse contract (engine `_sparse_window_fn` / `_build_inbox_sparse`)
 uploads only touched inbox rows and fetches only changed rows, compacted
 on device with a fixed capacity and a dense fallback on overflow. These
 tests drive two identical in-process clusters — one dense, one sparse —
@@ -116,7 +116,7 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
             # Settle until the partition's CONSENSUS GROUP has elected (the
             # metadata leader falls back to the static assignment before
             # the group's first election — that is not stability yet).
-            for _ in range(300):
+            for _ in range(600):
                 p = mgr.nodes[0].store.get_partition("ka", 0)
                 if (p is not None and p.group >= 1
                         and any(n.raft.engine.is_leader(p.group)
@@ -133,7 +133,7 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
             # group's term — a follower that did not grant the winning
             # vote adopts the new term on the first post-election AE, a
             # tick or two after is_leader flips.
-            for _ in range(100):
+            for _ in range(240):
                 terms0 = [[int(n.raft.engine._h_term[gg]) for gg in (0, g)]
                           for n in mgr.nodes]
                 if terms0[0] == terms0[1] == terms0[2]:

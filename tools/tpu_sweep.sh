@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-shot TPU evidence sweep, priority-ordered so a short-lived healthy
+# tunnel window still lands the most important artifacts first:
+#   1. bench.py          — the headline (the driver's own metric)
+#   2. bench_churn.py    — election convergence (BENCH_churn.json)
+#   3. bench_engine.py --kernel — packed-step floor + sparse transfer bytes
+#   4. bench_engine.py --window — windowed product path at P=100k
+# Each step has its own timeout and the sweep continues on failure (the
+# bench guards already emit structured records).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+log() { echo "== $(date +%H:%M:%S) $*"; }
+
+log "probe"
+if ! timeout 60 python -c "import jax; print(jax.devices())"; then
+    log "tunnel not answering; aborting sweep"
+    exit 1
+fi
+
+log "1/4 headline"
+timeout 900 python bench.py | tail -1 | tee /tmp/tpu_headline.json
+
+log "2/4 churn"
+timeout 1200 python bench_churn.py | tail -1
+
+log "3/4 engine kernel (+ sparse transfer bytes)"
+timeout 1800 python bench_engine.py --kernel --sizes 1000,10000,100000
+
+log "4/4 windowed engine at P=100k"
+timeout 1800 python bench_engine.py --sizes 100000 --ticks 60 --warmup 40 --window 8
+
+log "sweep complete"
