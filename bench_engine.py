@@ -200,8 +200,10 @@ def bench_kernel(P: int, iters: int) -> dict:
     for _ in range(it2):
         h = es.tick_begin()
         up += h["upload_bytes"]
-        fetch += h["fetch_bytes"]
         es.tick_finish(h)
+        # Read AFTER tick_finish: a compaction overflow adds its dense
+        # fallback fetch to h["fetch_bytes"] there.
+        fetch += h["fetch_bytes"]
     dt_s = time.perf_counter() - t0
 
     return {

@@ -125,15 +125,18 @@ def main():
     ap.add_argument("--warmup", type=int, default=15)
     args = ap.parse_args()
 
-    rows = []
     for d in (int(x) for x in args.devices.split(",")):
         r = bench_row(args.per_device, d, args.ticks, args.warmup)
-        rows.append(r)
-        print(json.dumps(r))
+        print(json.dumps(r), flush=True)
+        # Persist after EVERY row, merging with existing rows by
+        # (devices, per_device): rows take tens of minutes each on this
+        # box, and a deadline/crash mid-table must not discard the
+        # measured ones (it did, once — the run_guarded re-exec restarted
+        # a 3-row table from scratch).
+        _write_artifact([r])
 
-    # Merge with existing rows by (devices, per_device) so the scaling
-    # table and the big-P execution proof can come from separate runs
-    # (the 1M row alone is ~1000 s/tick on this 1-core box).
+
+def _write_artifact(rows):
     merged = {(r["devices"], r["per_device"]): r for r in rows}
     try:
         with open("MULTICHIP_podsim.json") as f:
@@ -164,12 +167,20 @@ def main():
         "max_P": max(r["P"] for r in allrows),
         "results": allrows,
     }
-    with open("MULTICHIP_podsim.json", "w") as f:
+    # Atomic replace: a deadline/crash mid-dump must not truncate the file
+    # (a truncated artifact would make the next merge silently discard
+    # every previously measured row).
+    tmp = "MULTICHIP_podsim.json.tmp%d" % os.getpid()
+    with open(tmp, "w") as f:
         json.dump(out, f, indent=1)
+    os.replace(tmp, "MULTICHIP_podsim.json")
 
 
 if __name__ == "__main__":
     from bench_backend import run_guarded
 
+    # The deadline covers the WHOLE invocation; a 4-row table is ~2h on
+    # this box and each row persists on completion, so the guard is only
+    # against a truly hung backend.
     run_guarded(main, metric="pod_sharded_simulation", unit="ticks/s",
-                deadline_s=3000)
+                deadline_s=14400)

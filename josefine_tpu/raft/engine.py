@@ -1087,12 +1087,14 @@ class RaftEngine:
                 # Compaction overflow (burst bigger than capacity):
                 # materialize the dense device-resident outputs — correct,
                 # just a bigger transfer — and grow the bucket.
-                sv = np.asarray(h["sv"]).astype(np.int64, copy=False)
-                ov = np.asarray(h["ov"])
+                sv32 = np.asarray(h["sv"])
                 # Transfer accounting must cover the fallback fetch too —
                 # it is exactly the worst-case transfer the sparse floor
-                # numbers would otherwise hide.
-                h["fetch_bytes"] += sv.nbytes + ov.nbytes
+                # numbers would otherwise hide. Counted at the int32 wire
+                # width, BEFORE the int64 host cast below.
+                sv = sv32.astype(np.int64, copy=False)
+                ov = np.asarray(h["ov"])
+                h["fetch_bytes"] += sv32.nbytes + ov.nbytes
                 dense = True
                 while self._k_out < min(self.P, total):
                     self._k_out = min(self.P, self._k_out * 8)
