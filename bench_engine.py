@@ -257,24 +257,27 @@ async def main():
     # device-measured rows (the merge below only keeps same-device rows).
     if jax.default_backend() == "cpu":
         out_path = out_path.replace(".json", "_cpu.json")
-    # Merge by P with any existing same-device results so a partial-size
-    # rerun never silently drops rows the README cites.
+    # Merge by (P, window) with any existing same-device results so a
+    # partial-size rerun never silently drops rows the README cites, and
+    # window-1 and window-K rows of the same size coexist (they are
+    # different measurements, not reruns of each other).
     device = str(jax.devices()[0])
     for r in results:
         r["backend"] = _BACKEND
-    merged = {r["P"]: r for r in results}
+    merged = {(r["P"], r.get("window")): r for r in results}
     try:
         with open(out_path) as f:
             prev = json.load(f)
         for r in prev.get("results", []):
             # Same-device rows only (older files carried device per row).
             if prev.get("device", r.get("device")) == device and "P" in r:
-                merged.setdefault(r["P"], r)
+                merged.setdefault((r["P"], r.get("window")), r)
     except (OSError, ValueError, AttributeError, KeyError, TypeError):
         pass
+    keys = sorted(merged, key=lambda k: (k[0], k[1] or 0))
     with open(out_path, "w") as f:
         json.dump({"bench": name, "device": device,
-                   "results": [merged[p] for p in sorted(merged)]},
+                   "results": [merged[k] for k in keys]},
                   f, indent=1)
 
 

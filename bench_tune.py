@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Headline operating-point sweep: find (tile, window_ticks) that maximises
+accepted AppendEntries/s for the fused Pallas engine at the headline shape
+(P=100k x N=5, the BASELINE.md config bench.py reports).
+
+Round 2 picked tile=128 x 500-tick windows by hand; this sweep measures the
+neighbourhood (tile 64-512, windows 500-2000) and re-measures the winner
+with bench.py's exact protocol (2 dependent reps) so the result is directly
+comparable to BENCH_headline.json. Stage 1 sweeps window length at tile=128;
+stage 2 sweeps tile width at the stage-1 winner — 6 compiles instead of 12
+(each (tile, ticks) pair is a distinct XLA program; remote compiles on the
+tunneled chip cost tens of seconds).
+
+Only meaningful on the real chip (a CPU sweep would tune the wrong machine):
+on CPU fallback it emits a labeled skip record and exits. Writes
+BENCH_tune.json; prints one JSON line per point plus a final summary line.
+"""
+
+import json
+import time
+
+from bench_backend import configure_jax, ensure_backend
+
+_BACKEND = ensure_backend()
+
+import jax
+
+configure_jax()
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import step_params
+
+P = 100_000
+N = 5
+PROPOSALS_PER_TICK = 4
+
+
+def measure(tile: int, ticks: int, reps: int) -> dict:
+    from josefine_tpu.ops.pallas_step import run_ticks_fused
+
+    params = step_params(timeout_min=5, timeout_max=10, hb_ticks=1,
+                         auto_proposals=PROPOSALS_PER_TICK)
+    state, member = cr.init_state(P, N, base_seed=0, params=params)
+    inbox = cr.empty_inbox(P, N)
+    proposals = jnp.zeros((P, N), jnp.int32)
+
+    t0 = time.perf_counter()
+    state, inbox, _ = run_ticks_fused(params, member, state, inbox, proposals,
+                                      ticks, tile=tile)
+    compile_s = time.perf_counter() - t0
+
+    msgs = 0
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, inbox, tot = run_ticks_fused(params, member, state, inbox,
+                                            proposals, ticks, tile=tile)
+        msgs += tot["accepted_msgs"]
+    dt = time.perf_counter() - t0
+    return {
+        "tile": tile,
+        "window_ticks": ticks,
+        "reps": reps,
+        "accepted_msgs_per_sec": round(msgs / dt, 1),
+        "ticks_per_sec": round(ticks * reps / dt, 1),
+        "wall_s": round(dt, 3),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main() -> None:
+    dev = str(jax.devices()[0])
+    if jax.default_backend() == "cpu":
+        print(json.dumps({"metric": "headline_tune", "value": 0,
+                          "unit": "msgs/s", "vs_baseline": 0,
+                          "extra": {"skipped": "cpu backend — sweep only "
+                                    "meaningful on the real chip",
+                                    "device": dev, "backend": _BACKEND}}))
+        return
+
+    rows = []
+
+    def point(tile, ticks, reps=1):
+        r = measure(tile, ticks, reps)
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+        return r
+
+    # Stage 1: window length at the r2 tile.
+    s1 = [point(128, t) for t in (500, 1000, 2000)]
+    best_ticks = max(s1, key=lambda r: r["accepted_msgs_per_sec"])["window_ticks"]
+    # Stage 2: tile width at the winning window.
+    s2 = [point(t, best_ticks) for t in (64, 256, 512)]
+    best = max(rows, key=lambda r: r["accepted_msgs_per_sec"])
+    # Final: winner under bench.py's protocol (2 dependent reps).
+    final = point(best["tile"], best["window_ticks"], reps=2)
+
+    out = {
+        "metric": "headline_tuned",
+        "value": final["accepted_msgs_per_sec"],
+        "unit": "msgs/s",
+        "vs_baseline": round(final["accepted_msgs_per_sec"] / 1e6, 3),
+        "extra": {"best_tile": best["tile"],
+                  "best_window_ticks": best["window_ticks"],
+                  "partitions": P, "nodes_per_partition": N,
+                  "device": dev, "backend": _BACKEND},
+    }
+    print(json.dumps(out))
+    with open("BENCH_tune.json", "w") as f:
+        json.dump({"bench": "headline_tune", "device": dev,
+                   "summary": out, "points": rows}, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -756,6 +756,11 @@ class RaftEngine:
         self._pending_msgs: list[rpc.WireMsg] = []
         self._pending_batches: list[rpc.MsgBatch] = []
         self._proposals: dict[int, list[tuple[bytes, asyncio.Future | None]]] = {}
+        # Groups with a non-empty proposal queue. Kept in lockstep with
+        # _proposals (propose() adds; tick_finish/_recycle remove) so the
+        # per-tick builders touch only pending groups instead of scanning a
+        # dict that grows toward P keys over a process's lifetime.
+        self._prop_groups: set[int] = set()
         # Conf-change bookkeeping: block-id-keyed commit waiters, the
         # single-in-flight guard (leader side), and conf notifications
         # produced outside tick() (snapshot install) for the next TickResult.
@@ -925,6 +930,7 @@ class RaftEngine:
             fut.set_exception(ValueError("conf changes must go through group 0"))
             return fut
         self._proposals.setdefault(group, []).append((payload, fut))
+        self._prop_groups.add(group)
         return fut
 
     def propose_conf(self, change: ConfChange) -> asyncio.Future:
@@ -1045,8 +1051,8 @@ class RaftEngine:
                  "fetch_bytes": int(np.prod(flat.shape)) * 4}
         else:
             in10, staged, deferred, deferred_b = self._build_inbox()
-            for g, lst in self._proposals.items():
-                in10[9, g, 0] = len(lst)
+            for g in self._prop_groups:
+                in10[9, g, 0] = len(self._proposals[g])
             self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
             step = (functools.partial(_py_packed_window, ticks=window)
                     if self._backend == "python"
@@ -1122,9 +1128,8 @@ class RaftEngine:
             active |= n_leader != self._h_leader
             active |= (n_term != self._h_term) | (n_voted != self._h_voted)
             active |= (ov[0] != rpc.MSG_NONE).any(axis=1)  # outbox traffic
-            for g, lst in self._proposals.items():
-                if lst:
-                    active[g] = True
+            if self._prop_groups:
+                active[list(self._prop_groups)] = True
             proc = np.nonzero(active)[0].astype(np.int64)
             v = sv[:, proc]
             ov_c = ov[:, proc, :]
@@ -1133,9 +1138,7 @@ class RaftEngine:
             # left unchanged (no mint — we are not their leader) are
             # appended with mirror values so their futures still fail fast.
             fetched = set(rows_g.tolist())
-            extra = np.asarray(
-                [g for g, lst in self._proposals.items()
-                 if lst and g not in fetched], np.int64)
+            extra = np.asarray(sorted(self._prop_groups - fetched), np.int64)
             v = buf[:, :10].astype(np.int64).T           # (10, R)
             ov_c = buf[:, 10:].reshape(total, 9, self.N).transpose(1, 0, 2)
             proc = rows_g
@@ -1159,9 +1162,9 @@ class RaftEngine:
          n_head_t, n_head_s, n_commit_t, n_commit_s, minted, became) = v
         head_new = (n_head_t << 32) | n_head_s
         commit_new = (n_commit_t << 32) | n_commit_s
-        pos_of = {int(g): i for i, g in enumerate(proc)}
 
         if self._parole:
+            pos_of = {int(g): i for i, g in enumerate(proc)}
             # Lift parole once legitimate replication has carried the head
             # back past the pre-reset ack watermark: from here on the node's
             # chain again contains everything it ever acknowledged, so its
@@ -1176,7 +1179,22 @@ class RaftEngine:
 
         res = TickResult()
         reset_rows: set[int] = set()
-        for pos in range(len(proc)):
+        # Host work is only needed where host-visible state moved. In steady
+        # state most fetched rows are outbox-only (staggered heartbeats /
+        # replies): the device compaction (or the dense active predicate)
+        # selects them for _decode_outbox, but their chain, proposal queue,
+        # and leadership are untouched — skipping them here keeps the Python
+        # loop O(changed rows), not O(fetched rows). term/voted-only rows
+        # are handled by the vectorized vol_changed pass below; all mirror
+        # adoption stays vectorized over the full proc set.
+        need = ((became != 0) | (minted != 0)
+                | (head_new != self._h_head[proc])
+                | (commit_new != self._h_commit[proc])
+                | ((self._h_role[proc] == LEADER) & (n_role != LEADER)))
+        if self._prop_groups:
+            need |= np.isin(proc, np.fromiter(
+                self._prop_groups, np.int64, len(self._prop_groups)))
+        for pos in np.nonzero(need)[0].tolist():
             g = int(proc[pos])
             if g in self._recycled_this_tick:
                 # Recycled by a group-0 commit hook earlier in THIS loop
@@ -1245,12 +1263,14 @@ class RaftEngine:
                             drv.notify(blk.id, fut)
                         else:
                             fut.set_result(b"")
-                self._proposals[g] = []
+                del self._proposals[g]
+                self._prop_groups.discard(g)
             elif queue:
                 for _, fut in queue:
                     if fut is not None and not fut.done():
                         fut.set_exception(NotLeader(g, int(n_leader[pos])))
-                self._proposals[g] = []
+                del self._proposals[g]
+                self._prop_groups.discard(g)
 
             # Accepted spans (follower): reconcile the chain to the device's
             # new head by walking parent pointers through the staged blocks.
@@ -1551,6 +1571,7 @@ class RaftEngine:
         self._lift_parole(g)
         self._h_last_seen[g] = 0
         self._proposals.pop(g, None)
+        self._prop_groups.discard(g)
         # Already-admitted intake for the old incarnation (the receive-time
         # filter passed it against the OLD local incarnation) must not reach
         # the device next tick.
@@ -2304,7 +2325,7 @@ class RaftEngine:
         if self._pending_msgs:
             parts.append(np.fromiter((m.group for m in self._pending_msgs),
                                      np.int64, len(self._pending_msgs)))
-        prop_groups = [g for g, lst in self._proposals.items() if lst]
+        prop_groups = list(self._prop_groups)
         if prop_groups:
             parts.append(np.asarray(prop_groups, np.int64))
         G = (np.unique(np.concatenate(parts)) if parts
