@@ -264,17 +264,20 @@ async def main():
     device = str(jax.devices()[0])
     for r in results:
         r["backend"] = _BACKEND
-    merged = {(r["P"], r.get("window")): r for r in results}
+    # Legacy rows lacking a window key are single-tick measurements —
+    # normalize to window 1 so a rerun replaces them instead of leaving a
+    # stale twin row beside the fresh one.
+    merged = {(r["P"], r.get("window") or 1): r for r in results}
     try:
         with open(out_path) as f:
             prev = json.load(f)
         for r in prev.get("results", []):
             # Same-device rows only (older files carried device per row).
             if prev.get("device", r.get("device")) == device and "P" in r:
-                merged.setdefault((r["P"], r.get("window")), r)
+                merged.setdefault((r["P"], r.get("window") or 1), r)
     except (OSError, ValueError, AttributeError, KeyError, TypeError):
         pass
-    keys = sorted(merged, key=lambda k: (k[0], k[1] or 0))
+    keys = sorted(merged)
     with open(out_path, "w") as f:
         json.dump({"bench": name, "device": device,
                    "results": [merged[k] for k in keys]},

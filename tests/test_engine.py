@@ -351,3 +351,49 @@ def test_live_isr_from_match_pointers():
                 f.exception()
 
     asyncio.run(main())
+
+
+def test_pending_proposal_set_tracks_queue_dict():
+    """_prop_groups is the per-tick fast path for pending proposals (round
+    4: the builders stopped scanning _proposals, which grows toward P keys
+    over a process lifetime) — it must track the dict exactly through
+    commit, NotLeader rejection, and group recycling."""
+
+    def check(e):
+        assert e._prop_groups == {g for g, q in e._proposals.items() if q}, (
+            e._prop_groups, {g: len(q) for g, q in e._proposals.items()})
+
+    async def main():
+        engines, _, _ = make_cluster(3, groups=3)
+        lead = wait_leader(engines)
+        follower = next(i for i in range(3) if i != lead)
+
+        # Queued on both a leader and a follower -> both sets populated.
+        f_ok = engines[lead].propose(1, b"yes")
+        f_no = engines[follower].propose(2, b"routed-away")
+        for e in engines:
+            check(e)
+        assert 1 in engines[lead]._prop_groups
+        assert 2 in engines[follower]._prop_groups
+
+        run_ticks(engines, 12)
+        # Mint (leader) and NotLeader rejection (follower) both drain the
+        # set with the queue.
+        assert f_ok.done() and not f_ok.exception()
+        assert f_no.done() and isinstance(f_no.exception(), NotLeader)
+        for e in engines:
+            check(e)
+            assert not e._prop_groups
+
+        # A queue refilled then recycled is dropped from both structures.
+        fut = engines[lead].propose(1, b"orphan")
+        engines[lead].recycle_group(1)
+        for e in engines:
+            check(e)
+        run_ticks(engines, 8)
+        for e in engines:
+            check(e)
+        if fut.done() and not fut.cancelled():
+            fut.exception()
+
+    asyncio.run(main())

@@ -165,6 +165,8 @@ def main() -> int:
             say(f"tunnel LIVE — step: {name} (pending: {[s[0] for s in pending]})")
             go()
             if done():
+                # Chain straight into the next step — grants are scarce
+                # and die without warning; no sleep while one is live.
                 say(f"  step {name} LANDED")
             else:
                 fails[name] = fails.get(name, 0) + 1
@@ -172,9 +174,9 @@ def main() -> int:
                 time.sleep(min(600, 30 * fails[name]))
         else:
             say(f"tunnel down (pending: {[s[0] for s in pending]})")
+            time.sleep(60)
         if once:
-            return 1
-        time.sleep(60)
+            return 0 if not [s for s in STEPS if not s[1]()] else 1
 
 
 if __name__ == "__main__":
