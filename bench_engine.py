@@ -274,7 +274,8 @@ async def main():
         for r in prev.get("results", []):
             # Same-device rows only (older files carried device per row).
             if prev.get("device", r.get("device")) == device and "P" in r:
-                merged.setdefault((r["P"], r.get("window") or 1), r)
+                r.setdefault("window", 1)  # stamp legacy rows: see merge key
+                merged.setdefault((r["P"], r["window"]), r)
     except (OSError, ValueError, AttributeError, KeyError, TypeError):
         pass
     keys = sorted(merged)

@@ -48,6 +48,31 @@ class SnapFsm:
         self.applied = [x.encode() for x in json.loads(data)] if data else []
 
 
+def check_linearizable(c, g: int, applied: list) -> None:
+    """Client-visible linearizability for the log FSM. Payloads are unique,
+    every write goes through Raft commit, and the applied sequence IS the
+    serialization — so linearizability reduces to (1) every acked payload
+    applied exactly once, and (2) real-time precedence: a payload acked
+    before another was even *submitted* must precede it in the applied
+    order. Tick bounds are conservative (the recorded ack tick is the
+    harvest tick, >= the true completion), so every pair this compares is a
+    genuine happened-before — no false positives under reordering."""
+    idx: dict[bytes, list[int]] = {}
+    for i, p in enumerate(applied):
+        idx.setdefault(p, []).append(i)
+    for p in c.acked[g]:
+        assert len(idx.get(p, ())) == 1, (
+            f"acked payload {p!r} applied {len(idx.get(p, ()))}x (group {g})")
+    acked = c.acked[g]
+    for a in acked:
+        for b in acked:
+            if c.ack_tick[a] < c.submit_tick[b]:
+                assert idx[a][0] < idx[b][0], (
+                    f"real-time order violated (group {g}): {a!r} acked at "
+                    f"tick {c.ack_tick[a]}, before {b!r} was submitted at "
+                    f"tick {c.submit_tick[b]}, yet applies later")
+
+
 class Chaos:
     """One chaotic cluster run with deterministic randomness.
 
@@ -74,6 +99,8 @@ class Chaos:
         self.acked: dict[int, list[bytes]] = {g: [] for g in range(GROUPS)}
         self.pending: list[tuple[int, bytes, asyncio.Future]] = []
         self.proposed = 0
+        self.submit_tick: dict[bytes, int] = {}
+        self.ack_tick: dict[bytes, int] = {}
 
     def _make(self, i: int) -> RaftEngine:
         self.fsms[i] = [SnapFsm() for _ in range(GROUPS)]
@@ -164,6 +191,7 @@ class Chaos:
             if i not in self.down and e.is_leader(g):
                 payload = b"p%d" % self.proposed
                 self.proposed += 1
+                self.submit_tick[payload] = self.tick_no
                 self.pending.append((g, payload, e.propose(g, payload)))
                 return
 
@@ -173,6 +201,7 @@ class Chaos:
             if fut.done():
                 if not fut.cancelled() and fut.exception() is None:
                     self.acked[g].append(payload)
+                    self.ack_tick[payload] = self.tick_no
             else:
                 still.append((g, payload, fut))
         self.pending = still
@@ -203,6 +232,8 @@ class MemberChaos:
         self.acked: dict[int, list[bytes]] = {g: [] for g in range(GROUPS)}
         self.pending: list[tuple[int, bytes, asyncio.Future]] = []
         self.proposed = 0
+        self.submit_tick: dict[bytes, int] = {}
+        self.ack_tick: dict[bytes, int] = {}
         self.conf_fut: asyncio.Future | None = None
         self.adds_committed = 0
         self.removes_committed = 0
@@ -364,6 +395,7 @@ class MemberChaos:
             if e.is_leader(g):
                 payload = b"m%d" % self.proposed
                 self.proposed += 1
+                self.submit_tick[payload] = self.tick_no
                 self.pending.append((g, payload, e.propose(g, payload)))
                 return
 
@@ -373,6 +405,7 @@ class MemberChaos:
             if fut.done():
                 if not fut.cancelled() and fut.exception() is None:
                     self.acked[g].append(payload)
+                    self.ack_tick[payload] = self.tick_no
             else:
                 still.append((g, payload, fut))
         self.pending = still
@@ -438,6 +471,7 @@ def test_chaos_with_membership_churn(seed):
                 assert payload in applied, (
                     f"acked payload {payload!r} lost after chaos (group {g})")
                 total_acked += 1
+            check_linearizable(c, g, logs[0])
         assert total_acked >= 5, f"only {total_acked} acked — chaos too hostile"
 
     asyncio.run(main())
@@ -494,6 +528,8 @@ def test_chaos_safety_and_convergence(seed):
                     f"acked payload {payload!r} lost after chaos (group {g})"
                 )
                 total_acked += 1
+            # Linearizability: exactly-once + real-time precedence.
+            check_linearizable(c, g, logs[0])
         # The run must have actually exercised the write path.
         assert total_acked >= 5, f"only {total_acked} acked proposals — chaos too hostile"
 

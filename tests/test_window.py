@@ -173,7 +173,7 @@ def test_windowed_chaos_crash_restart_safety():
     safety argument carries: election safety and FSM log-matching are
     checked every round, acked writes must survive, and the cluster must
     re-converge after healing."""
-    from test_chaos import GROUPS, N_NODES, Chaos
+    from test_chaos import GROUPS, N_NODES, Chaos, check_linearizable
 
     async def main():
         c = Chaos(11, window=4,
@@ -219,6 +219,10 @@ def test_windowed_chaos_crash_restart_safety():
             assert logs[0] == logs[1] == logs[2], f"g={g} FSM logs diverge"
             for payload in c.acked[g]:
                 assert payload in logs[0], f"g={g} lost acked {payload!r}"
+            # Exactly-once + real-time precedence must survive windowed
+            # dispatch too (ack ticks quantize to window boundaries, which
+            # only widens the conservative happened-before bound).
+            check_linearizable(c, g, logs[0])
         c.check_log_matching()
 
     asyncio.run(main())

@@ -174,7 +174,8 @@ def main() -> int:
                 time.sleep(min(600, 30 * fails[name]))
         else:
             say(f"tunnel down (pending: {[s[0] for s in pending]})")
-            time.sleep(60)
+            if not once:
+                time.sleep(60)
         if once:
             return 0 if not [s for s in STEPS if not s[1]()] else 1
 
