@@ -44,10 +44,16 @@ def probe(timeout_s: int = 150) -> bool:
 
 def run(cmd: list[str], timeout_s: int) -> bool:
     say("run: " + " ".join(cmd))
+    # Give the bench's own SIGALRM guard (run_guarded, default 600s) room
+    # to match this step's budget — otherwise a long multi-size run gets
+    # killed by its inner deadline and re-execs to a CPU fallback that
+    # can't land the device artifact.
+    env = {**os.environ,
+           "JOSEFINE_BENCH_DEADLINE": str(max(540, timeout_s - 120))}
     try:
         with open(LOG, "a") as f:
             r = subprocess.run(cmd, stdout=f, stderr=f, timeout=timeout_s,
-                               cwd=REPO, env={**os.environ})
+                               cwd=REPO, env=env)
         return r.returncode == 0
     except subprocess.TimeoutExpired:
         say("  TIMEOUT")
