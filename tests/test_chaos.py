@@ -101,6 +101,12 @@ class Chaos:
         self.proposed = 0
         self.submit_tick: dict[bytes, int] = {}
         self.ack_tick: dict[bytes, int] = {}
+        # Directed link partitions: (src, dst) -> heal tick. One-way loss
+        # (A->B dead while B->A delivers) exercises failure shapes random
+        # per-message drops don't sustain: a leader that can broadcast but
+        # never hear acks, a follower that hears heartbeats but whose votes
+        # vanish. Raft must stay safe under arbitrary asymmetric loss.
+        self.blocked: dict[tuple[int, int], int] = {}
 
     def _make(self, i: int) -> RaftEngine:
         self.fsms[i] = [SnapFsm() for _ in range(GROUPS)]
@@ -150,6 +156,18 @@ class Chaos:
             self.down.add(i)
             self.down_until[i] = self.tick_no + self.rng.randint(10, 40)
 
+        # Directed link partitions: heal expired ones, maybe install a new
+        # one (at most one at a time, and never while a node is down —
+        # keep some quorum path alive so the run stays live enough to
+        # exercise the write path).
+        for link, until in list(self.blocked.items()):
+            if until <= self.tick_no:
+                del self.blocked[link]
+        if not self.blocked and not self.down and self.rng.random() < 0.015:
+            src = self.rng.randrange(N_NODES)
+            dst = self.rng.choice([j for j in range(N_NODES) if j != src])
+            self.blocked[(src, dst)] = self.tick_no + self.rng.randint(15, 40)
+
         # Deliver matured delayed messages.
         still = []
         for when, dst, m in self.delayed:
@@ -165,6 +183,8 @@ class Chaos:
                 continue
             res = e.tick(window=e.suggest_window(self.window))
             for m in expand_outbound(res.outbound):
+                if (i, m.dst) in self.blocked:
+                    continue  # one-way partition: src -> dst is dead
                 for _ in range(2 if self.rng.random() < 0.05 else 1):  # dup
                     r = self.rng.random()
                     if r < 0.10:
