@@ -739,9 +739,16 @@ class RaftEngine:
         # large P, where dense per-tick transfers are megabytes of zeros.
         self._sparse = (groups > 4096) if sparse_io is None else bool(sparse_io)
         self._backend = backend
-        # Adaptive outbox-compaction capacity: grows on overflow (each size
-        # is its own compiled variant; growth is monotone and bounded by P).
+        # Adaptive outbox-compaction capacity: grows on overflow and shrinks
+        # again after a long quiet run (each size is its own compiled
+        # variant, cached by jit, so resizing costs at most one compile per
+        # level). The fetch each tick is the FULL capacity buffer — without
+        # shrink, one cold-start election burst at P=100k leaves every
+        # subsequent idle tick fetching a burst-sized (~MBs) buffer over
+        # the device link forever (measured 2.6 MB/tick idle; ~300 KB at
+        # the floor capacity).
         self._k_out = min(4096, groups)
+        self._k_out_quiet = 0  # consecutive ticks with total << capacity
         # Per-src transport liveness: tick of the last frame (of any kind,
         # including MSG_PING) received from each slot. Drives peer_fresh —
         # the aggregate keepalive that lets leaders stagger per-group
@@ -1104,12 +1111,37 @@ class RaftEngine:
                 dense = True
                 while self._k_out < min(self.P, total):
                     self._k_out = min(self.P, self._k_out * 8)
+                self._k_out_quiet = 0
                 log.info("sparse outbox overflow (%d > %d); capacity now %d",
                          total, k_out, self._k_out)
             else:
                 rows_g = flat[1:1 + k_out][:total].astype(np.int64)
                 buf = flat[1 + k_out:].reshape(k_out, C)[:total]
                 dense = False
+                # Shrink hysteresis: 64 consecutive ticks fitting the next
+                # bucket down (with 2x headroom) drop one level. A burst
+                # right after just regrows via the overflow fallback. The
+                # target is computed by walking the SAME growth ladder
+                # (min(P, 4096*8^i)) so shrink/regrow cycles only ever
+                # revisit already-compiled program sizes — k_out // 8 from
+                # a P-clamped value would mint novel sizes, each a full
+                # XLA compile retained forever by the jit cache.
+                floor = min(4096, self.P)
+                if k_out > floor:
+                    target = floor
+                    while min(self.P, target * 8) < k_out:
+                        target = min(self.P, target * 8)
+                    if total * 2 <= target:
+                        self._k_out_quiet += 1
+                        if self._k_out_quiet >= 64:
+                            self._k_out = target
+                            self._k_out_quiet = 0
+                            log.info("sparse outbox quiet; capacity %d -> %d",
+                                     k_out, self._k_out)
+                    else:
+                        self._k_out_quiet = 0
+                else:
+                    self._k_out_quiet = 0
 
         if dense:
             (n_term, n_voted, n_role, n_leader,
