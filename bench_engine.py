@@ -192,8 +192,11 @@ def bench_kernel(P: int, iters: int) -> dict:
                     params=step_params(timeout_min=3, timeout_max=8,
                                        hb_ticks=16),
                     sparse_io=True)
-    for _ in range(8):
-        es.tick()  # settle: every group elects itself
+    # Settle past the cold-start election burst AND the 64-tick shrink
+    # hysteresis, so the idle numbers reflect steady state (the compaction
+    # bucket has shrunk back down the ladder after the burst).
+    for _ in range(80):
+        es.tick()
     it2 = max(10, iters // 2)
     up = fetch = 0
     t0 = time.perf_counter()
@@ -215,6 +218,7 @@ def bench_kernel(P: int, iters: int) -> dict:
         "sparse_idle_ms_per_tick": round(1000 * dt_s / it2, 2),
         "sparse_idle_upload_bytes_per_tick": up // it2,
         "sparse_idle_fetch_bytes_per_tick": fetch // it2,
+        "sparse_idle_k_out": es._k_out,
         "dense_upload_bytes_per_tick": int(in10.nbytes),
         "dense_fetch_bytes_per_tick": int(np.prod(np.asarray(flat).shape)) * 4,
         "device": str(jax.devices()[0]),
