@@ -397,3 +397,48 @@ def test_pending_proposal_set_tracks_queue_dict():
             fut.exception()
 
     asyncio.run(main())
+
+
+def test_five_node_cluster_quorum_and_minority_crash():
+    """N=5 engine cluster (the kernel benches' node count, which the
+    engine suites otherwise never drive): quorum is 3, so TWO nodes can
+    crash and the cluster must keep committing; with three down it must
+    stall; healed, it converges."""
+    async def main():
+        engines, fsms, _ = make_cluster(5)
+        lead = wait_leader(engines)
+        fut = engines[lead].propose(0, b"five-alive")
+        run_ticks(engines, 12)
+        assert fut.done() and (await fut) == b"ok:five-alive"
+
+        # Crash two non-leaders: 3 of 5 survive — still a quorum.
+        downed = [i for i in range(5) if i != lead][:2]
+        lead2 = wait_leader(engines, down=downed)
+        fut = engines[lead2].propose(0, b"three-of-five")
+        run_ticks(engines, 16, down=downed)
+        assert fut.done() and not fut.exception()
+        assert (await fut) == b"ok:three-of-five"
+
+        # Third crash (not the leader): minority cannot commit.
+        downed3 = downed + [next(i for i in range(5)
+                                 if i != lead2 and i not in downed)]
+        run_ticks(engines, 5, down=downed3)
+        fut = engines[lead2].propose(0, b"stalled")
+        run_ticks(engines, 25, down=downed3)
+        assert not (fut.done() and not fut.cancelled()
+                    and fut.exception() is None and fut.result() == b"ok:stalled"), \
+            "minority committed a write"
+
+        # Heal: everyone back, chains converge, every acked write applied
+        # everywhere exactly once (the stalled write may commit now — the
+        # new leader's chain still holds it; that is Raft-legal).
+        run_ticks(engines, 60)
+        heads = {e.chains[0].head for e in engines}
+        assert len(heads) == 1
+        for fsm in fsms:
+            assert fsm.applied.count(b"five-alive") == 1
+            assert fsm.applied.count(b"three-of-five") == 1
+        logs = [tuple(f.applied) for f in fsms]
+        assert len(set(logs)) == 1, "FSM logs diverge after heal"
+
+    asyncio.run(main())
