@@ -82,21 +82,30 @@ class Chaos:
     ticks per dispatch (params must allow it — the window clamps to
     hb_ticks)."""
 
-    def __init__(self, seed: int, window: int = 1, params=PARAMS):
+    def __init__(self, seed: int, window: int = 1, params=PARAMS,
+                 groups: int | None = None, sparse: bool = False,
+                 k_out: int | None = None):
         self.rng = random.Random(seed)
         self.window = window
         self.params = params
+        self.G = GROUPS if groups is None else groups
+        # sparse/k_out force the sparse packed-IO bridge (auto only above
+        # 4096 groups) with a tiny compaction capacity, so chaos bursts
+        # exercise overflow growth, the dense fallback fetch, and the
+        # quiet-run shrink — under crashes, not just fault-free equality.
+        self.sparse = sparse
+        self.k_out = k_out
         self.ids = [1, 2, 3]
         self.kvs = [MemKV() for _ in range(N_NODES)]
         # One FSM per (node, group): apply order is only defined per group.
-        self.fsms = [[SnapFsm() for _ in range(GROUPS)] for _ in range(N_NODES)]
+        self.fsms = [[SnapFsm() for _ in range(self.G)] for _ in range(N_NODES)]
         self.engines = [self._make(i) for i in range(N_NODES)]
         self.down: set[int] = set()
         self.down_until: dict[int, int] = {}
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.tick_no = 0
         self.leaders_by_term: dict[tuple[int, int], int] = {}  # (g, term) -> node
-        self.acked: dict[int, list[bytes]] = {g: [] for g in range(GROUPS)}
+        self.acked: dict[int, list[bytes]] = {g: [] for g in range(self.G)}
         self.pending: list[tuple[int, bytes, asyncio.Future]] = []
         self.proposed = 0
         self.submit_tick: dict[bytes, int] = {}
@@ -109,13 +118,17 @@ class Chaos:
         self.blocked: dict[tuple[int, int], int] = {}
 
     def _make(self, i: int) -> RaftEngine:
-        self.fsms[i] = [SnapFsm() for _ in range(GROUPS)]
-        return RaftEngine(
-            self.kvs[i], self.ids, self.ids[i], groups=GROUPS,
-            fsms={g: self.fsms[i][g] for g in range(GROUPS)},
+        self.fsms[i] = [SnapFsm() for _ in range(self.G)]
+        e = RaftEngine(
+            self.kvs[i], self.ids, self.ids[i], groups=self.G,
+            fsms={g: self.fsms[i][g] for g in range(self.G)},
             params=self.params, base_seed=100 + i,
             snapshot_threshold=6,
+            sparse_io=True if self.sparse else None,
         )
+        if self.k_out is not None:
+            e._k_out = self.k_out
+        return e
 
     # ----------------------------------------------------------- invariants
 
@@ -123,7 +136,7 @@ class Chaos:
         for i, e in enumerate(self.engines):
             if i in self.down:
                 continue
-            for g in range(GROUPS):
+            for g in range(self.G):
                 if e.is_leader(g):
                     key = (g, e.term(g))
                     prev = self.leaders_by_term.setdefault(key, i)
@@ -133,7 +146,7 @@ class Chaos:
 
     def check_log_matching(self):
         # Per group, all nodes' FSM logs must be prefix-compatible.
-        for g in range(GROUPS):
+        for g in range(self.G):
             logs = [self.fsms[i][g].applied for i in range(N_NODES)]
             for a in logs:
                 for b in logs:
@@ -204,7 +217,7 @@ class Chaos:
     def maybe_propose(self):
         if self.rng.random() > 0.15 or self.proposed >= 40:
             return
-        g = self.rng.randrange(GROUPS)
+        g = self.rng.randrange(self.G)
         # Propose on the node that believes it leads (if any); chaos means
         # it may be deposed — failures are fine, only acks must be durable.
         for i, e in enumerate(self.engines):
@@ -214,6 +227,43 @@ class Chaos:
                 self.submit_tick[payload] = self.tick_no
                 self.pending.append((g, payload, e.propose(g, payload)))
                 return
+
+    def heal(self, ticks: int = 120):
+        """Everyone up, clean network (no drops/dups/partitions), run to
+        convergence — the shared epilogue of every chaos test."""
+        self.blocked.clear()
+        for i in list(self.down):
+            self.engines[i] = self._make(i)
+            self.down.discard(i)
+        for _ in range(ticks):
+            self.tick_no += 1
+            for _, dst, m in self.delayed:
+                self.engines[dst].receive(m)
+            self.delayed = []
+            for e in self.engines:
+                res = e.tick(window=e.suggest_window(self.window))
+                for m in res.outbound:
+                    self.engines[m.dst].receive(m)
+            self.check_election_safety()
+
+    def assert_converged_and_linearizable(self):
+        """Single agreed leader per group; identical chains and FSM logs;
+        every acked write durable, exactly-once, in real-time order."""
+        for g in range(self.G):
+            leads = [i for i, e in enumerate(self.engines) if e.is_leader(g)]
+            assert len(leads) == 1, f"group {g}: leaders {leads}"
+            heads = {e.chains[g].head for e in self.engines}
+            commits = {e.chains[g].committed for e in self.engines}
+            assert len(heads) == 1 and len(commits) == 1, (
+                f"group {g} failed to converge: heads={heads} commits={commits}")
+            logs = [self.fsms[i][g].applied for i in range(N_NODES)]
+            assert all(l == logs[0] for l in logs), f"group {g} logs differ"
+            applied = set(logs[0])
+            for payload in self.acked[g]:
+                assert payload in applied, (
+                    f"acked payload {payload!r} lost after chaos (group {g})")
+            check_linearizable(self, g, logs[0])
+        self.check_log_matching()
 
     def harvest_acks(self):
         still = []
@@ -552,5 +602,30 @@ def test_chaos_safety_and_convergence(seed):
             check_linearizable(c, g, logs[0])
         # The run must have actually exercised the write path.
         assert total_acked >= 5, f"only {total_acked} acked proposals — chaos too hostile"
+
+    asyncio.run(main())
+
+
+@pytest.mark.parametrize("seed", [3, 19])
+def test_sparse_bridge_chaos(seed):
+    """The sparse packed-IO bridge under the full fault model. 96 groups
+    with a deliberately tiny compaction capacity (k_out=8): election
+    bursts overflow the bucket (dense fallback fetch + ladder growth),
+    quiet stretches shrink it back, crashes restart engines mid-resize —
+    and every invariant (election safety, log matching, durability,
+    linearizability) must hold exactly as in dense mode. Fault-free
+    sparse==dense equality lives in test_sparse_io; this is the faulted
+    complement."""
+    async def main():
+        c = Chaos(seed, groups=96, sparse=True, k_out=8)
+        for _ in range(300):
+            c.step()
+            c.maybe_propose()
+            c.harvest_acks()
+            await asyncio.sleep(0)
+        c.heal()
+        c.harvest_acks()
+        assert c.proposed >= 5, "chaos too hostile — write path unexercised"
+        c.assert_converged_and_linearizable()
 
     asyncio.run(main())
