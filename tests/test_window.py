@@ -226,3 +226,29 @@ def test_windowed_chaos_crash_restart_safety():
         c.check_log_matching()
 
     asyncio.run(main())
+
+
+def test_windowed_sparse_chaos_all_features():
+    """Every round-4 mechanism at once: adaptive multi-tick windows x the
+    sparse packed-IO bridge x a tiny compaction capacity (overflow growth,
+    dense fallback, quiet-run shrink) x the full fault model (drops, dups,
+    delays, crash/restart, one-way link partitions). The invariant epilogue
+    is the same as every other chaos run — windows and sparse IO are
+    transport/dispatch optimizations and must be safety-invisible."""
+    from test_chaos import Chaos
+
+    async def main():
+        c = Chaos(23, window=4, groups=96, sparse=True, k_out=8,
+                  params=step_params(timeout_min=3, timeout_max=8,
+                                     hb_ticks=8))
+        for _ in range(300):
+            c.step()
+            c.maybe_propose()
+            c.harvest_acks()
+            await asyncio.sleep(0)
+        c.heal()
+        c.harvest_acks()
+        assert c.proposed >= 5
+        c.assert_converged_and_linearizable()
+
+    asyncio.run(main())
