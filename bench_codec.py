@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Kafka wire-codec throughput: the C++ schema-table codec in isolation.
+
+The reference inherits its codec from the kafka-protocol crate and
+publishes no numbers; seglog has `bench_log.py` — this is the matching
+microbench for the other native component. Measures full client->server
+round trips (client encode_request -> server decode_request and server
+encode_response -> client decode_response) for the hot frames:
+
+* PRODUCE v3 with a 64 KiB record batch (the data-plane write),
+* FETCH v4 response carrying the same batch (the data-plane read),
+* METADATA v4 response for a 16-topic x 8-partition cluster (control),
+* API_VERSIONS v2 (the tiny handshake frame).
+
+Prints one JSON line per shape and writes BENCH_codec.json. Pure host
+C++ — no device, so no backend guard is needed; numbers from the shared
+1-core CI box vary run to run.
+"""
+
+import json
+import time
+
+from josefine_tpu.broker import records
+from josefine_tpu.kafka.codec import (ApiKey, decode_request, decode_response,
+                                      encode_request, encode_response)
+
+# One 64 KiB payload blob labeled as 16 records (build_batch wraps a single
+# opaque blob; the count only fills the header) — the data-plane frame size.
+BATCH = records.build_batch(b"x" * 65536, 16)
+
+
+def produce_body():
+    return {"transactional_id": None, "acks": -1, "timeout_ms": 10000,
+            "topics": [{"name": "bench", "partitions": [
+                {"index": 0, "records": BATCH}]}]}
+
+
+def fetch_response_body():
+    return {"throttle_time_ms": 0, "responses": [
+        {"topic": "bench", "partitions": [
+            {"partition_index": 0, "error_code": 0, "high_watermark": 1000,
+             "last_stable_offset": 1000, "log_start_offset": 0,
+             "aborted_transactions": [], "records": BATCH}]}]}
+
+
+def metadata_response_body():
+    return {"throttle_time_ms": 0,
+            "brokers": [{"node_id": i, "host": "broker-%d.local" % i,
+                         "port": 9092, "rack": None} for i in range(1, 4)],
+            "cluster_id": "josefine", "controller_id": 1,
+            "topics": [{"error_code": 0, "name": "t%02d" % t,
+                        "is_internal": False,
+                        "partitions": [{"error_code": 0, "partition_index": p,
+                                        "leader_id": 1 + (p % 3),
+                                        "leader_epoch": 0,
+                                        "replica_nodes": [1, 2, 3],
+                                        "isr_nodes": [1, 2, 3],
+                                        "offline_replicas": []}
+                                       for p in range(8)]}
+                       for t in range(16)]}
+
+
+def api_versions_body():
+    return {"client_software_name": "bench", "client_software_version": "1"}
+
+
+def bench_round_trip(name, api, version, req_body, resp_body, resp_version=None):
+    # Request leg: client encode -> server decode.
+    wire_req = encode_request(int(api), version, 7, "bench", req_body)
+    req = decode_request(wire_req)
+    assert req["api_key"] == int(api) and req["body"] is not None
+    # Response leg: server encode -> client decode.
+    rv = version if resp_version is None else resp_version
+    wire_resp = encode_response(int(api), rv, 7, resp_body)
+    rbody = decode_response(int(api), rv, wire_resp)
+    assert rbody is not None
+
+    n = max(200, min(20_000, 50 * 1024 * 1024 // max(1, len(wire_req) + len(wire_resp))))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        req = decode_request(encode_request(int(api), version, 7, "bench", req_body))
+        rbody = decode_response(int(api), rv,
+                                encode_response(int(api), rv, 7, resp_body))
+    dt = time.perf_counter() - t0
+    wire_bytes = len(wire_req) + len(wire_resp)
+    row = {
+        "shape": name,
+        "round_trips_per_sec": round(n / dt, 1),
+        "wire_mb_per_sec": round(n * wire_bytes / dt / 1e6, 1),
+        "request_bytes": len(wire_req),
+        "response_bytes": len(wire_resp),
+        "iters": n,
+    }
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def main():
+    rows = [
+        bench_round_trip("produce_v3_64k", ApiKey.PRODUCE, 3,
+                         produce_body(),
+                         {"responses": [{"name": "bench", "partitions": [
+                             {"index": 0, "error_code": 0, "base_offset": 0,
+                              "log_append_time_ms": -1, "log_start_offset": 0}]}],
+                          "throttle_time_ms": 0}),
+        bench_round_trip("fetch_v4_64k", ApiKey.FETCH, 4,
+                         {"replica_id": -1, "max_wait_ms": 500, "min_bytes": 1,
+                          "max_bytes": 1 << 20, "isolation_level": 0,
+                          "topics": [{"topic": "bench", "partitions": [
+                              {"partition": 0, "fetch_offset": 0,
+                               "partition_max_bytes": 1 << 20}]}]},
+                         fetch_response_body()),
+        bench_round_trip("metadata_v4_16x8", ApiKey.METADATA, 4,
+                         {"topics": [{"name": "t%02d" % t} for t in range(16)],
+                          "allow_auto_topic_creation": False},
+                         metadata_response_body()),
+        bench_round_trip("api_versions_v2", ApiKey.API_VERSIONS, 2,
+                         {}, {"error_code": 0, "api_keys": [
+                             {"api_key": k, "min_version": 0, "max_version": 7}
+                             for k in range(18)], "throttle_time_ms": 0}),
+    ]
+    with open("BENCH_codec.json", "w") as f:
+        json.dump({"bench": "kafka_codec_round_trip", "results": rows}, f,
+                  indent=1)
+
+
+if __name__ == "__main__":
+    main()
