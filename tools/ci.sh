@@ -59,7 +59,7 @@ else
         tests/test_log.py tests/test_durability.py \
         tests/test_idempotent_produce.py tests/test_metrics.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
-        tests/test_partition_compaction.py -q
+        tests/test_partition_compaction.py tests/test_entrypoint.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_reset_safety.py -q
 fi
