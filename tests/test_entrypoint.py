@@ -57,3 +57,63 @@ def test_josefine_boots_example_config_and_serves_kafka(tmp_path):
             await asyncio.wait_for(task, 60)  # clean join, no orphan tasks
 
     asyncio.run(main())
+
+
+def test_josefine_three_nodes_create_topic(tmp_path):
+    """Three josefine() nodes from the multi-node example TOMLs (ports and
+    dirs rewritten), full-mesh over real sockets: CreateTopics with
+    replication_factor=2 / partitions=2 round-trips OK — the reference's
+    ``create_topic`` integration test shape (``tests/josefine.rs:124-166``)
+    driven through the public entrypoint."""
+    ex = EXAMPLE.parent.parent / "multi-node"
+    raft_ports = {6669: 16791, 6670: 16792, 6671: 16793}
+    broker_ports = {8844: 18871, 8845: 18872, 8846: 18873}
+    paths = []
+    for i in (1, 2, 3):
+        toml = (ex / f"node-{i}.toml").read_text()
+        for old, new in {**raft_ports, **broker_ports}.items():
+            toml = toml.replace(f"port = {old}", f"port = {new}")
+        toml = re.sub(r'"/tmp/josefine-tpu/multi/node-(\d)',
+                      r'"%s/node-\1' % tmp_path, toml)
+        p = tmp_path / f"node-{i}.toml"
+        p.write_text(toml)
+        paths.append(p)
+
+    async def main():
+        shutdown = Shutdown()
+        tasks = [asyncio.create_task(josefine(str(p), shutdown.clone()))
+                 for p in paths]
+        c = None
+        try:
+            for _ in range(240):
+                for t in tasks:
+                    if t.done():
+                        t.result()
+                try:
+                    c = await kafka_client.connect("127.0.0.1", 18871)
+                    break
+                except OSError:
+                    await asyncio.sleep(0.25)
+            assert c is not None, "broker 1 never came up"
+            # Wait until all three brokers registered (metadata shows them).
+            for _ in range(240):
+                md = await asyncio.wait_for(
+                    c.send(ApiKey.METADATA, 4,
+                           {"topics": [], "allow_auto_topic_creation": False}), 30)
+                if len(md["brokers"]) == 3:
+                    break
+                await asyncio.sleep(0.25)
+            assert len(md["brokers"]) == 3, md["brokers"]
+            r = await asyncio.wait_for(
+                c.send(ApiKey.CREATE_TOPICS, 1, {
+                    "topics": [{"name": "new-topic", "num_partitions": 2,
+                                "replication_factor": 2, "assignments": [],
+                                "configs": []}],
+                    "timeout_ms": 10000, "validate_only": False}), 60)
+            assert r["topics"][0]["error_code"] == 0, r
+            await c.close()
+        finally:
+            shutdown.shutdown()
+            await asyncio.wait_for(asyncio.gather(*tasks), 60)
+
+    asyncio.run(main())
