@@ -118,7 +118,7 @@ async def test_two_members_one_generation_and_leader_map():
         {"member_id": j1["member_id"], "assignment": b"p0"},
         {"member_id": j2["member_id"], "assignment": b"p1"},
     ])
-    fs = await asyncio.wait_for(task, 1.0)
+    fs = await asyncio.wait_for(task, 15)
     assert fs["error_code"] == ErrorCode.NONE
     assert fs["assignment"] in (b"p0", b"p1")
 
@@ -138,7 +138,7 @@ async def test_rejoin_triggers_rebalance_and_heartbeat_signals_it():
     assert coord.heartbeat("g", 1, j1["member_id"]) == ErrorCode.REBALANCE_IN_PROGRESS
     r1 = await coord.join_group("g", j1["member_id"], "consumer",
                                 [("range", b"")], 10_000, 150)
-    j2 = await asyncio.wait_for(task, 1.0)
+    j2 = await asyncio.wait_for(task, 15)
     assert r1["generation_id"] == j2["generation_id"] == 2
     assert len({r1["member_id"], j2["member_id"]}) == 2
 
