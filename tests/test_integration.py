@@ -127,7 +127,7 @@ async def test_create_topic_replicated(tmp_path):
                     for n in mgr.nodes
                 ):
                     await asyncio.sleep(0.05)
-            await asyncio.wait_for(all_replicated(), 10)
+            await asyncio.wait_for(all_replicated(), 45)
             for n in mgr.nodes:
                 parts = n.store.get_partitions("replicated")
                 assert [p.idx for p in parts] == [0, 1]
@@ -317,7 +317,7 @@ async def test_fetch_long_poll_wakes_on_append(tmp_path):
                 }), 10)
                 assert (pr["responses"][0]["partitions"][0]["error_code"]
                         == ErrorCode.NONE)
-                fetched = await asyncio.wait_for(fetcher, 10)
+                fetched = await asyncio.wait_for(fetcher, 30)
                 waited = loop.time() - t0
                 fp = fetched["responses"][0]["partitions"][0]
                 assert fp["records"] and fp["records"].endswith(b"wake")
