@@ -182,3 +182,62 @@ def test_served_dedup_cache_ttl_and_bound():
         assert not ns._served
 
     asyncio.run(main())
+
+
+def test_windowed_server_loop_over_sockets():
+    """The production windowed tick loop (raft.window_ticks > 1): real
+    sockets, staggered heartbeats, engine-emitted keepalive. The loop must
+    fold ticks in steady state (suggest_window opens fully), stay
+    term-stable across the windowed stretch, and still commit proposals —
+    including one forwarded through a follower."""
+    async def main():
+        tick_ms = 30
+        ports = free_ports(3)
+        ids_ = [1, 2, 3]
+        nodes, fsms = [], []
+        for i, nid in enumerate(ids_):
+            cfg = RaftConfig(
+                id=nid, ip="127.0.0.1", port=ports[i],
+                nodes=[NodeAddr(id=oid, ip="127.0.0.1", port=ports[j])
+                       for j, oid in enumerate(ids_) if oid != nid],
+                tick_ms=tick_ms,
+                heartbeat_timeout_ms=8 * tick_ms,   # staggered: hb 8 ticks
+                election_timeout_min_ms=4 * tick_ms,
+                election_timeout_max_ms=10 * tick_ms,
+                window_ticks=4,
+            )
+            fsm = ListFsm()
+            fsms.append(fsm)
+            nodes.append(JosefineRaft(cfg, MemKV(), {0: fsm}, shutdown=Shutdown()))
+        for n in nodes:
+            await n.start()
+        try:
+            leader = await wait_for_leader(nodes)
+            # Steady state: the adaptive policy opens the full window on
+            # every node (elections over, no snapshots, no parole).
+            for _ in range(600):
+                if all(n.engine.suggest_window(4) == 4 for n in nodes):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(n.engine.suggest_window(4) == 4 for n in nodes)
+
+            terms0 = [int(n.engine.term(0)) for n in nodes]
+            result = await RaftClient(leader).propose(b"windowed", timeout=15.0)
+            assert result == b"ok:windowed"
+            follower = next(n for n in nodes if n is not leader)
+            result = await RaftClient(follower).propose(b"via-follower",
+                                                        timeout=15.0)
+            assert result == b"ok:via-follower"
+            for _ in range(200):
+                if all(f.applied == [b"windowed", b"via-follower"]
+                       for f in fsms):
+                    break
+                await asyncio.sleep(0.05)
+            assert all(f.applied == [b"windowed", b"via-follower"] for f in fsms)
+            # No election churned terms while windows were folding.
+            assert [int(n.engine.term(0)) for n in nodes] == terms0
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
