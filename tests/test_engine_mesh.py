@@ -40,25 +40,31 @@ def _mk(mesh, sparse):
                        sparse_io=sparse, mesh=mesh) for i in range(3)]
 
 
-def _route(cluster):
+def _route(cluster, window=1):
     out = []
     for e in cluster:
-        out.extend(e.tick().outbound)
+        out.extend(e.tick(window=e.suggest_window(window)).outbound)
     for m in out:
         cluster[m.dst].receive(m)
 
 
 @pytest.mark.asyncio
-@pytest.mark.parametrize("shards,sparse", [(2, False), (8, True)])
-async def test_mesh_engine_matches_single_device(shards, sparse):
+@pytest.mark.parametrize("shards,sparse,window", [
+    (2, False, 1),
+    (8, True, 1),
+    (4, True, 4),   # multi-tick windows over the sharded mesh
+])
+async def test_mesh_engine_matches_single_device(shards, sparse, window):
     """Engine clusters on a sharded mesh must be bit-identical to the
     single-device engine, tick for tick, through elections and a live
-    proposal lane."""
+    proposal lane — including with multi-tick windows folding dispatches
+    (both clusters run the same adaptive policy from identical state, so
+    their window decisions must coincide too)."""
     single, meshed = _mk(None, sparse), _mk(_mesh(shards), sparse)
     futs = []
     for t in range(200):
-        _route(single)
-        _route(meshed)
+        _route(single, window)
+        _route(meshed, window)
         if t == 60:
             for g in range(0, P, 9):
                 for cluster in (single, meshed):
