@@ -28,10 +28,14 @@ def say(msg: str) -> None:
     print(line, flush=True)
 
 
-def probe(timeout_s: int = 150) -> bool:
+def probe(timeout_s: int = 3300) -> bool:
     # The axon backend claims a chip from a shared pool via the local
     # relay; a busy pool looks like a hang (the claim leg blocks until a
-    # grant). A generous timeout gives a queued grant time to arrive.
+    # grant) and the relay's own error strings ("grant unclaimed past
+    # timeout — client lost") imply claims QUEUE and a grant can arrive
+    # late. A short probe therefore keeps abandoning its queue position
+    # right before it would be served — hold one claim for up to 55 min
+    # instead, and run the bench steps the moment it returns.
     try:
         r = subprocess.run(
             [sys.executable, "-c",
