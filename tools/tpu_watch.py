@@ -40,9 +40,13 @@ def probe(timeout_s: int = 3300) -> bool:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; assert jax.devices()[0].platform=='tpu'"],
-            capture_output=True, timeout=timeout_s, cwd=REPO)
+            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
+        if r.returncode != 0:
+            tail = (r.stderr or r.stdout).strip().splitlines()
+            say(f"  claim refused after wait: {tail[-1][:200] if tail else '(no output)'}")
         return r.returncode == 0
     except subprocess.TimeoutExpired:
+        say(f"  claim still queued after {timeout_s}s; recycling")
         return False
 
 
