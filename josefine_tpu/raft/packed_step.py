@@ -1,0 +1,326 @@
+"""Packed-IO device step functions behind the engine's tick dispatch.
+
+On a tunneled TPU every individual host<->device transfer is a full network
+round trip, so the bridge's tick floor is set by the *number* of transfers,
+not their bytes. The step therefore takes ONE packed (10, P, N) input tensor
+(nine message rows + a proposal-count row) and returns ONE flat int32 output
+holding both the (10, P) scalar mirror (term/voted/role/leader/head/commit/
+minted/became) and the (9, P, N) outbox — one transfer each way per tick,
+instead of ~27 pytree leaves. Packed message row order (both directions):
+  0=kind 1=term 2=x.t 3=x.s 4=y.t 5=y.s 6=z.t 7=z.s 8=ok
+Input row 9: proposal counts in column 0 (the (P,) lane, node-axis-padded).
+
+Three backends share the contract (and the equivalence suites pin them
+bit-exact — tests/test_window.py, tests/test_differential.py):
+
+* the jitted vmapped XLA kernel (``models/chained_raft.node_step``),
+* the scalar Python oracle (``models/py_step``),
+* sparse-IO variants of both, which upload only touched inbox rows and
+  fetch only changed rows compacted into a fixed-capacity buffer.
+
+Multi-tick windows (``ticks > 1``) fold consecutive ticks into one
+dispatch: the uploaded inbox applies at tick 1, ticks 2..K run with an
+empty inbox, and the outbox is merged LAST-WRITER-WINS per (group, dst)
+slot with REPLIES frozen (see :func:`_merge_outbox` for why that is both
+safe and, for K <= hb_ticks, lossless). The single-tick step is DEFINED as
+the window of length 1, so there is exactly one implementation per backend.
+
+This module replaces the reference's per-role step functions
+(``src/raft/follower.rs`` / ``candidate.rs`` / ``leader.rs``) with batched
+tensor programs; the host half of the bridge lives in ``raft/engine.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.types import Msgs, NodeState
+from josefine_tpu.ops import ids
+from josefine_tpu.raft import rpc
+
+_I32 = jnp.int32
+
+
+def _node_view(state: NodeState, me: int) -> NodeState:
+    """Slice one node's row out of a (P, N) cluster state."""
+    return jax.tree.map(lambda a: a[:, me], state)
+
+
+def _msgs_from_packed(m9) -> Msgs:
+    return Msgs(
+        kind=m9[0], term=m9[1],
+        x=ids.Bid(m9[2], m9[3]), y=ids.Bid(m9[4], m9[5]),
+        z=ids.Bid(m9[6], m9[7]), ok=m9[8],
+    )
+
+
+def _flat_outputs(xp, st, out, met):
+    """The single definition of the flat-output row order (both backends):
+    the (10, P) scalar mirror followed by the (9, P, N) outbox. One flat
+    buffer = ONE device->host fetch per tick; the concatenate costs a
+    device-side copy of the outbox (HBM-bandwidth trivial) while a second
+    fetch on a tunneled TPU costs a full network round trip (~65 ms
+    observed), which dominates by orders of magnitude."""
+    sv = xp.stack([
+        st.term, st.voted_for, st.role, st.leader,
+        st.head.t, st.head.s, st.commit.t, st.commit.s,
+        met.minted, met.became_leader,
+    ])
+    ov = xp.stack([
+        out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
+        out.z.t, out.z.s, out.ok,
+    ])
+    return xp.concatenate([sv.reshape(-1), ov.reshape(-1)])
+
+
+def _jax_packed_step(params, member, me, state, in10, peer_fresh=None):
+    inbox = _msgs_from_packed(in10)
+    props = in10[9, :, 0]
+    st, out, met = jax.vmap(
+        cr.node_step, in_axes=(None, 0, None, 0, 0, 0, None))(
+        params, member, me, state, inbox, props, peer_fresh)
+    return st, _flat_outputs(jnp, st, out, met)
+
+
+_packed_over_groups = jax.jit(_jax_packed_step, donate_argnums=(3,))
+
+
+def _py_packed_step(params, member, me, state, in10, peer_fresh=None):
+    """The scalar host engine behind the same packed-IO contract."""
+    from josefine_tpu.models.py_step import py_node_over_groups
+
+    in10 = np.asarray(in10)
+    inbox = _msgs_from_packed(in10)
+    props = in10[9, :, 0]
+    st, out, met = py_node_over_groups(params, member, me, state, inbox,
+                                       props, peer_fresh)
+    return st, _flat_outputs(np, st, out, met)
+
+
+# Sparse packed-IO step: the dense (10, P, N) inbox upload and
+# (10, P) + (9, P, N) outbox fetch scale transfers linearly with P even
+# when almost every group is idle — at P=100k on a tunneled TPU that is
+# ~25 MB/tick of mostly zeros, and the transfer (not compute) sets the
+# tick floor. The sparse contract uploads only the touched inbox rows
+# (idx + values, bucketed so shapes stay static) and fetches only the
+# CHANGED rows, compacted on device into a fixed-capacity buffer (count +
+# row ids + row data in one flat array). Capacity overflow falls back to
+# materializing the dense device-resident outputs — correct, just slower —
+# and the engine grows its bucket for the next tick.
+
+
+def _sparse_changed(state, st, out, met):
+    """Rows the host must process: any durable/mirrored field moved, a
+    block was minted, leadership changed hands, or the outbox has traffic."""
+    return ((st.term != state.term) | (st.voted_for != state.voted_for)
+            | (st.role != state.role) | (st.leader != state.leader)
+            | (st.head.t != state.head.t) | (st.head.s != state.head.s)
+            | (st.commit.t != state.commit.t)
+            | (st.commit.s != state.commit.s)
+            | (met.minted != 0) | met.became_leader
+            | (out.kind != rpc.MSG_NONE).any(axis=-1))
+
+
+def _sparse_compact(xp, changed, sv, ov, k_out):
+    P = sv.shape[1]
+    N = ov.shape[2]
+    cnt = xp.cumsum(changed.astype(jnp.int32 if xp is jnp else np.int32))
+    total = cnt[-1]
+    pos = xp.where(changed, cnt - 1, k_out)
+    rows = xp.concatenate(
+        [sv.T, ov.transpose(1, 0, 2).reshape(P, 9 * N)], axis=1)
+    if xp is jnp:
+        buf = jnp.zeros((k_out, 10 + 9 * N), _I32).at[pos].set(
+            rows, mode="drop")
+        idx_out = jnp.zeros((k_out,), _I32).at[pos].set(
+            jnp.arange(P, dtype=_I32), mode="drop")
+        return jnp.concatenate(
+            [total[None].astype(_I32), idx_out, buf.reshape(-1)])
+    buf = np.zeros((k_out, 10 + 9 * N), np.int32)
+    idx_out = np.zeros((k_out,), np.int32)
+    sel = pos < k_out
+    buf[pos[sel]] = rows[sel]
+    idx_out[pos[sel]] = np.arange(P, dtype=np.int32)[sel]
+    return np.concatenate(
+        [np.asarray([total], np.int32), idx_out, buf.reshape(-1)])
+
+
+# Multi-tick device window (VERDICT r3 #3 — close the product-vs-bench
+# kernel gap). One dispatch folds ``window`` consecutive ticks: the uploaded
+# inbox (and queued proposals) applies at tick 1, ticks 2..K run with an
+# empty inbox, and the outbox is merged LAST-WRITER-WINS per (group, dst)
+# slot. Why that is sound:
+#
+# * Safety: dropping the earlier of two same-slot messages is pure message
+#   loss in FIFO order, which Raft tolerates by construction (rejected AEs
+#   re-root the sender; lost grants retry on the next election draw). No
+#   reordering and no duplication is introduced.
+# * In steady state it is also LOSSLESS when K <= hb_ticks: a quiet window
+#   produces at most one message per (group, dst) — one heartbeat (hb_due
+#   fires at most once per hb_ticks), or one catch-up AE at tick 1 (the
+#   optimistic nxt advance stops repeats), or one election broadcast
+#   (timeout redraws >= timeout_min ticks). tick() clamps the window to
+#   hb_ticks for exactly this reason.
+# * Messages RECEIVED mid-window wait for the next window — the same rule
+#   as the single-tick path (receive() queues for the next tick), just with
+#   a longer tick. Latency scales with K; throughput scales with 1/K
+#   dispatches. The server loop grows K only while the cluster is quiet.
+#
+# became_leader can only fire at tick 1 (votes arrive only in the uploaded
+# inbox), so the host's noop-mint/minted-payload bookkeeping is unchanged;
+# ``minted`` is summed and ``became_leader`` OR-ed across the window for
+# the changed-row predicate.
+
+
+def _merge_outbox(xp, acc, out):
+    """Overlay ``out`` on ``acc``, except that a slot already holding a
+    REPLY is frozen for the rest of the window.
+
+    Replies outrank later broadcasts — the same priority rule node_step
+    applies within one tick (its pre-vote broadcast defers to pending
+    replies). Without it the window merge livelocks cold-start elections:
+    a follower grants a (pre-)vote at tick 1, its own timer fires at tick
+    3-8 of the same window, and the last-writer broadcast erases the grant
+    — every round's grants vanish and no candidate ever promotes (observed
+    at window=4, timeout 3-8). A reply slot can't collide with a second
+    reply: replies are only generated at tick 1 (the only tick with an
+    inbox), so freezing it loses at most a heartbeat, which the aggregate
+    keepalive already covers."""
+    resp = ((acc.kind == rpc.MSG_VOTE_RESP)
+            | (acc.kind == rpc.MSG_PREVOTE_RESP)
+            | (acc.kind == rpc.MSG_APPEND_RESP))
+    sel = (out.kind != rpc.MSG_NONE) & ~resp
+    return jax.tree.map(lambda n, o: xp.where(sel, n, o), out, acc)
+
+
+_vstep_nodes = jax.vmap(cr.node_step, in_axes=(None, 0, None, 0, 0, 0, None))
+
+
+def _scan_quiet_ticks(params, member, me, st, out, met, inbox, props,
+                      peer_fresh, ticks):
+    """Ticks 2..K of a jax window: empty inbox, zero proposals, outbox
+    merged with reply priority, minted summed / became_leader OR-ed. A
+    no-op for ticks == 1 (scan length 0) — the single-tick step IS the
+    window of length 1, so there is exactly one implementation to keep in
+    sync with the python twin."""
+    zero_inbox = jax.tree.map(jnp.zeros_like, inbox)
+    zero_props = jnp.zeros_like(props)
+
+    def body(carry, _):
+        st, acc, minted, became = carry
+        st, o2, m2 = _vstep_nodes(params, member, me, st, zero_inbox,
+                                  zero_props, peer_fresh)
+        return (st, _merge_outbox(jnp, acc, o2), minted + m2.minted,
+                became | m2.became_leader), None
+
+    (st, out, minted, became), _ = jax.lax.scan(
+        body, (st, out, met.minted, met.became_leader), None,
+        length=ticks - 1)
+    return st, out, met.replace(minted=minted, became_leader=became)
+
+
+def _sparse_outputs(xp, state, st, out, met, k_out):
+    """Shared sparse epilogue (both backends): scalar-mirror + outbox
+    stacks, the changed-row predicate, and the fixed-capacity compaction.
+    Returns (flat, sv, ov) — sv/ov dense for the overflow fallback."""
+    sv = xp.stack([
+        st.term, st.voted_for, st.role, st.leader,
+        st.head.t, st.head.s, st.commit.t, st.commit.s,
+        met.minted, xp.asarray(met.became_leader).astype(xp.int32),
+    ])
+    ov = xp.stack([
+        out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
+        out.z.t, out.z.s, out.ok,
+    ])
+    changed = _sparse_changed(state, st, out, met)
+    return _sparse_compact(xp, changed, sv, ov, k_out), sv, ov
+
+
+@functools.lru_cache(maxsize=None)
+def _window_step_fn(ticks: int):
+    """Dense-IO window (jitted per length; ticks=1 == the packed step)."""
+
+    def fn(params, member, me, state, in10, peer_fresh):
+        inbox = _msgs_from_packed(in10)
+        props = in10[9, :, 0]
+        st, out, met = _vstep_nodes(params, member, me, state, inbox, props,
+                                    peer_fresh)
+        st, out, met = _scan_quiet_ticks(params, member, me, st, out, met,
+                                         inbox, props, peer_fresh, ticks)
+        return st, _flat_outputs(jnp, st, out, met)
+
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=None)
+def _sparse_window_fn(k_out: int, ticks: int):
+    """Sparse-IO window (jitted per capacity x length; ticks=1 == the
+    sparse packed step)."""
+
+    def fn(params, member, me, state, peer_fresh, idx, vals):
+        P, N = member.shape
+        in10 = jnp.zeros((10, P, N), _I32).at[:, idx, :].set(vals, mode="drop")
+        inbox = _msgs_from_packed(in10)
+        props = in10[9, :, 0]
+        st, out, met = _vstep_nodes(params, member, me, state, inbox, props,
+                                    peer_fresh)
+        st, out, met = _scan_quiet_ticks(params, member, me, st, out, met,
+                                         inbox, props, peer_fresh, ticks)
+        flat, sv, ov = _sparse_outputs(jnp, state, st, out, met, k_out)
+        return st, flat, sv, ov
+
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+def _py_window(params, member, me, state, inbox, props, peer_fresh, ticks):
+    """Python-backend window loop — the scalar twin of tick 1 +
+    _scan_quiet_ticks, with the same merge semantics. Returns np-leaved
+    (st, out, met)."""
+    from josefine_tpu.models.py_step import py_node_over_groups
+
+    st, out, met = py_node_over_groups(params, member, me, state, inbox,
+                                       props, peer_fresh)
+    minted = np.asarray(met.minted)
+    became = np.asarray(met.became_leader)
+    zero_inbox = jax.tree.map(np.zeros_like, inbox)
+    zero_props = np.zeros_like(props)
+    for _ in range(ticks - 1):
+        st, o2, m2 = py_node_over_groups(params, member, me, st, zero_inbox,
+                                         zero_props, peer_fresh)
+        out = _merge_outbox(np, out, o2)
+        minted = minted + np.asarray(m2.minted)
+        became = became | np.asarray(m2.became_leader)
+    st = jax.tree.map(np.asarray, st)
+    out = jax.tree.map(np.asarray, out)
+    return st, out, met.replace(minted=minted, became_leader=became)
+
+
+def _py_packed_window(params, member, me, state, in10, peer_fresh, ticks):
+    """Scalar-engine twin of the dense window (ticks=1 == packed step)."""
+    in10 = np.asarray(in10)
+    st, out, met = _py_window(params, member, me, state,
+                              _msgs_from_packed(in10), in10[9, :, 0],
+                              peer_fresh, ticks)
+    return st, _flat_outputs(np, st, out, met)
+
+
+def _py_sparse_window(k_out, params, member, me, state, peer_fresh, idx, vals,
+                      ticks):
+    """Scalar-engine twin of the sparse window (ticks=1 == sparse step)."""
+    member_np = np.asarray(member)
+    P, N = member_np.shape
+    in10 = np.zeros((10, P, N), np.int32)
+    idx = np.asarray(idx)
+    sel = idx < P
+    in10[:, idx[sel], :] = np.asarray(vals)[:, sel, :]
+    st, out, met = _py_window(params, member, me, state,
+                              _msgs_from_packed(in10), in10[9, :, 0],
+                              peer_fresh, ticks)
+    state_np = jax.tree.map(np.asarray, state)
+    flat, sv, ov = _sparse_outputs(np, state_np, st, out, met, k_out)
+    return st, flat, sv.astype(np.int32), ov.astype(np.int32)

@@ -24,6 +24,7 @@ from josefine_tpu.models.types import StepParams, step_params
 from josefine_tpu.raft import membership, rpc
 from josefine_tpu.raft.engine import NotLeader, RaftEngine
 from josefine_tpu.raft.fsm import Fsm
+from josefine_tpu.raft.pacer import WallClockPacer
 from josefine_tpu.raft.tcp import Transport
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.shutdown import Shutdown
@@ -57,9 +58,14 @@ class JosefineRaft:
         shutdown: Shutdown | None = None,
         backend: str = "jax",
         mesh=None,
+        pacer=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
+        # Tick source. Default: wall clock, the reference's 100 ms-loop
+        # semantics (server.rs:25). Tests/simulation inject a
+        # LockstepPacer so tick counts decouple from host load.
+        self.pacer = pacer if pacer is not None else WallClockPacer()
         node_ids = [config.id] + [n.id for n in config.nodes]
         self.engine = RaftEngine(
             kv,
@@ -116,6 +122,7 @@ class JosefineRaft:
 
     async def start(self) -> None:
         self.bound_addr = await self.transport.start()
+        self.pacer.attach(self)
         self._tick_task = asyncio.create_task(self._tick_loop())
 
     async def run(self) -> None:
@@ -299,8 +306,10 @@ class JosefineRaft:
                 t0 = asyncio.get_running_loop().time()
                 # Steady-state clusters fold up to window_ticks ticks into
                 # one device dispatch; elections/snapshots/parole drop back
-                # to single ticks (engine.suggest_window).
-                w = self.engine.suggest_window(max_window)
+                # to single ticks (engine.suggest_window). The pacer may
+                # clamp further (a lockstep harness grants ticks one at a
+                # time) or block until ticks are granted at all.
+                w = await self.pacer.acquire(self, self.engine.suggest_window(max_window))
                 res = self.engine.tick(window=w)
                 for ch in res.conf_changes:
                     if ch.node_id == self.config.id:
@@ -316,10 +325,13 @@ class JosefineRaft:
                     if dst_id is not None:
                         self.transport.send(dst_id, m)
                 elapsed = asyncio.get_running_loop().time() - t0
-                # A w-tick window covers w tick intervals of wall time.
-                await asyncio.sleep(max(0.0, interval * w - elapsed))
+                # Wall pacer: a w-tick window covers w tick intervals of
+                # wall time. Lockstep pacer: report this node parked.
+                await self.pacer.pace(self, w, interval, elapsed)
         except asyncio.CancelledError:
             pass
         except Exception:
             log.exception("tick loop crashed")
             self.shutdown.shutdown()
+        finally:
+            self.pacer.detach(self)

@@ -15,6 +15,9 @@ import pytest
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
+# Tests that reuse bench harnesses (test_chained_raft imports bench_churn)
+# must never trigger bench_backend's claim supervisor at import time.
+os.environ.setdefault("JOSEFINE_BENCH_PLATFORM", "cpu")
 
 import jax
 

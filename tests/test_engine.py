@@ -442,3 +442,80 @@ def test_five_node_cluster_quorum_and_minority_crash():
         assert len(set(logs)) == 1, "FSM logs diverge after heal"
 
     asyncio.run(main())
+
+
+def test_wide_cluster_n9_python_backend():
+    """The allow_wide envelope (config.py): N=9 exceeds the default N<=8
+    cap but the protocol is N-generic — prove election, quorum commit (5 of
+    9), and exactly-once apply end to end on the scalar backend (the XLA
+    kernel runs the same math; its N=9 first-compile is minutes, which is
+    exactly what the config cap + allow_wide opt-in is about)."""
+    async def main():
+        n = 9
+        ids_ = [10 * (i + 1) for i in range(n)]
+        engines, fsms = [], []
+        for i, nid in enumerate(ids_):
+            fsm = ListFsm()
+            fsms.append(fsm)
+            engines.append(RaftEngine(MemKV(), ids_, nid, groups=2,
+                                      fsms={0: fsm}, params=PARAMS,
+                                      base_seed=i, backend="python"))
+        lead = wait_leader(engines)
+        fut = engines[lead].propose(0, b"wide")
+        run_ticks(engines, 16)
+        assert fut.done() and not fut.exception()
+        assert (await fut) == b"ok:wide"
+
+        # Quorum at N=9 is 5: four crashed nodes leave a committing majority.
+        downed = [i for i in range(n) if i != lead][:4]
+        lead2 = wait_leader(engines, down=downed)
+        fut = engines[lead2].propose(0, b"five-of-nine")
+        run_ticks(engines, 20, down=downed)
+        assert fut.done() and not fut.exception()
+
+        # Heal: all nine converge to one chain, exactly-once apply.
+        run_ticks(engines, 80)
+        assert len({e.chains[0].head for e in engines}) == 1
+        for f in fsms:
+            assert f.applied.count(b"wide") == 1
+            assert f.applied.count(b"five-of-nine") == 1
+
+    asyncio.run(main())
+
+
+def test_propose_between_tick_begin_and_finish_defers():
+    """Round-4 advisor finding: a proposal enqueued after tick_begin (so
+    not counted in the device's inbox row 9) must NOT be failed NotLeader
+    by tick_finish on a leader — and on a group that already had pending
+    proposals it must not trip the minted-count invariant. It waits for
+    the next tick instead."""
+    async def main():
+        engines, fsms, _ = make_cluster(3)
+        lead = wait_leader(engines)
+        leader = engines[lead]
+
+        # Case 1: fresh group queue appears mid-dispatch.
+        h = leader.tick_begin()
+        late = leader.propose(0, b"late")
+        res = leader.tick_finish(h)
+        for m in res.outbound:
+            pass  # not delivered — single-engine dispatch check
+        assert not late.done(), "late proposal must defer, not fail"
+
+        # Case 2: a second payload lands on a group already presented with
+        # one proposal — device minted 1, host must mint exactly 1.
+        first = leader.propose(0, b"first")
+        h = leader.tick_begin()
+        second = leader.propose(0, b"second")
+        leader.tick_finish(h)  # would raise RuntimeError before the fix
+        assert not second.done()
+
+        # Both deferred proposals commit on subsequent full cluster ticks.
+        run_ticks(engines, 20)
+        assert late.done() and not late.exception()
+        assert first.done() and not first.exception()
+        assert second.done() and not second.exception()
+        assert (await late) == b"ok:late"
+        assert (await second) == b"ok:second"
+
+    asyncio.run(main())

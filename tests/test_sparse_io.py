@@ -1,7 +1,7 @@
 """Sparse packed-IO differential: the compacted host<->device bridge must
 be behaviorally identical to the dense one.
 
-The sparse contract (engine `_sparse_window_fn` / `_build_inbox_sparse`)
+The sparse contract (packed_step `_sparse_window_fn` / hostio `_build_inbox_sparse`)
 uploads only touched inbox rows and fetches only changed rows, compacted
 on device with a fixed capacity and a dense fallback on overflow. These
 tests drive two identical in-process clusters — one dense, one sparse —

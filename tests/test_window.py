@@ -1,7 +1,7 @@
 """Multi-tick device windows (engine.tick(window=K)).
 
 The window step folds K ticks into one dispatch with a last-writer-wins
-outbox merge (see engine.py commentary above _window_step_fn). These suites
+outbox merge (see raft/packed_step.py window commentary). These suites
 pin it three ways: the jax and python backends must agree BIT-EXACTLY while
 stepping windows (the differential seam that guards all three step
 implementations), a quiet keepalive-vouched cluster must stay term-stable

@@ -1,0 +1,89 @@
+"""Shared registry of the device bench steps + their landed-artifact checks.
+
+Used by ``tools/tpu_watch.py`` (decides what is still pending, supervises)
+and ``tools/device_suite.py`` (runs the pending steps inside ONE pool
+claim). A step counts as landed only when its artifact proves a TPU run
+(device field contains "TPU") AND the artifact is newer than ``since`` —
+the round checkout stamps every tracked file with the same recent mtime,
+so an mtime-free check would wrongly accept last round's artifacts. The
+headline step is exempt from freshness: its committed artifact is only
+ever written from a device-verified run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: name -> (argv, per-step deadline seconds)
+STEPS: dict[str, tuple[list[str], int]] = {
+    "headline": (["bench.py"], 600),
+    "churn": (["bench_churn.py"], 900),
+    "engine-kernel": (["bench_engine.py", "--kernel",
+                       "--sizes", "1000,10000,100000", "--ticks", "60"], 900),
+    "engine-window8": (["bench_engine.py",
+                        "--sizes", "1000,10000,100000", "--window", "8"], 1500),
+    "engine-single": (["bench_engine.py",
+                       "--sizes", "1000,10000,100000"], 1500),
+    "tune": (["bench_tune.py"], 1800),
+}
+
+STEP_ORDER = list(STEPS)
+
+
+def _json(path: str):
+    try:
+        with open(os.path.join(REPO, path)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fresh(path: str, since: float) -> bool:
+    try:
+        return os.path.getmtime(os.path.join(REPO, path)) >= since
+    except OSError:
+        return False
+
+
+def step_done(name: str, since: float) -> bool:
+    if name == "headline":
+        # Either the committed artifact (landed on the chip in an earlier
+        # grant window) or a fresh capture counts — a fresh checkout must
+        # not spend its first live tunnel window re-measuring a landed
+        # number.
+        for path in ("BENCH_headline_run.json", "BENCH_headline.json"):
+            d = _json(path)
+            if d and "TPU" in d.get("extra", {}).get("device", ""):
+                return True
+        return False
+    if name == "churn":
+        d = _json("BENCH_churn.json")
+        return bool(d and "TPU" in d.get("extra", {}).get("device", "")
+                    and _fresh("BENCH_churn.json", since))
+    if name == "engine-kernel":
+        d = _json("BENCH_engine_kernel.json")
+        if not (d and "TPU" in d.get("device", "")
+                and _fresh("BENCH_engine_kernel.json", since)):
+            return False
+        rows = {r["P"] for r in d.get("results", [])}
+        return {1000, 10000, 100000} <= rows
+    if name in ("engine-window8", "engine-single"):
+        window = 8 if name == "engine-window8" else 1
+        d = _json("BENCH_engine.json")
+        if not (d and "TPU" in d.get("device", "")
+                and _fresh("BENCH_engine.json", since)):
+            return False
+        rows = {r["P"] for r in d.get("results", [])
+                if (r.get("window") or 1) == window}
+        return {1000, 10000, 100000} <= rows
+    if name == "tune":
+        d = _json("BENCH_tune.json")
+        return bool(d and d.get("summary") and _fresh("BENCH_tune.json", since))
+    raise KeyError(name)
+
+
+def pending_steps(since: float) -> list[str]:
+    return [n for n in STEP_ORDER if not step_done(n, since)]

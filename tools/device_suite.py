@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Run the requested device bench steps inside ONE pool claim.
+
+The chip pool grants claims rarely (observed: one ~1-minute-to-minutes
+window in 8h+) and a grant dies without warning, so the worst possible
+design is one claim per bench: every subprocess re-queues at the back of
+the pool. This suite claims ONCE (its own ``import jax``) and then runs
+every pending bench **in-process** via ``runpy``, so a single grant window
+lands as many artifacts as it can.
+
+Mechanics:
+* ``JOSEFINE_BENCH_WORKER=1`` is set before any bench import so
+  ``bench_backend.ensure_backend`` returns immediately instead of
+  spawning its own supervised worker (this process IS the worker).
+* ``JOSEFINE_BENCH_NO_REEXEC=1`` disables run_guarded's CPU re-exec net:
+  a CPU rerun can never land a device artifact, it would only burn the
+  grant window.
+* Each step's stdout is captured to ``/tmp/suite_<step>.out`` (bench.py
+  communicates its result via stdout; the others write artifacts
+  themselves). The headline capture is promoted to
+  ``BENCH_headline_run.json`` + ``BENCH_headline.json`` when it proves a
+  TPU run.
+* Per-step SIGALRM deadlines come from ``tools/device_steps.STEPS``; the
+  supervising watcher's subprocess timeout is the outer net for
+  uninterruptible hangs.
+
+Usage: python tools/device_suite.py [step ...]   (default: all steps)
+Exit codes: 0 = every requested step landed, 2 = some step failed,
+3 = the claim was granted but not a TPU, 1 = backend init raised.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import runpy
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from device_steps import REPO, STEP_ORDER, STEPS, step_done  # noqa: E402
+
+os.chdir(REPO)
+sys.path.insert(0, REPO)
+os.environ["JOSEFINE_BENCH_WORKER"] = "1"
+os.environ["JOSEFINE_BENCH_NO_REEXEC"] = "1"
+
+
+def say(msg: str) -> None:
+    print(f"[suite +{time.time() - T0:7.0f}s] {msg}", flush=True)
+
+
+T0 = time.time()
+
+
+def main() -> int:
+    requested = [a for a in sys.argv[1:] if not a.startswith("-")] or STEP_ORDER
+    for name in requested:
+        if name not in STEPS:
+            say(f"unknown step {name!r}; known: {STEP_ORDER}")
+            return 2
+    since = float(os.environ.get("JOSEFINE_SUITE_SINCE", T0))
+
+    say(f"claiming the pool (steps: {requested})")
+    try:
+        import jax
+
+        from bench_backend import configure_jax
+
+        configure_jax()  # honor a JOSEFINE_BENCH_PLATFORM preset (CPU tests)
+        dev = jax.devices()[0]
+    except Exception as e:  # claim refused / backend init failed
+        say(f"claim failed: {type(e).__name__}: {str(e)[:300]}")
+        return 1
+    say(f"claim GRANTED after {time.time() - T0:.0f}s: {dev}")
+    if dev.platform != "tpu":
+        if os.environ.get("JOSEFINE_SUITE_ALLOW_CPU"):
+            say(f"non-TPU platform {dev.platform} allowed for plumbing test")
+        else:
+            say(f"not a TPU (platform={dev.platform}) — aborting, nothing to land")
+            return 3
+
+    def run_step(name: str) -> bool:
+        argv, deadline = STEPS[name]
+        out_path = f"/tmp/suite_{name}.out"
+        say(f"step {name}: {' '.join(argv)} (deadline {deadline}s)")
+        os.environ["JOSEFINE_BENCH_DEADLINE"] = str(deadline)
+        old_argv = sys.argv
+        sys.argv = list(argv)
+        try:
+            with open(out_path, "w") as f, contextlib.redirect_stdout(f):
+                runpy.run_path(os.path.join(REPO, argv[0]), run_name="__main__")
+        except SystemExit:
+            pass
+        except BaseException as e:  # noqa: BLE001 — keep harvesting the window
+            say(f"step {name}: raised {type(e).__name__}: {str(e)[:200]}")
+        finally:
+            sys.argv = old_argv
+        if name == "headline":
+            _promote_headline(out_path)
+        if step_done(name, since):
+            say(f"step {name}: LANDED")
+            return True
+        say(f"step {name}: did not land (see {out_path})")
+        return False
+
+    failed = []
+    for name in requested:
+        if step_done(name, since):
+            say(f"step {name}: already landed, skipping")
+            continue
+        if not run_step(name):
+            failed.append(name)
+    if failed:
+        # The grant we hold is scarce (observed: one window in 8h+) —
+        # burn it on one bounded retry pass before releasing; a transient
+        # per-step failure must not send us to the back of the pool queue.
+        say(f"retry pass inside the held claim: {failed}")
+        failed = [n for n in failed if not run_step(n)]
+    say(f"done; failed steps: {failed or 'none'}")
+    return 2 if failed else 0
+
+
+def _promote_headline(out_path: str) -> None:
+    """bench.py reports via stdout; persist a TPU-proven line as artifacts."""
+    try:
+        with open(out_path) as f:
+            lines = [ln for ln in f if ln.strip().startswith("{")]
+        d = json.loads(lines[-1])
+    except (OSError, ValueError, IndexError):
+        return
+    if "TPU" not in d.get("extra", {}).get("device", ""):
+        say(f"headline ran but not on TPU: {d.get('extra', {}).get('device')}")
+        return
+    for path in ("BENCH_headline_run.json", "BENCH_headline.json"):
+        with open(os.path.join(REPO, path), "w") as f:
+            json.dump(d, f, indent=1)
+    say(f"headline {d['value']:.3e} {d['unit']} on {d['extra']['device']}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

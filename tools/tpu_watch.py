@@ -1,24 +1,39 @@
 #!/usr/bin/env python
-"""Babysit the flaky axon TPU tunnel until every device bench artifact lands.
+"""Babysit the flaky axon TPU pool until every device bench artifact lands.
 
-The tunnel hangs intermittently (r3: the whole round; r4: minutes after a
-successful run), so this watcher probes it under a timeout and, while it is
-live, runs the device bench sequence one step at a time. A step only counts
-as done when its artifact proves a TPU run (device field / non-_cpu path);
-a mid-sequence tunnel death just means that step retries on the next live
-window. Exits when all steps are landed.
+The pool grants claims rarely and revokes them without warning (r3: zero
+grants all round; r4: one ~1-minute window in 8h+, held claims refused
+server-side after ~25 min with UNAVAILABLE). Earlier watchers probed the
+pool in a throwaway subprocess and then re-claimed for the actual bench —
+releasing a scarce grant right after winning it (round-4 advisor finding).
+
+This watcher instead supervises ``tools/device_suite.py``: ONE process
+that owns the claim and runs every still-pending bench inside the same
+grant window. The watcher's only jobs are (a) keep a claim queued
+continuously by relaunching the suite when its claim is refused, (b) kill
+a suite whose claim (or tunnel) hangs past the hold budget, and (c) stop
+when every artifact proves a TPU run.
 
 Usage: python tools/tpu_watch.py [--once]   (log: /tmp/tpu_watch.log)
 """
 
-import json
+from __future__ import annotations
+
 import os
 import subprocess
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from device_steps import REPO, STEPS, pending_steps  # noqa: E402
+
 LOG = "/tmp/tpu_watch.log"
+CLAIM_HOLD_S = 3300  # observed worst-case grant latency ~55 min
+# Retire before the round's driver bench runs: the driver's bench.py queues
+# its own claim at round end, and the watcher must not be ahead of it in
+# the pool queue by then (the driver channel BENCH_r{N}.json is the
+# evidence that counts — VERDICT r4).
+DEADLINE_H = float(os.environ.get("JOSEFINE_WATCH_DEADLINE_H", "9"))
 
 
 def say(msg: str) -> None:
@@ -28,170 +43,54 @@ def say(msg: str) -> None:
     print(line, flush=True)
 
 
-def probe(timeout_s: int = 3300) -> bool:
-    # The axon backend claims a chip from a shared pool via the local
-    # relay; a busy pool looks like a hang (the claim leg blocks until a
-    # grant) and the relay's own error strings ("grant unclaimed past
-    # timeout — client lost") imply claims QUEUE and a grant can arrive
-    # late. A short probe therefore keeps abandoning its queue position
-    # right before it would be served — hold one claim for up to 55 min
-    # instead, and run the bench steps the moment it returns.
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert jax.devices()[0].platform=='tpu'"],
-            capture_output=True, text=True, timeout=timeout_s, cwd=REPO)
-        if r.returncode != 0:
-            tail = (r.stderr or r.stdout).strip().splitlines()
-            say(f"  claim refused after wait: {tail[-1][:200] if tail else '(no output)'}")
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        say(f"  claim still queued after {timeout_s}s; recycling")
-        return False
-
-
-def run(cmd: list[str], timeout_s: int) -> bool:
-    say("run: " + " ".join(cmd))
-    # Give the bench's own SIGALRM guard (run_guarded, default 600s) room
-    # to match this step's budget — otherwise a long multi-size run gets
-    # killed by its inner deadline and re-execs to a CPU fallback that
-    # can't land the device artifact.
-    env = {**os.environ,
-           "JOSEFINE_BENCH_DEADLINE": str(max(540, timeout_s - 120))}
-    try:
-        with open(LOG, "a") as f:
-            r = subprocess.run(cmd, stdout=f, stderr=f, timeout=timeout_s,
-                               cwd=REPO, env=env)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        say("  TIMEOUT")
-        return False
-
-
-def _json(path: str):
-    try:
-        with open(os.path.join(REPO, path)) as f:
-            return json.load(f)
-    except (OSError, ValueError):
-        return None
-
-
-def _fresh(path: str) -> bool:
-    try:
-        return os.path.getmtime(os.path.join(REPO, path)) >= START
-    except OSError:
-        return False
-
-
 # Only artifacts written AFTER the watcher started count as landed — the
 # round checkout stamps every tracked file with the same recent mtime, so
 # any grace window would wrongly accept last round's artifacts. (The
-# headline step is exempt: BENCH_headline_run.json is created only by this
-# watcher, from a device-verified run.)
+# headline check is exempt inside device_steps: its committed artifact is
+# only ever written from a device-verified run.)
 START = time.time()
 
 
-def headline_done() -> bool:
-    # Either the committed artifact (BENCH_headline.json, landed 03:46Z on
-    # the chip) or a fresh watcher capture counts — a fresh checkout must
-    # not spend its first live tunnel window re-measuring a landed number.
-    for path in ("BENCH_headline_run.json", "BENCH_headline.json"):
-        d = _json(path)
-        if d and "TPU" in d.get("extra", {}).get("device", ""):
-            return True
-    return False
-
-
-def headline() -> bool:
-    try:
-        with open("/tmp/bench_headline.out", "w") as f:
-            r = subprocess.run([sys.executable, "bench.py"], stdout=f,
-                               stderr=subprocess.DEVNULL, timeout=600, cwd=REPO)
-    except subprocess.TimeoutExpired:
-        say("  TIMEOUT")
-        return False
-    if r.returncode != 0:
-        return False
-    d = _json("/tmp/bench_headline.out") or {}
-    if "TPU" in (d.get("extra", {}).get("device", "")):
-        with open(os.path.join(REPO, "BENCH_headline_run.json"), "w") as f:
-            json.dump(d, f)
-        say(f"  headline {d['value']:.3e} {d['unit']} on {d['extra']['device']}")
-        return True
-    say("  headline ran but not on TPU: " + str(d.get("extra", {}).get("device")))
-    return False
-
-
-def churn_done() -> bool:
-    d = _json("BENCH_churn.json")
-    return bool(d and "TPU" in d.get("extra", {}).get("device", "")
-                and _fresh("BENCH_churn.json"))
-
-
-def kernel_done() -> bool:
-    d = _json("BENCH_engine_kernel.json")
-    if not (d and "TPU" in d.get("device", "") and _fresh("BENCH_engine_kernel.json")):
-        return False
-    rows = {r["P"] for r in d.get("results", [])}
-    return {1000, 10000, 100000} <= rows
-
-
-def engine_done(window: int) -> bool:
-    d = _json("BENCH_engine.json")
-    if not (d and "TPU" in d.get("device", "") and _fresh("BENCH_engine.json")):
-        return False
-    rows = {r["P"] for r in d.get("results", []) if r.get("window") == window}
-    return {1000, 10000, 100000} <= rows
-
-
-STEPS = [
-    ("headline", headline_done, headline),
-    ("churn", churn_done,
-     lambda: run([sys.executable, "bench_churn.py"], 900)),
-    ("engine-kernel", kernel_done,
-     lambda: run([sys.executable, "bench_engine.py", "--kernel",
-                  "--sizes", "1000,10000,100000", "--ticks", "60"], 900)),
-    ("engine-window8", lambda: engine_done(8),
-     lambda: run([sys.executable, "bench_engine.py",
-                  "--sizes", "1000,10000,100000", "--window", "8"], 1500)),
-    ("engine-single", lambda: engine_done(1),
-     lambda: run([sys.executable, "bench_engine.py",
-                  "--sizes", "1000,10000,100000"], 1500)),
-    ("tune", lambda: bool((_json("BENCH_tune.json") or {}).get("summary"))
-     and _fresh("BENCH_tune.json"),
-     lambda: run([sys.executable, "bench_tune.py"], 1800)),
-]
-
-
 def main() -> int:
-    say("watcher start")
+    say("watcher start (one-claim suite mode)")
     once = "--once" in sys.argv
-    fails: dict[str, int] = {}
+    cycle = 0
     while True:
-        pending = [s for s in STEPS if not s[1]()]
-        if not pending:
+        pend = pending_steps(START)
+        if not pend:
             say("ALL DEVICE ARTIFACTS LANDED")
             return 0
-        if probe():
-            # Least-failed-first: a step that keeps dying (bad flag, OOM)
-            # must not starve the later steps of live tunnel windows.
-            name, done, go = min(pending, key=lambda s: fails.get(s[0], 0))
-            say(f"tunnel LIVE — step: {name} (pending: {[s[0] for s in pending]})")
-            go()
-            if done():
-                # Chain straight into the next step — grants are scarce
-                # and die without warning; no sleep while one is live.
-                say(f"  step {name} LANDED")
-            else:
-                fails[name] = fails.get(name, 0) + 1
-                say(f"  step {name} did not land (fail #{fails[name]})")
-                time.sleep(min(600, 30 * fails[name]))
-        else:
-            say(f"tunnel down (pending: {[s[0] for s in pending]})")
-            if not once:
-                time.sleep(60)
+        if time.time() - START > DEADLINE_H * 3600:
+            say(f"deadline ({DEADLINE_H}h) reached with pending {pend} — "
+                "retiring so the round's driver bench owns the pool queue")
+            return 1
+        cycle += 1
+        budget = CLAIM_HOLD_S + sum(STEPS[n][1] for n in pend) + 300
+        # A suite launched near the deadline must not outlive it from
+        # inside the pool queue — the whole point of retiring is that the
+        # driver's own bench claim is ahead of ours by round end.
+        remaining = DEADLINE_H * 3600 - (time.time() - START)
+        budget = max(60, min(budget, int(remaining)))
+        say(f"cycle {cycle}: pending {pend}; suite budget {budget}s")
+        env = {**os.environ, "JOSEFINE_SUITE_SINCE": str(START)}
+        try:
+            with open(LOG, "a") as f:
+                r = subprocess.run(
+                    [sys.executable, "tools/device_suite.py", *pend],
+                    stdout=f, stderr=f, timeout=budget, cwd=REPO, env=env)
+            rc = r.returncode
+        except subprocess.TimeoutExpired:
+            rc = None
+            say("  suite hit the hold budget (claim or tunnel hung) — recycled")
+        if rc == 0:
+            continue  # pending recomputed at loop top; should be empty now
+        if rc is not None:
+            say(f"  suite exited rc={rc} (1=claim refused, 2=step failed, 3=not TPU)")
         if once:
-            return 0 if not [s for s in STEPS if not s[1]()] else 1
+            return 0 if not pending_steps(START) else 1
+        # Refused claims recycle fast to stay queued; anything else backs
+        # off a little so a hard-broken bench can't spin the pool.
+        time.sleep(20 if rc == 1 else 90)
 
 
 if __name__ == "__main__":
