@@ -33,7 +33,7 @@ class Node:
     """One full node: raft runtime + broker + shared durable store."""
 
     def __init__(self, config: JosefineConfig, shutdown: Shutdown | None = None,
-                 in_memory: bool = False):
+                 in_memory: bool = False, pacer=None):
         config.validate()
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -67,6 +67,10 @@ class Node:
             shutdown=self.shutdown.clone(),
             backend=config.engine.backend,
             mesh=mesh,
+            # Tick source passthrough: wall clock by default; harnesses
+            # inject a LockstepPacer (raft/pacer.py) to drive the whole
+            # product node on a virtual clock.
+            pacer=pacer,
         )
         self.client = RaftClient(self.raft)
         self.broker = JosefineBroker(
