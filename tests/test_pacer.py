@@ -1,0 +1,105 @@
+"""Unit suite for the injected tick sources (raft/pacer.py).
+
+The LockstepPacer is the determinism foundation of every socket suite
+(test_raft_server.py) and the virtual-clock product test — its contract
+needs pinning on its own, not only through 3-node clusters:
+
+* ``advance(k)`` returns only after every attached node consumed exactly
+  ``k`` ticks and parked again (zero tick skew);
+* partial grants: a node whose loop asks for a smaller window than the
+  outstanding permits drains them across several iterations;
+* a node that detaches mid-advance (crash tests stop nodes while a
+  driver task is granting) must not deadlock the harness;
+* the WallClockPacer preserves the reference tick-loop arithmetic
+  (sleep = tick_s * executed - elapsed, floored at 0).
+"""
+
+import asyncio
+import time
+
+from josefine_tpu.raft.pacer import LockstepPacer, WallClockPacer
+
+
+def test_lockstep_exact_tick_counts():
+    async def main():
+        pacer = LockstepPacer(settle_s=0)
+        executed = {"a": 0, "b": 0}
+        running = True
+
+        async def node(key, want):
+            pacer.attach(key)
+            try:
+                while running:
+                    got = await pacer.acquire(key, want)
+                    executed[key] += got
+                    await pacer.pace(key, got, 0.0, 0.0)
+            except asyncio.CancelledError:
+                pass
+            finally:
+                pacer.detach(key)
+
+        ta = asyncio.create_task(node("a", 1))
+        tb = asyncio.create_task(node("b", 4))
+        await asyncio.sleep(0)  # let both attach and park
+
+        await pacer.advance(1)
+        assert executed == {"a": 1, "b": 1}
+        await pacer.advance(4)   # b folds 4 in one acquire; a drains 4 × 1
+        assert executed == {"a": 5, "b": 5}
+        await pacer.advance(3)   # b's want=4 clamps to the 3 granted
+        assert executed == {"a": 8, "b": 8}
+
+        running = False
+        for t in (ta, tb):
+            t.cancel()
+        await asyncio.gather(ta, tb, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+def test_lockstep_detach_mid_advance_does_not_deadlock():
+    async def main():
+        pacer = LockstepPacer(settle_s=0)
+        pacer.attach("dead")  # attached but never consumes (a crashed node)
+        pacer.attach("live")
+        consumed = 0
+
+        async def live():
+            nonlocal consumed
+            while True:
+                got = await pacer.acquire("live", 1)
+                consumed += got
+                await pacer.pace("live", got, 0.0, 0.0)
+
+        t = asyncio.create_task(live())
+        await asyncio.sleep(0)
+
+        async def kill_dead_soon():
+            await asyncio.sleep(0.05)
+            pacer.detach("dead")  # stop() path: tick loop detaches
+
+        killer = asyncio.create_task(kill_dead_soon())
+        # Without the detach, this would hang on the dead node's permits.
+        await asyncio.wait_for(pacer.advance(2), timeout=5.0)
+        assert consumed == 2
+        await killer
+        t.cancel()
+        await asyncio.gather(t, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+def test_wall_clock_pacer_sleep_arithmetic():
+    async def main():
+        pacer = WallClockPacer()
+        assert await pacer.acquire("n", 4) == 4  # never blocks, never clamps
+        t0 = time.monotonic()
+        # 2 ticks of 30 ms with 10 ms already spent -> ~50 ms sleep.
+        await pacer.pace("n", 2, 0.030, 0.010)
+        dt = time.monotonic() - t0
+        assert dt >= 0.045
+        t0 = time.monotonic()
+        await pacer.pace("n", 1, 0.010, 0.500)  # overrun: no negative sleep
+        assert time.monotonic() - t0 < 0.25
+
+    asyncio.run(main())

@@ -47,7 +47,7 @@ else
         tests/test_window.py tests/test_chain.py tests/test_snapshot.py \
         tests/test_membership.py tests/test_raft_server.py \
         tests/test_rpc_batch.py tests/test_tcp_coalesce.py \
-        tests/test_config.py -q
+        tests/test_config.py tests/test_pacer.py -q
     # Real-socket timing suite in its own chunk: it shares the box with no
     # other suite so CPU contention cannot flake its wall-clock deadlines
     # (ADVICE r3).
