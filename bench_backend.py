@@ -23,7 +23,7 @@ claims the chip is the process that runs the bench.**
     claim entirely).
   - *parent* (neither set — the normal ``python bench.py`` entry): spawn
     this same script as a worker subprocess and supervise it for up to
-    ``JOSEFINE_CLAIM_BUDGET`` seconds (default 3000 s ≈ the pool's
+    ``JOSEFINE_CLAIM_BUDGET`` seconds (default 3600 s, above the pool's
     observed worst-case grant latency), streaming the worker's stdout
     through and printing a heartbeat line to stderr every minute so the
     run is visibly alive. A worker that dies quickly (claim refused
@@ -108,14 +108,20 @@ def _stream_worker(cmd: list[str], env: dict, budget_s: float,
     """
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE, text=True)
     saw_line = False
+    abandoned = False  # set when the supervisor gives up on this worker
 
     def pump():
         nonlocal saw_line
         assert proc.stdout is not None
-        for line in proc.stdout:
-            saw_line = True
-            sys.stdout.write(line)
-            sys.stdout.flush()
+        try:
+            for line in proc.stdout:
+                if abandoned:
+                    break  # zombie output must not interleave with the next run
+                saw_line = True
+                sys.stdout.write(line)
+                sys.stdout.flush()
+        except (ValueError, OSError):
+            pass  # stdout closed under us by the abandon path
 
     t = threading.Thread(target=pump, daemon=True)
     t.start()
@@ -136,7 +142,15 @@ def _stream_worker(cmd: list[str], env: dict, budget_s: float,
                 # A worker stuck in uninterruptible device-tunnel IO may
                 # not reap — the supervisor must still reach its fallback
                 # nets rather than die with nothing on stdout (the
-                # round-3 outcome).
+                # round-3 outcome). Silence the zombie's pump first: its
+                # stdout must not interleave with the fallback run's result
+                # stream and corrupt the driver's tail-line JSON parse.
+                abandoned = True
+                try:
+                    if proc.stdout is not None:
+                        proc.stdout.close()
+                except OSError:
+                    pass
                 _say(f"{hb_prefix} worker pid {proc.pid} did not reap after "
                      "SIGKILL (uninterruptible IO?); abandoning it")
             t.join(timeout=10)

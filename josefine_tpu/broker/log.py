@@ -12,24 +12,36 @@ from __future__ import annotations
 import os
 
 from josefine_tpu import native
+from josefine_tpu.utils.kv import DiskFault
 
 MAX_SEGMENT_BYTES = 1 << 30  # reference segment.rs:11
 INDEX_BYTES = 10 << 20       # reference index.rs:9
 
 
 class Log:
-    """Append-only offset-addressed record-blob log for one partition."""
+    """Append-only offset-addressed record-blob log for one partition.
+
+    ``io_hook`` is the chaos seam (``josefine_tpu/chaos/faults.py``): a
+    callable ``hook(op, data) -> bytes | None`` consulted before ``append``
+    and ``flush``. Returning ``None`` proceeds normally; raising
+    :class:`DiskFault` fails the op with nothing written; returning a bytes
+    prefix from an ``"append"`` call simulates a TORN write — the prefix
+    lands in the segment, then the caller still sees the error. Default is
+    ``None``: the hot path pays nothing when chaos is off.
+    """
 
     def __init__(
         self,
         directory: str | os.PathLike,
         max_segment_bytes: int = MAX_SEGMENT_BYTES,
         index_bytes: int = INDEX_BYTES,
+        io_hook=None,
     ):
         os.makedirs(directory, exist_ok=True)
         self._dir = str(directory)
         self._max_segment_bytes = max_segment_bytes
         self._index_bytes = index_bytes
+        self._io_hook = io_hook
         self._open()
 
     def _open(self) -> None:
@@ -42,6 +54,12 @@ class Log:
     def append(self, data: bytes, count: int = 1) -> int:
         """Append one blob spanning ``count`` offsets; returns its base
         offset (a Kafka record batch claims one offset per record)."""
+        if self._io_hook is not None:
+            torn = self._io_hook("append", data)
+            if torn is not None:
+                self._log.append(torn, count=count)
+                raise DiskFault(
+                    f"torn append: {len(torn)}/{len(data)} bytes written")
         return self._log.append(data, count=count)
 
     def read(self, offset: int):
@@ -70,6 +88,8 @@ class Log:
         self._open()
 
     def flush(self) -> None:
+        if self._io_hook is not None:
+            self._io_hook("flush", b"")
         self._log.flush()
 
     def close(self) -> None:

@@ -1,0 +1,503 @@
+"""In-process chaos cluster harnesses.
+
+:class:`ChaosCluster` drives N :class:`~josefine_tpu.raft.engine.RaftEngine`
+nodes through a :class:`~josefine_tpu.chaos.faults.FaultPlane`-mediated
+network on the plane's virtual clock. Every message fate, crash, partition
+and proposal draw comes from the plane's single seeded RNG, so one seed
+reproduces one run exactly. The safety invariants
+(:mod:`josefine_tpu.chaos.invariants`) are enforced throughout — election
+safety every tick, log matching every 10, the full convergence +
+durability + linearizability epilogue after healing.
+
+This is the library form of what used to be the test-private ``Chaos``
+class in ``tests/test_chaos.py``; the chaos suites, the windowed-dispatch
+suite, and ``tools/chaos_soak.py`` all drive this one fault model.
+
+:class:`MembershipChaosCluster` adds runtime membership churn (a 4th node
+ADDed/REMOVEd through conf blocks mid-chaos) — the library form of the old
+``MemberChaos``.
+"""
+
+from __future__ import annotations
+
+import json
+
+from josefine_tpu.chaos import invariants
+from josefine_tpu.chaos.faults import FaultPlane, NetFaults
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange
+from josefine_tpu.utils.kv import MemKV
+
+DEFAULT_PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class SnapFsm:
+    """List FSM with snapshot/restore — the chaos suites' replicated state."""
+
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data: bytes) -> bytes:
+        self.applied.append(data)
+        return b"ok:" + data
+
+    def snapshot(self) -> bytes:
+        return json.dumps([a.decode() for a in self.applied]).encode()
+
+    def restore(self, data: bytes) -> None:
+        self.applied = [x.encode() for x in json.loads(data)] if data else []
+
+
+def expand_outbound(outbound):
+    """Flatten TickResult.outbound to per-message WireMsgs so the fault
+    plane decides each message's fate individually (columnar MsgBatches
+    expand via .messages())."""
+    from josefine_tpu.raft import rpc
+
+    out = []
+    for m in outbound:
+        if isinstance(m, rpc.MsgBatch):
+            out.extend(m.messages())
+        else:
+            out.append(m)
+    return out
+
+
+class _PlaneDrivenCluster:
+    """Driver scaffolding shared by the plane-mediated harnesses: virtual-
+    clock accessors, delayed-message maturation, fault-plane routing of
+    engine outboxes, and ack harvesting. Subclasses own engine lifecycle
+    (``self.engines`` slots may be None for removed members) and the
+    fault-drawing policy."""
+
+    @property
+    def tick_no(self) -> int:
+        return self.plane.tick
+
+    @property
+    def down(self) -> set[int]:
+        return set(self.plane.crashed)
+
+    def _deliver_matured(self) -> None:
+        """Deliver delayed messages whose virtual delivery tick arrived;
+        traffic to a down or removed node is lost (as on a real network)."""
+        still = []
+        for when, dst, m in self.delayed:
+            if when <= self.tick_no:
+                e = self.engines[dst]
+                if e is not None and not self.plane.is_down(dst):
+                    e.receive(m)
+            else:
+                still.append((when, dst, m))
+        self.delayed = still
+
+    def _route_outbound(self, src: int, outbound) -> None:
+        """Route one engine's outbox through the fault plane: deliver now,
+        schedule a delayed copy, or lose it — the plane decides."""
+        for m in expand_outbound(outbound):
+            if self.engines[m.dst] is None:
+                continue
+            for when, msg in self.plane.route(src, m.dst, m):
+                if when <= self.tick_no:
+                    self.engines[msg.dst].receive(msg)
+                else:
+                    self.delayed.append((when, msg.dst, msg))
+
+    def harvest_acks(self) -> None:
+        still = []
+        for g, payload, fut in self.pending:
+            if fut.done():
+                if not fut.cancelled() and fut.exception() is None:
+                    self.acked[g].append(payload)
+                    self.ack_tick[payload] = self.tick_no
+            else:
+                still.append((g, payload, fut))
+        self.pending = still
+
+
+class ChaosCluster(_PlaneDrivenCluster):
+    """One chaotic cluster run with deterministic randomness.
+
+    ``window``/``params`` let the windowed-dispatch suite reuse this harness
+    instead of growing a second fault model: live engines then step
+    ``suggest_window(window)`` fused ticks per dispatch (params must allow
+    it — the window clamps to hb_ticks). ``sparse``/``k_out`` force the
+    sparse packed-IO bridge with a tiny compaction capacity, so chaos
+    bursts exercise overflow growth, the dense fallback fetch, and the
+    quiet-run shrink — under crashes, not just fault-free equality.
+
+    ``auto_crash``/``auto_links`` enable the background random crash and
+    directed-partition generators (the classic fuzz mode); nemesis-driven
+    runs usually disable them so the schedule is the only structured fault
+    source (probabilistic drop/dup/delay noise stays on via ``net``).
+    """
+
+    def __init__(self, seed: int, n_nodes: int = 3, groups: int = 2,
+                 window: int = 1, params=DEFAULT_PARAMS,
+                 sparse: bool = False, k_out: int | None = None,
+                 plane: FaultPlane | None = None, net: NetFaults | None = None,
+                 auto_crash: bool = True, auto_links: bool = True,
+                 propose_rate: float = 0.15, max_proposals: int = 40):
+        self.plane = plane or FaultPlane(seed, n_nodes, net=net)
+        self.rng = self.plane.rng  # one RNG: the whole run replays from seed
+        self.N = n_nodes
+        self.G = groups
+        self.window = window
+        self.params = params
+        self.sparse = sparse
+        self.k_out = k_out
+        self.auto_crash = auto_crash
+        self.auto_links = auto_links
+        self.propose_rate = propose_rate
+        self.max_proposals = max_proposals
+        self.ids = list(range(1, n_nodes + 1))
+        self.kvs = [MemKV() for _ in range(n_nodes)]
+        # One FSM per (node, group): apply order is only defined per group.
+        self.fsms = [[SnapFsm() for _ in range(groups)] for _ in range(n_nodes)]
+        self.engines = [self._make(i) for i in range(n_nodes)]
+        self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
+        self.ledger = invariants.ElectionSafetyLedger()
+        self.acked: dict[int, list[bytes]] = {g: [] for g in range(groups)}
+        self.pending: list[tuple[int, bytes, object]] = []
+        self.proposed = 0
+        self.submit_tick: dict[bytes, int] = {}
+        self.ack_tick: dict[bytes, int] = {}
+
+    def _make(self, i: int) -> RaftEngine:
+        self.fsms[i] = [SnapFsm() for _ in range(self.G)]
+        e = RaftEngine(
+            self.kvs[i], self.ids, self.ids[i], groups=self.G,
+            fsms={g: self.fsms[i][g] for g in range(self.G)},
+            params=self.params, base_seed=100 + i,
+            snapshot_threshold=6,
+            sparse_io=True if self.sparse else None,
+        )
+        if self.k_out is not None:
+            e._k_out = self.k_out
+        return e
+
+    # ------------------------------------------------------ nemesis queries
+
+    def live_nodes(self) -> list[int]:
+        return [i for i in range(self.N) if not self.plane.is_down(i)]
+
+    def leader_node(self, group: int = 0) -> int | None:
+        for i in self.live_nodes():
+            if self.engines[i].is_leader(group):
+                return i
+        return None
+
+    # ----------------------------------------------------------- invariants
+
+    def _live_engines(self):
+        return [(i, self.engines[i]) for i in self.live_nodes()]
+
+    def check_election_safety(self):
+        self.ledger.check(self._live_engines(), self.G)
+
+    def check_log_matching(self):
+        invariants.check_log_matching({
+            g: [self.fsms[i][g].applied for i in range(self.N)]
+            for g in range(self.G)
+        })
+
+    # ---------------------------------------------------------------- chaos
+
+    def step(self, nemesis=None):
+        """One virtual tick: advance the plane (revivals), apply nemesis
+        steps, optionally draw background crash/link faults, deliver matured
+        delayed messages, tick live engines through the chaotic network,
+        check safety."""
+        for i in self.plane.advance(1):
+            # Durable restart: fresh engine over the same KV (FSM rebuilt
+            # via snapshot restore + replay).
+            self.engines[i] = self._make(i)
+        if nemesis is not None:
+            nemesis.apply()
+
+        # Background faults (the fuzz mode): maybe crash one node (only if
+        # everyone else is up — keep quorum), maybe block one directed link
+        # (at most one at a time, never while a node is down, so some
+        # quorum path stays alive and the write path keeps being exercised).
+        if self.auto_crash and not self.plane.crashed and self.rng.random() < 0.02:
+            i = self.rng.randrange(self.N)
+            self.plane.crash(i, until=self.tick_no + self.rng.randint(10, 40))
+        if (self.auto_links and not self.plane.blocked
+                and not self.plane.crashed and self.rng.random() < 0.015):
+            src = self.rng.randrange(self.N)
+            dst = self.rng.choice([j for j in range(self.N) if j != src])
+            self.plane.block_link(src, dst,
+                                  until=self.tick_no + self.rng.randint(15, 40))
+
+        self._deliver_matured()
+
+        # Tick live engines, route outbound through the fault plane.
+        for i in self.live_nodes():
+            if not self.plane.should_tick(i):
+                continue  # pacer skew: this node is slow
+            e = self.engines[i]
+            res = e.tick(window=e.suggest_window(self.window))
+            self._route_outbound(i, res.outbound)
+
+        self.check_election_safety()
+        if self.tick_no % 10 == 0:
+            self.check_log_matching()
+
+    def maybe_propose(self):
+        if self.rng.random() > self.propose_rate or self.proposed >= self.max_proposals:
+            return
+        g = self.rng.randrange(self.G)
+        # Propose on the node that believes it leads (if any); chaos means
+        # it may be deposed — failures are fine, only acks must be durable.
+        for i in self.live_nodes():
+            e = self.engines[i]
+            if e.is_leader(g):
+                payload = b"p%d" % self.proposed
+                self.proposed += 1
+                self.submit_tick[payload] = self.tick_no
+                self.pending.append((g, payload, e.propose(g, payload)))
+                return
+
+    def heal(self, ticks: int = 120):
+        """Everyone up, clean network (no drops/dups/partitions/skew), run
+        to convergence — the shared epilogue of every chaos run."""
+        self.plane.heal_all()
+        for i in list(self.plane.crashed):
+            self.plane.crashed.pop(i)
+            self.engines[i] = self._make(i)
+            self.plane._event("node_restarted", node=i)
+        # Heal-phase delivery is direct (no plane routing): the epilogue is
+        # a clean network by definition, and keeping it off the RNG keeps
+        # the fault-event log a pure record of the chaotic phase.
+        for _ in range(ticks):
+            self.plane.advance(1)
+            for _, dst, m in self.delayed:
+                self.engines[dst].receive(m)
+            self.delayed = []
+            for e in self.engines:
+                res = e.tick(window=e.suggest_window(self.window))
+                for m in res.outbound:
+                    self.engines[m.dst].receive(m)
+            self.check_election_safety()
+
+    def assert_converged_and_linearizable(self):
+        """Single agreed leader per group; identical chains and FSM logs;
+        every acked write durable, exactly-once, in real-time order."""
+        for g in range(self.G):
+            invariants.check_converged(
+                [(i, self.engines[i]) for i in range(self.N)],
+                [self.fsms[i][g].applied for i in range(self.N)],
+                self.acked[g], self.submit_tick, self.ack_tick, g)
+        self.check_log_matching()
+
+    def state_digest(self) -> dict:
+        """A JSON-safe fingerprint of the converged cluster: per-group
+        (head, committed, term) plus every node's applied FSM sequence.
+        Two same-seed runs must produce identical digests."""
+        return {
+            "groups": {
+                str(g): {
+                    "head": int(self.engines[0].chains[g].head),
+                    "committed": int(self.engines[0].chains[g].committed),
+                    "terms": [int(self.engines[i].term(g)) for i in range(self.N)],
+                    "logs": [[p.decode("latin1") for p in self.fsms[i][g].applied]
+                             for i in range(self.N)],
+                }
+                for g in range(self.G)
+            },
+            "acked": {str(g): [p.decode("latin1") for p in self.acked[g]]
+                      for g in range(self.G)},
+        }
+
+
+class MembershipChaosCluster(_PlaneDrivenCluster):
+    """Chaos + runtime membership churn: a 4th node is ADDed and REMOVEd
+    through group-0 conf blocks WHILE the fault plane drops/dups/delays
+    messages and crashes nodes, and snapshots install (threshold 5 keeps
+    conf blocks falling below truncation floors, so joiners exercise the
+    member-table-over-snapshot path)."""
+
+    MAX = 4  # node slots; ids 1..4, node 4 churns
+
+    def __init__(self, seed: int, groups: int = 2):
+        self.plane = FaultPlane(seed, self.MAX)
+        self.rng = self.plane.rng
+        self.G = groups
+        self.ids = [1, 2, 3, 4]
+        self.kvs = [MemKV() for _ in range(self.MAX)]
+        self.fsms = [[SnapFsm() for _ in range(groups)] for _ in range(self.MAX)]
+        self.engines: list[RaftEngine | None] = [
+            self._make(i, [1, 2, 3]) for i in range(3)] + [None]
+        self.delayed: list[tuple[int, int, object]] = []
+        self.ledger = invariants.ElectionSafetyLedger()
+        self.acked: dict[int, list[bytes]] = {g: [] for g in range(groups)}
+        self.pending: list[tuple[int, bytes, object]] = []
+        self.proposed = 0
+        self.submit_tick: dict[bytes, int] = {}
+        self.ack_tick: dict[bytes, int] = {}
+        self.conf_fut = None
+        self.adds_committed = 0
+        self.removes_committed = 0
+
+    def _make(self, i: int, member_ids) -> RaftEngine:
+        self.fsms[i] = [SnapFsm() for _ in range(self.G)]
+        return RaftEngine(
+            self.kvs[i], list(member_ids), self.ids[i], groups=self.G,
+            fsms={g: self.fsms[i][g] for g in range(self.G)},
+            params=DEFAULT_PARAMS, base_seed=200 + i,
+            snapshot_threshold=5, max_nodes=self.MAX,
+        )
+
+    def _boot_ids(self, i: int) -> list[int]:
+        """Restart bootstrap list: the node's original config (the durable
+        member table overrides it when present)."""
+        return [1, 2, 3] if i < 3 else [1, 2, 3, 4]
+
+    # ------------------------------------------------------------- helpers
+
+    def live(self):
+        return [(i, e) for i, e in enumerate(self.engines)
+                if e is not None and not self.plane.is_down(i)]
+
+    def leader_engine(self, g=0):
+        for _i, e in self.live():
+            if e.is_leader(g):
+                return e
+        return None
+
+    def node4_is_member(self) -> bool:
+        """The cluster's view: does any live engine's committed member table
+        have node 4 active? (Conf futures can be lost to leader churn, so
+        the driver watches the tables, not the futures.)"""
+        e = self.leader_engine() or (self.live()[0][1] if self.live() else None)
+        return e is not None and any(
+            m.node_id == 4 and m.active for m in e.members.by_id.values())
+
+    # ------------------------------------------------------------- checks
+
+    def check_election_safety(self):
+        self.ledger.check(self.live(), self.G)
+
+    def check_log_matching(self):
+        invariants.check_log_matching({
+            g: [self.fsms[i][g].applied
+                for i in range(self.MAX) if self.engines[i] is not None]
+            for g in range(self.G)
+        })
+
+    # -------------------------------------------------------------- chaos
+
+    def step(self):
+        for i in self.plane.advance(1):
+            # Durable restart over the same KV (exercises replay of conf
+            # blocks + snapshot restore mid-chaos). Core nodes restart with
+            # their ORIGINAL bootstrap list — only the durable member table
+            # (i.e. a committed ADD) may introduce node 4; restarting with
+            # [1,2,3,4] would fabricate membership on a node that crashed
+            # before the table was ever persisted.
+            self.engines[i] = self._make(i, self._boot_ids(i))
+        if not self.plane.crashed and self.rng.random() < 0.02:
+            cands = [i for i, _ in self.live()]
+            if len(cands) > 2:  # keep a quorum of the 3 core nodes possible
+                i = self.rng.choice(cands)
+                self.plane.crash(i, until=self.tick_no + self.rng.randint(10, 40))
+
+        self._deliver_matured()
+
+        for i, e in self.live():
+            res = e.tick()
+            self._route_outbound(i, res.outbound)
+
+        self.check_election_safety()
+        if self.tick_no % 10 == 0:
+            self.check_log_matching()
+
+    def drive_membership(self):
+        """The churn driver: converge the engine-4 process toward the
+        cluster's committed membership, and randomly flip that membership
+        through conf proposals."""
+        member = self.node4_is_member()
+        if member and self.engines[3] is None:
+            # Cluster says node 4 is in; boot it with a FRESH disk (worst
+            # case: must catch up purely by replay or snapshot install).
+            self.kvs[3] = MemKV()
+            self.engines[3] = self._make(3, [1, 2, 3, 4])
+            self.adds_committed += 1
+        elif (not member and self.engines[3] is not None
+                and not self.plane.is_down(3)):
+            self.engines[3] = None  # committed removal: stop the process
+            self.removes_committed += 1
+
+        if self.conf_fut is not None and not self.conf_fut.done():
+            return  # one change in flight
+        self.conf_fut = None
+        if self.rng.random() > 0.04:
+            return
+        lead = self.leader_engine(0)
+        if lead is None:
+            return
+        try:
+            if member:
+                self.conf_fut = lead.propose_conf(
+                    ConfChange(op=REMOVE, node_id=4))
+            else:
+                self.conf_fut = lead.propose_conf(
+                    ConfChange(op=ADD, node_id=4, ip="x", port=4))
+        except Exception:
+            self.conf_fut = None
+
+    def drive_membership_settled(self):
+        """Heal-phase driver: no new conf proposals, but still converge the
+        engine-4 process with whatever membership committed (an ADD/REMOVE
+        may land during healing)."""
+        member = self.node4_is_member()
+        if member and self.engines[3] is None:
+            self.kvs[3] = MemKV()
+            self.engines[3] = self._make(3, [1, 2, 3, 4])
+            self.adds_committed += 1
+        elif not member and self.engines[3] is not None:
+            self.engines[3] = None
+            self.removes_committed += 1
+
+    def maybe_propose(self):
+        if self.rng.random() > 0.15 or self.proposed >= 40:
+            return
+        g = self.rng.randrange(self.G)
+        for _i, e in self.live():
+            if e.is_leader(g):
+                payload = b"m%d" % self.proposed
+                self.proposed += 1
+                self.submit_tick[payload] = self.tick_no
+                self.pending.append((g, payload, e.propose(g, payload)))
+                return
+
+    def heal(self, ticks: int = 150):
+        """Settle: revive crashes, stop driving conf changes, clean network
+        to convergence (membership still converges to whatever committed)."""
+        self.plane.heal_all()
+        for i in list(self.plane.crashed):
+            self.plane.crashed.pop(i)
+            self.engines[i] = self._make(i, self._boot_ids(i))
+        for _ in range(ticks):
+            self.plane.advance(1)
+            for _, dst, m in self.delayed:
+                if self.engines[dst] is not None:
+                    self.engines[dst].receive(m)
+            self.delayed = []
+            for _i, e in self.live():
+                res = e.tick()
+                for m in res.outbound:
+                    if self.engines[m.dst] is not None:
+                        self.engines[m.dst].receive(m)
+            self.drive_membership_settled()
+            self.check_election_safety()
+
+    def assert_converged_and_linearizable(self):
+        active = [(i, e) for i, e in enumerate(self.engines) if e is not None]
+        for g in range(self.G):
+            invariants.check_converged(
+                active,
+                [self.fsms[i][g].applied for i, _ in active],
+                self.acked[g], self.submit_tick, self.ack_tick, g)
+        self.check_log_matching()

@@ -1,0 +1,152 @@
+"""The Raft safety invariants, shared by tests, the soak CLI, and CI.
+
+Extracted from the previously test-private checkers in
+``tests/test_chaos.py`` / ``tests/test_node_chaos.py`` so every consumer
+enforces ONE implementation:
+
+* **election safety** — at most one leader per (group, term), across the
+  whole run (a cross-tick ledger, not a point check);
+* **durability** — every client-acknowledged payload survives on every
+  node at the end;
+* **log matching** — all nodes apply the same FSM sequence per group
+  (prefix-closed during chaos, identical after healing);
+* **convergence** — after the network heals: one agreed leader, identical
+  chain heads/commits, identical FSM logs;
+* **linearizability** — acked writes applied exactly once, respecting
+  real-time precedence (an ack that happened before another's submission
+  must be applied first);
+* **replica log contract** (node-level byte logs) — acked records durable,
+  first occurrences in ack order, identical bytes across replicas
+  (at-least-once is the contract without idempotence, as in Kafka).
+
+Violations raise :class:`InvariantViolation` (an AssertionError, so pytest
+suites keep their semantics and the soak tool can catch one type).
+"""
+
+from __future__ import annotations
+
+
+class InvariantViolation(AssertionError):
+    """A Raft safety invariant failed under chaos."""
+
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise InvariantViolation(msg)
+
+
+class ElectionSafetyLedger:
+    """Cross-tick election-safety bookkeeping: remembers which node won
+    each (group, term) and flags any second claimant — including one that
+    appears many ticks later (a point-in-time check would miss a stale
+    resurgent leader)."""
+
+    def __init__(self):
+        self.leaders_by_term: dict[tuple[int, int], int] = {}
+
+    def check(self, live_engines, groups: int) -> None:
+        """``live_engines``: iterable of (node_index, engine) for nodes
+        currently up. Call every tick."""
+        for i, e in live_engines:
+            for g in range(groups):
+                if e.is_leader(g):
+                    key = (g, e.term(g))
+                    prev = self.leaders_by_term.setdefault(key, i)
+                    _require(prev == i,
+                             f"two leaders for group {g} term {key[1]}: "
+                             f"{prev} and {i}")
+
+
+def check_log_matching(logs_per_group: dict[int, list[list[bytes]]]) -> None:
+    """``logs_per_group[g]`` = each live node's applied-FSM sequence for
+    group g. All pairs must be prefix-compatible (divergence at any index
+    breaks the log-matching property)."""
+    for g, logs in logs_per_group.items():
+        for a in logs:
+            for b in logs:
+                n = min(len(a), len(b))
+                _require(a[:n] == b[:n],
+                         f"divergent FSM sequences in group {g}")
+
+
+def check_durability(acked: list[bytes], applied: list[bytes], group: int) -> None:
+    """Every acked payload must appear in the (converged) applied log."""
+    applied_set = set(applied)
+    for payload in acked:
+        _require(payload in applied_set,
+                 f"acked payload {payload!r} lost after chaos (group {group})")
+
+
+def check_linearizable(acked: list[bytes], applied: list[bytes],
+                       submit_tick: dict[bytes, int],
+                       ack_tick: dict[bytes, int], group: int) -> None:
+    """Client-visible linearizability for the log FSM. Payloads are unique,
+    every write goes through Raft commit, and the applied sequence IS the
+    serialization — so linearizability reduces to (1) every acked payload
+    applied exactly once, and (2) real-time precedence: a payload acked
+    before another was even *submitted* must precede it in the applied
+    order. Tick bounds are conservative (the recorded ack tick is the
+    harvest tick, >= the true completion), so every pair this compares is a
+    genuine happened-before — no false positives under reordering."""
+    idx: dict[bytes, list[int]] = {}
+    for i, p in enumerate(applied):
+        idx.setdefault(p, []).append(i)
+    for p in acked:
+        _require(len(idx.get(p, ())) == 1,
+                 f"acked payload {p!r} applied {len(idx.get(p, ()))}x "
+                 f"(group {group})")
+    for a in acked:
+        for b in acked:
+            if ack_tick[a] < submit_tick[b]:
+                _require(idx[a][0] < idx[b][0],
+                         f"real-time order violated (group {group}): {a!r} "
+                         f"acked at tick {ack_tick[a]}, before {b!r} was "
+                         f"submitted at tick {submit_tick[b]}, yet applies "
+                         f"later")
+
+
+def check_converged(engines_by_node, fsm_logs_by_node, acked: list[bytes],
+                    submit_tick: dict[bytes, int], ack_tick: dict[bytes, int],
+                    group: int) -> None:
+    """The post-heal epilogue for one group: single agreed leader,
+    identical chains and FSM logs, then durability + linearizability.
+    ``engines_by_node``: list of (node_index, engine); ``fsm_logs_by_node``:
+    the same nodes' applied sequences for this group."""
+    leads = [i for i, e in engines_by_node if e.is_leader(group)]
+    _require(len(leads) == 1, f"group {group}: leaders {leads}")
+    heads = {e.chains[group].head for _, e in engines_by_node}
+    commits = {e.chains[group].committed for _, e in engines_by_node}
+    _require(len(heads) == 1 and len(commits) == 1,
+             f"group {group} failed to converge: heads={heads} "
+             f"commits={commits}")
+    logs = fsm_logs_by_node
+    _require(all(l == logs[0] for l in logs), f"group {group} logs differ")
+    check_durability(acked, logs[0], group)
+    check_linearizable(acked, logs[0], submit_tick, ack_tick, group)
+
+
+def check_replica_log_contract(per_node_bytes: list[bytes],
+                               acked: list[bytes], part: int,
+                               payload_pattern: bytes | None = None) -> None:
+    """Node-level (whole-stack) contract over raw partition log bytes:
+    identical across replicas; every acked record present with first
+    occurrences in ack order. At-least-once is the contract (a timed-out
+    attempt can commit and its retry commit again; Kafka without
+    idempotence is the same) — every ACK must be durable, and first
+    occurrences must respect ack order for a sequential producer."""
+    first = per_node_bytes[0]
+    if not all(d == first for d in per_node_bytes):
+        detail = ""
+        if payload_pattern is not None:
+            import re
+            orders = [re.findall(payload_pattern, d) for d in per_node_bytes]
+            detail = f": orders={orders}"
+        raise InvariantViolation(
+            f"partition {part}: replica logs diverge "
+            f"({[len(d) for d in per_node_bytes]} bytes){detail}")
+    pos = -1
+    for payload in acked:
+        at = first.find(payload)
+        _require(at != -1, f"ACKED record {payload!r} lost (p{part})")
+        _require(at > pos, f"record {payload!r} out of ack order (p{part})")
+        pos = at

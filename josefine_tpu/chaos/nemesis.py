@@ -1,0 +1,242 @@
+"""Nemesis: named, composable, JSON-serializable fault schedules.
+
+A :class:`Schedule` is a list of virtual-tick-stamped steps — the repro
+artifact for a chaos run (``Schedule.to_json()`` + the run seed fully
+determine the fault history). A :class:`Nemesis` replays a schedule into a
+:class:`josefine_tpu.chaos.faults.FaultPlane` as the harness's clock
+advances, resolving dynamic targets ("the current leader of group 0")
+against the live cluster at apply time.
+
+Step ops (the DSL):
+
+``block_link {src,dst,for}``        directed link loss
+``heal_link {src,dst}``
+``partition {a,b,for,symmetric}``   group A <-/-> group B
+``isolate {node|target,for,symmetric,group}``  one node vs everyone
+``heal_all {}``
+``crash {node|target,for,group}``   whole-node crash (+auto restart)
+``restart {node}``
+``disk {node|target,fault,p,for,group}``  arm a disk fault class
+``skew {node|target,stride}``       slow a node's pacer
+
+``node`` is a 0-based index; ``target`` may be ``"leader"`` or
+``"follower"`` (resolved per group at apply time; unresolvable targets are
+skipped and recorded, never fatal — a leaderless tick simply has no leader
+to shoot).
+
+The bundled schedules (:data:`SCHEDULES`) cover the classic nemeses:
+``leader-partition``, ``minority-partition``, ``flapping-link``,
+``slow-disk``, ``crash-loop``, ``skewed-pacer``. Every one must pass the
+full invariant suite — ``tools/chaos_soak.py`` enforces that, and the CI
+smoke runs one end-to-end.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from josefine_tpu.chaos.faults import FaultPlane
+
+_OPS = ("block_link", "heal_link", "partition", "isolate", "heal_all",
+        "crash", "restart", "disk", "skew")
+
+
+@dataclass
+class Step:
+    at: int
+    op: str
+    args: dict = field(default_factory=dict)
+
+    def __post_init__(self):
+        assert self.op in _OPS, f"unknown nemesis op {self.op!r}"
+
+
+@dataclass
+class Schedule:
+    """A named fault plan: steps over a run of ``horizon`` chaos ticks,
+    then ``heal_ticks`` of clean network to convergence."""
+
+    name: str
+    steps: list[Step]
+    horizon: int
+    heal_ticks: int = 140
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "name": self.name,
+            "horizon": self.horizon,
+            "heal_ticks": self.heal_ticks,
+            "steps": [{"at": s.at, "op": s.op, **s.args} for s in self.steps],
+        }, sort_keys=True, indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Schedule":
+        d = json.loads(text)
+        steps = []
+        for raw in d["steps"]:
+            raw = dict(raw)
+            steps.append(Step(at=raw.pop("at"), op=raw.pop("op"), args=raw))
+        return cls(name=d["name"], steps=steps, horizon=d["horizon"],
+                   heal_ticks=d.get("heal_ticks", 140))
+
+    def then(self, other: "Schedule", gap: int = 40) -> "Schedule":
+        """Compose sequentially: other's steps shifted past this horizon."""
+        shifted = [Step(at=s.at + self.horizon + gap, op=s.op,
+                        args=dict(s.args)) for s in other.steps]
+        return Schedule(
+            name=f"{self.name}+{other.name}",
+            steps=self.steps + shifted,
+            horizon=self.horizon + gap + other.horizon,
+            heal_ticks=max(self.heal_ticks, other.heal_ticks),
+        )
+
+
+class Nemesis:
+    """Replays a schedule into a fault plane against a live cluster.
+
+    ``cluster`` only needs two lookups for dynamic targets:
+    ``leader_node(group) -> node index | None`` and
+    ``live_nodes() -> list[int]``.
+    """
+
+    def __init__(self, schedule: Schedule, plane: FaultPlane, cluster=None):
+        self.schedule = schedule
+        self.plane = plane
+        self.cluster = cluster
+        self._by_tick: dict[int, list[Step]] = {}
+        for s in schedule.steps:
+            self._by_tick.setdefault(s.at, []).append(s)
+
+    def done(self) -> bool:
+        return self.plane.tick >= self.schedule.horizon
+
+    def apply(self) -> None:
+        """Apply every step scheduled at the plane's current tick. Call once
+        per harness tick, right after the clock advances."""
+        for step in self._by_tick.get(self.plane.tick, ()):
+            self._apply(step)
+
+    # ------------------------------------------------------------- internals
+
+    def _resolve(self, args: dict) -> int | None:
+        if "node" in args:
+            return int(args["node"])
+        target = args.get("target", "leader")
+        group = int(args.get("group", 0))
+        if self.cluster is None:
+            return None
+        leader = self.cluster.leader_node(group)
+        if target == "leader":
+            return leader
+        if target == "follower":
+            for i in self.cluster.live_nodes():
+                if i != leader:
+                    return i
+        return None
+
+    def _until(self, args: dict) -> int | None:
+        dur = args.get("for")
+        return None if dur is None else self.plane.tick + int(dur)
+
+    def _apply(self, step: Step) -> None:
+        p, a = self.plane, step.args
+        if step.op == "block_link":
+            p.block_link(int(a["src"]), int(a["dst"]), until=self._until(a))
+        elif step.op == "heal_link":
+            p.heal_link(int(a["src"]), int(a["dst"]))
+        elif step.op == "partition":
+            p.partition(list(a["a"]), list(a["b"]), until=self._until(a),
+                        symmetric=bool(a.get("symmetric", True)))
+        elif step.op == "heal_all":
+            p.heal_all()
+        elif step.op in ("isolate", "crash", "disk", "skew"):
+            node = self._resolve(a)
+            if node is None:
+                p._event("nemesis_skipped", op=step.op, at=step.at)
+                return
+            if step.op == "isolate":
+                p.isolate(node, until=self._until(a),
+                          symmetric=bool(a.get("symmetric", True)))
+            elif step.op == "crash":
+                p.crash(node, until=self._until(a))
+            elif step.op == "disk":
+                p.arm_disk_fault(node, a["fault"], p=float(a.get("p", 1.0)),
+                                 until=self._until(a))
+            elif step.op == "skew":
+                p.set_skew(node, int(a["stride"]))
+        elif step.op == "restart":
+            p.restart(int(a["node"]))
+
+
+# --------------------------------------------------------- bundled schedules
+
+def leader_partition(n_nodes: int = 3) -> Schedule:
+    """Repeatedly cut the CURRENT leader off (symmetric): the classic
+    "deposed leader must step down, cluster must re-elect" nemesis."""
+    steps = [Step(at=t, op="isolate", args={"target": "leader", "for": 45})
+             for t in (60, 170, 280)]
+    return Schedule("leader-partition", steps, horizon=380)
+
+
+def minority_partition(n_nodes: int = 3) -> Schedule:
+    """Wall off a minority (last node): the majority side must keep
+    committing; the minority must never elect."""
+    minority = [n_nodes - 1]
+    majority = list(range(n_nodes - 1))
+    steps = [
+        Step(at=50, op="partition", args={"a": minority, "b": majority, "for": 70}),
+        Step(at=200, op="partition", args={"a": minority, "b": majority, "for": 70}),
+    ]
+    return Schedule("minority-partition", steps, horizon=330)
+
+
+def flapping_link(n_nodes: int = 3) -> Schedule:
+    """One asymmetric link (0 -> 1) flaps every 20 ticks: the receiver
+    hears heartbeats, the sender never hears responses — sustained one-way
+    loss a random drop rate cannot model."""
+    steps = [Step(at=t, op="block_link", args={"src": 0, "dst": 1, "for": 10})
+             for t in range(40, 280, 20)]
+    return Schedule("flapping-link", steps, horizon=320)
+
+
+def slow_disk(n_nodes: int = 3) -> Schedule:
+    """A follower's storage turns slow (stride-3 pacer skew: it steps one
+    tick in three, falling behind in protocol time), then recovers and must
+    catch back up without a term bump from its stale view."""
+    steps = [
+        Step(at=50, op="skew", args={"node": 1, "stride": 3}),
+        Step(at=220, op="skew", args={"node": 1, "stride": 1}),
+    ]
+    return Schedule("slow-disk", steps, horizon=300)
+
+
+def crash_loop(n_nodes: int = 3) -> Schedule:
+    """Rolling whole-node crash/restart: every 70 ticks another node dies
+    for 25 (fresh engine over the same durable KV on revival)."""
+    steps = [Step(at=50 + 70 * i, op="crash",
+                  args={"node": i % n_nodes, "for": 25})
+             for i in range(4)]
+    return Schedule("crash-loop", steps, horizon=380)
+
+
+def skewed_pacer(n_nodes: int = 3) -> Schedule:
+    """Every node ticks at a different rate for a stretch (strides 1/2/3):
+    timeout math must stay safe when protocol time itself is skewed."""
+    steps = [
+        Step(at=40, op="skew", args={"node": 1, "stride": 2}),
+        Step(at=40, op="skew", args={"node": 2, "stride": 3}),
+        Step(at=200, op="skew", args={"node": 1, "stride": 1}),
+        Step(at=200, op="skew", args={"node": 2, "stride": 1}),
+    ]
+    return Schedule("skewed-pacer", steps, horizon=300)
+
+
+SCHEDULES = {
+    "leader-partition": leader_partition,
+    "minority-partition": minority_partition,
+    "flapping-link": flapping_link,
+    "slow-disk": slow_disk,
+    "crash-loop": crash_loop,
+    "skewed-pacer": skewed_pacer,
+}

@@ -1,0 +1,92 @@
+"""The programmatic soak runner behind ``tools/chaos_soak.py``.
+
+``run_soak(seed, schedule)`` drives a :class:`ChaosCluster` through a
+nemesis schedule on the virtual clock, heals, enforces every safety
+invariant, and returns a result dict carrying the byte-stable fault-event
+log. Reproducibility is the contract: two runs with the same (seed,
+schedule) produce identical event logs and identical final cluster state
+— pinned by ``tests/test_chaos_determinism.py`` and relied on whenever a
+soak finding needs a deterministic reproducer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from josefine_tpu.chaos.faults import FaultPlane, NetFaults
+from josefine_tpu.chaos.harness import ChaosCluster
+from josefine_tpu.chaos.invariants import InvariantViolation
+from josefine_tpu.chaos.nemesis import SCHEDULES, Nemesis, Schedule
+from josefine_tpu.utils.metrics import REGISTRY
+
+
+def resolve_schedule(name_or_schedule, n_nodes: int = 3) -> Schedule:
+    """A Schedule passes through; a bundled name builds one; a string of
+    JSON (or anything with a ``read``) parses the DSL."""
+    if isinstance(name_or_schedule, Schedule):
+        return name_or_schedule
+    if name_or_schedule in SCHEDULES:
+        return SCHEDULES[name_or_schedule](n_nodes)
+    return Schedule.from_json(name_or_schedule)
+
+
+async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
+                         groups: int = 2, window: int = 1,
+                         net: NetFaults | None = None,
+                         auto_faults: bool = False,
+                         horizon: int | None = None) -> dict:
+    """One soak run. ``auto_faults`` additionally layers the background
+    random crash/partition generators over the schedule (hostile mode);
+    default is schedule + probabilistic message noise only, which is what
+    the bundled schedules' invariant guarantees are stated against."""
+    sched = resolve_schedule(schedule, n_nodes)
+    plane = FaultPlane(seed, n_nodes, net=net)
+    cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
+                           window=window, plane=plane,
+                           auto_crash=auto_faults, auto_links=auto_faults)
+    nemesis = Nemesis(sched, plane, cluster)
+    ticks = sched.horizon if horizon is None else horizon
+
+    # The whole drive sits inside the violation net: election safety and
+    # log matching are checked every tick DURING chaos, and a mid-run
+    # violation must still yield the summary + the event log (the repro
+    # artifact is the entire point of catching one).
+    violation = None
+    try:
+        for _ in range(ticks):
+            cluster.step(nemesis=nemesis)
+            cluster.maybe_propose()
+            cluster.harvest_acks()
+            await asyncio.sleep(0)  # let engine futures resolve
+        cluster.heal(sched.heal_ticks)
+        cluster.harvest_acks()
+        cluster.assert_converged_and_linearizable()
+    except InvariantViolation as e:
+        violation = str(e)
+
+    acked_total = sum(len(v) for v in cluster.acked.values())
+    return {
+        "schedule": sched.name,
+        "seed": seed,
+        "nodes": n_nodes,
+        "groups": groups,
+        "window": window,
+        "ticks": cluster.tick_no,
+        "proposed": cluster.proposed,
+        "acked": acked_total,
+        "fault_events": len(plane.events),
+        "chaos_counters": {
+            name: m.values.get((), sum(m.values.values()))
+            for name, m in sorted(REGISTRY._metrics.items())
+            if name.startswith("chaos_")
+        },
+        "invariants": "ok" if violation is None else "VIOLATED",
+        "violation": violation,
+        "event_log": plane.event_log_jsonl(),
+        "schedule_json": sched.to_json(),
+        "state_digest": cluster.state_digest(),
+    }
+
+
+def run_soak(*args, **kwargs) -> dict:
+    return asyncio.run(run_soak_async(*args, **kwargs))

@@ -19,7 +19,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11
+    import tomli as tomllib
 from dataclasses import dataclass, field
 
 

@@ -30,6 +30,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from josefine_tpu.models import chained_raft as cr
 from josefine_tpu.models.types import Msgs, NodeState, StepParams
 
+# shard_map stabilized as jax.shard_map (replication-check kwarg renamed
+# check_rep -> check_vma); older jax in this image only has the
+# experimental form. Resolve once at import so the call site is
+# version-agnostic.
+try:
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+except AttributeError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
 _I32 = jnp.int32
 
 
@@ -121,11 +132,11 @@ def make_sharded_cluster_step(mesh: Mesh, N: int):
         accepted_blocks=0, accepted_msgs=0, minted=0, commit_delta=0, became_leader=0))
 
     member_spec = P("p", None)
-    stepped = jax.shard_map(
+    stepped = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(params_spec, member_spec, state_specs, msg_specs, pn),
         out_specs=(state_specs, msg_specs, met_specs),
-        check_vma=False,
+        **{_CHECK_KW: False},
     )
     return jax.jit(stepped, donate_argnums=(2, 3))

@@ -34,6 +34,9 @@ class WallClockPacer:
     async def acquire(self, key, want: int) -> int:
         return want
 
+    def release(self, key, n: int) -> None:
+        """Return unused ticks (wall clock mints them freely — no-op)."""
+
     async def pace(self, key, executed: int, tick_s: float, elapsed_s: float) -> None:
         await asyncio.sleep(max(0.0, tick_s * executed - elapsed_s))
 
@@ -75,6 +78,16 @@ class LockstepPacer:
         got = min(st["permits"], max(1, want))
         st["permits"] -= got
         return got
+
+    def release(self, key, n: int) -> None:
+        """Return surplus granted ticks (the driver clamped its window after
+        acquiring — see server._tick_loop). The permits go back to the pool
+        so an ``advance(k)`` still executes exactly k ticks on this node,
+        just in smaller windows; without this, clamping would silently eat
+        granted ticks and skew the virtual clock across nodes."""
+        st = self._nodes.get(key)
+        if st is not None and n > 0:
+            st["permits"] += n
 
     async def pace(self, key, executed: int, tick_s: float, elapsed_s: float) -> None:
         st = self._nodes.get(key)

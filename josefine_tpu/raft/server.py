@@ -59,6 +59,8 @@ class JosefineRaft:
         backend: str = "jax",
         mesh=None,
         pacer=None,
+        intercept_send=None,
+        intercept_recv=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -103,6 +105,8 @@ class JosefineRaft:
             addr_by_id,
             self._on_message,
             self.shutdown,
+            intercept_send=intercept_send,
+            intercept_recv=intercept_recv,
         )
         self._inbound_client: list[rpc.WireMsg] = []
         self._forwarded: dict[str, asyncio.Future] = {}
@@ -308,8 +312,17 @@ class JosefineRaft:
                 # one device dispatch; elections/snapshots/parole drop back
                 # to single ticks (engine.suggest_window). The pacer may
                 # clamp further (a lockstep harness grants ticks one at a
-                # time) or block until ticks are granted at all.
-                w = await self.pacer.acquire(self, self.engine.suggest_window(max_window))
+                # time) or block until ticks are granted at all. acquire()
+                # can park indefinitely (LockstepPacer), so the window hint
+                # is evaluated AFTER it returns: a hint computed before
+                # parking can be stale by grant time (e.g. a group went
+                # leaderless while parked — a >1 window would quantize its
+                # election timeouts to window boundaries and de-randomize
+                # candidacy). Surplus granted ticks go back to the pacer.
+                got = await self.pacer.acquire(self, max_window)
+                w = min(got, self.engine.suggest_window(max_window))
+                if got > w:
+                    self.pacer.release(self, got - w)
                 res = self.engine.tick(window=w)
                 for ch in res.conf_changes:
                     if ch.node_id == self.config.id:

@@ -62,7 +62,15 @@ class Transport:
         peers: dict[int, tuple[str, int]],  # node id -> (ip, port)
         on_message: Callable[[WireMsg], None],
         shutdown: Shutdown,
+        intercept_send: Callable[[int, object], bool] | None = None,
+        intercept_recv: Callable[[object], bool] | None = None,
     ):
+        # Chaos hook points (josefine_tpu/chaos/faults.py): predicates
+        # consulted per outbound (peer_id, msg) / inbound (msg); returning
+        # False swallows the message (injected loss / partition). Both are
+        # None by default — the production hot path pays one is-None check.
+        self._intercept_send = intercept_send
+        self._intercept_recv = intercept_recv
         self.self_id = self_id
         self.bind_addr = bind_addr
         self.peers = peers
@@ -128,6 +136,8 @@ class Transport:
         """Enqueue; full queue drops the message (reference tcp.rs:90-96 —
         Raft tolerates loss, retry comes from the protocol itself).
         Consensus batches coalesce into a 1-slot newest-wins mailbox."""
+        if self._intercept_send is not None and not self._intercept_send(peer_id, msg):
+            return  # injected loss (chaos): the fault plane counts it
         q = self._queues.get(peer_id)
         if q is None:
             log.warning("send to unknown peer %d", peer_id)
@@ -170,6 +180,8 @@ class Transport:
                     log.warning("undecodable frame (%d bytes); closing conn", len(body))
                     break
                 _m_received.inc(node=self.self_id)
+                if self._intercept_recv is not None and not self._intercept_recv(msg):
+                    continue  # injected inbound loss (chaos)
                 self.on_message(msg)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass

@@ -18,6 +18,15 @@ import threading
 from typing import Iterator
 
 
+class DiskFault(OSError):
+    """An injected storage failure (chaos testing).
+
+    Raised by :class:`InterceptedKV` / :class:`josefine_tpu.broker.log.Log`
+    when an armed fault hook decides an operation fails. Subclasses OSError
+    so code written for real disk errors handles injected ones identically.
+    """
+
+
 class KV:
     """Interface: bytes -> bytes with prefix scans."""
 
@@ -126,6 +135,46 @@ class SqliteKV(KV):
     def close(self):
         with self._lock:
             self._db.close()
+
+
+class InterceptedKV(KV):
+    """Fault-wrapping decorator: consult ``hook(op, key)`` before every
+    operation, then delegate to the wrapped store.
+
+    The chaos hook point for storage (see ``josefine_tpu/chaos/faults.py``,
+    which builds the hooks): the hook may raise :class:`DiskFault` to fail
+    the op with nothing written (a write error), or raise it on ``"flush"``
+    to model a failed fsync. This wrapper is only ever constructed when
+    fault injection is explicitly enabled — the default path keeps the
+    bare KV, so chaos-off costs nothing.
+    """
+
+    def __init__(self, inner: KV, hook):
+        self.inner = inner
+        self._hook = hook
+
+    def get(self, key):
+        self._hook("get", key)
+        return self.inner.get(key)
+
+    def put(self, key, value):
+        self._hook("put", key)
+        self.inner.put(key, value)
+
+    def delete(self, key):
+        self._hook("delete", key)
+        self.inner.delete(key)
+
+    def scan_prefix(self, prefix):
+        self._hook("scan", prefix)
+        return self.inner.scan_prefix(prefix)
+
+    def flush(self):
+        self._hook("flush", b"")
+        self.inner.flush()
+
+    def close(self):
+        self.inner.close()
 
 
 def open_kv(path: str | None, full_sync: bool = False) -> KV:

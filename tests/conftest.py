@@ -25,22 +25,21 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def expand_outbound(outbound):
-    """Flatten TickResult.outbound to per-message WireMsgs: columnar
-    MsgBatches expand via .messages(); WireMsgs pass through. Lets tests
-    inspect/fault-inject at single-message granularity."""
-    from josefine_tpu.raft import rpc
+    """Flatten TickResult.outbound to per-message WireMsgs so tests can
+    inspect/fault-inject at single-message granularity. One implementation,
+    shared with the chaos subsystem (imported lazily: the harness pulls in
+    the engine stack, which must not load before the jax config above)."""
+    from josefine_tpu.chaos.harness import expand_outbound as _expand
 
-    out = []
-    for m in outbound:
-        if isinstance(m, rpc.MsgBatch):
-            out.extend(m.messages())
-        else:
-            out.append(m)
-    return out
+    return _expand(outbound)
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: coroutine test (run via asyncio.run)")
+    config.addinivalue_line(
+        "markers",
+        "slow: outside the tier-1 time budget (deselected by -m 'not slow'; "
+        "the full CI suite still runs these)")
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -1,0 +1,231 @@
+"""Unit suite for the chaos fault plane and the product hook seams.
+
+The zero-cost-when-off contract matters as much as the faults themselves:
+``Transport``/``Log``/KV carry a None-default hook and construct no fault
+objects unless chaos is explicitly enabled. These tests pin both sides —
+the hooks fire when armed (KV write/fsync errors, torn seglog appends,
+transport interception) and the plane's draw sequence is a pure function
+of its seed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from josefine_tpu.broker.log import Log
+from josefine_tpu.chaos.faults import FaultPlane, NetFaults
+from josefine_tpu.chaos.nemesis import SCHEDULES, Nemesis, Schedule, Step
+from josefine_tpu.raft import rpc, tcp
+from josefine_tpu.utils.kv import DiskFault, InterceptedKV, MemKV
+from josefine_tpu.utils.shutdown import Shutdown
+
+
+# ---------------------------------------------------------------- KV faults
+
+def test_intercepted_kv_write_and_flush_faults():
+    plane = FaultPlane(5, 1)
+    kv = plane.wrap_kv(MemKV(), node=0)
+    assert isinstance(kv, InterceptedKV)
+
+    kv.put(b"k", b"v")  # nothing armed: transparent
+    assert kv.get(b"k") == b"v"
+
+    plane.arm_disk_fault(0, "kv_write", p=1.0)
+    with pytest.raises(DiskFault):
+        kv.put(b"k", b"v2")
+    with pytest.raises(DiskFault):
+        kv.delete(b"k")
+    # Reads and scans keep working; the store is untouched by failed writes.
+    assert kv.get(b"k") == b"v"
+    assert list(kv.scan_prefix(b"k")) == [(b"k", b"v")]
+
+    plane.arm_disk_fault(0, "kv_flush", p=1.0)
+    with pytest.raises(DiskFault):
+        kv.flush()
+
+    # Timed arming expires on the virtual clock.
+    plane.disk.clear()
+    plane.arm_disk_fault(0, "kv_write", p=1.0, until=plane.tick + 2)
+    plane.advance(2)
+    kv.put(b"k", b"v3")
+    assert kv.get(b"k") == b"v3"
+    fired = [e for e in plane.events if e["kind"] == "disk_fault_fired"]
+    assert len(fired) == 3
+
+
+# ------------------------------------------------------------ seglog faults
+
+def test_log_append_error_writes_nothing(tmp_path):
+    plane = FaultPlane(5, 1)
+    log = Log(tmp_path / "p0", io_hook=plane.log_hook(0))
+    log.append(b"alpha")
+    plane.arm_disk_fault(0, "log_append", p=1.0)
+    before = log.next_offset()
+    with pytest.raises(DiskFault):
+        log.append(b"beta")
+    assert log.next_offset() == before  # clean failure: nothing landed
+    plane.disk.clear()
+    log.append(b"gamma")
+    blobs = log.read_from(0, 1 << 20)
+    assert [b for _, _, b in blobs] == [b"alpha", b"gamma"]
+    log.close()
+
+
+def test_log_torn_append_leaves_partial_bytes(tmp_path):
+    plane = FaultPlane(9, 1)
+    log = Log(tmp_path / "p0", io_hook=plane.log_hook(0))
+    plane.arm_disk_fault(0, "log_torn", p=1.0)
+    with pytest.raises(DiskFault):
+        log.append(b"0123456789abcdef")
+    plane.disk.clear()
+    log.append(b"whole")
+    blobs = [b for _, _, b in log.read_from(0, 1 << 20)]
+    # The torn prefix IS on disk (that's the point — recovery code must
+    # cope with it), strictly shorter than the intended record.
+    assert len(blobs) == 2
+    assert b"0123456789abcdef".startswith(blobs[0])
+    assert 0 < len(blobs[0]) < 16
+    assert blobs[1] == b"whole"
+    torn = [e for e in plane.events if e["kind"] == "torn_append"]
+    assert torn and torn[0]["wrote"] == len(blobs[0])
+    log.close()
+
+
+def test_log_without_hook_is_untouched(tmp_path):
+    # The default path: no hook object, no chaos import, plain appends.
+    log = Log(tmp_path / "p0")
+    assert log._io_hook is None
+    log.append(b"x")
+    log.flush()
+    log.close()
+
+
+# ------------------------------------------------------------ network plane
+
+def test_route_blocked_link_and_partition():
+    plane = FaultPlane(1, 3, net=NetFaults.quiet())
+    msg = object()
+    assert plane.route(0, 1, msg) == [(plane.tick, msg)]
+    plane.block_link(0, 1)
+    assert plane.route(0, 1, msg) == []          # src->dst dead
+    assert plane.route(1, 0, msg) == [(plane.tick, msg)]  # asymmetric
+    plane.heal_link(0, 1)
+    plane.partition([0], [1, 2], until=plane.tick + 5)
+    assert plane.route(0, 2, msg) == []
+    assert plane.route(2, 0, msg) == []          # symmetric
+    plane.advance(5)                              # heals on the clock
+    assert plane.route(0, 2, msg) == [(plane.tick, msg)]
+
+
+def test_route_draws_are_seed_deterministic():
+    fates = []
+    for _ in range(2):
+        plane = FaultPlane(42, 3)
+        run = []
+        for i in range(200):
+            run.append([(t - plane.tick) for t, _ in plane.route(0, 1, i)])
+            plane.advance(1)
+        fates.append(run)
+    assert fates[0] == fates[1]
+    # ... and the event logs are byte-identical.
+    a, b = FaultPlane(42, 3), FaultPlane(42, 3)
+    for i in range(100):
+        a.route(0, 1, i), b.route(0, 1, i)
+    assert a.event_log_jsonl() == b.event_log_jsonl()
+
+
+# ----------------------------------------------------- transport interceptors
+
+def test_transport_send_interceptor_enforces_partition():
+    async def main():
+        import socket
+        got: list = []
+        shutdown = Shutdown()
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+
+        plane = FaultPlane(3, 2, net=NetFaults.quiet())
+        # Transport node ids are 1-based; plane indexes 0-based.
+        sender = tcp.Transport(1, ("127.0.0.1", 0), {2: ("127.0.0.1", port)},
+                               lambda m: None, shutdown,
+                               intercept_send=plane.transport_send_interceptor(0))
+        receiver = tcp.Transport(2, ("127.0.0.1", port), {}, got.append,
+                                 shutdown)
+        await receiver.start()
+        await sender.start()
+        try:
+            def wire(x):
+                return rpc.WireMsg(kind=rpc.MSG_SNAPSHOT, group=0, src=0,
+                                   dst=1, x=x, payload=b"p")
+
+            plane.block_link(0, 1)
+            sender.send(2, wire(1))   # swallowed by the partition
+            plane.heal_link(0, 1)
+            sender.send(2, wire(2))   # delivered
+            for _ in range(100):
+                if got:
+                    break
+                await asyncio.sleep(0.05)
+            assert [m.x for m in got] == [2]
+            blocked = [e for e in plane.events if e["kind"] == "msg_blocked"]
+            assert len(blocked) == 1 and blocked[0]["plane"] == "tcp"
+        finally:
+            await sender.stop()
+            await receiver.stop()
+            shutdown.shutdown()
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------- schedules
+
+def test_schedule_json_round_trip():
+    for name, builder in SCHEDULES.items():
+        sched = builder(3)
+        back = Schedule.from_json(sched.to_json())
+        assert back.name == sched.name
+        assert back.horizon == sched.horizon
+        assert back.heal_ticks == sched.heal_ticks
+        assert [(s.at, s.op, s.args) for s in back.steps] == \
+               [(s.at, s.op, s.args) for s in sched.steps]
+
+
+def test_schedule_compose_shifts_steps():
+    a, b = SCHEDULES["flapping-link"](3), SCHEDULES["crash-loop"](3)
+    ab = a.then(b, gap=50)
+    assert ab.horizon == a.horizon + 50 + b.horizon
+    assert len(ab.steps) == len(a.steps) + len(b.steps)
+    assert min(s.at for s in ab.steps[len(a.steps):]) >= a.horizon + 50
+
+
+def test_nemesis_resolves_leader_dynamically():
+    class FakeCluster:
+        def __init__(self):
+            self.leader = 2
+
+        def leader_node(self, group=0):
+            return self.leader
+
+        def live_nodes(self):
+            return [0, 1, 2]
+
+    plane = FaultPlane(1, 3, net=NetFaults.quiet())
+    sched = Schedule("t", [Step(at=2, op="isolate",
+                                args={"target": "leader", "for": 5}),
+                           Step(at=4, op="crash",
+                                args={"target": "follower", "for": 3})],
+                     horizon=10)
+    nem = Nemesis(sched, plane, FakeCluster())
+    plane.advance(2)
+    nem.apply()
+    assert (2, 0) in plane.blocked and (0, 2) in plane.blocked
+    plane.advance(2)
+    nem.apply()
+    assert 0 in plane.crashed  # first live non-leader
+    # Timed faults expire on the clock.
+    plane.advance(5)
+    assert not plane.blocked and not plane.crashed

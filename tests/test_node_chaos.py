@@ -5,22 +5,27 @@ this drives the complete node — real sockets on both planes, the C++ codec,
 the replicated data plane, durable sqlite KV + on-disk seglog — through
 repeated whole-node crashes and restarts while a client produces records.
 
-Contract checked at the end, the only one acks give: every acknowledged
-record survives, appears EXACTLY once, in ack order, on EVERY replica's
-log (identical bytes at identical offsets — the apply-time offset
-assignment means replicas never negotiate). The reference cannot run this
-test at all: its Produce path is unreachable over the wire and its data
-plane is leader-local (SURVEY.md quirk 8)."""
+Contract checked at the end, the only one acks give
+(:func:`josefine_tpu.chaos.invariants.check_replica_log_contract`): every
+acknowledged record survives, in ack order, identical bytes on EVERY
+replica's log (the apply-time offset assignment means replicas never
+negotiate). Crash/restart decisions draw from a seeded
+:class:`~josefine_tpu.chaos.faults.FaultPlane`, so the whole-stack run
+shares the engine suites' fault vocabulary and leaves the same structured
+event log. The reference cannot run this test at all: its Produce path is
+unreachable over the wire and its data plane is leader-local (SURVEY.md
+quirk 8)."""
 
 from __future__ import annotations
 
 import asyncio
-import random
 
 import pytest
 
 from test_integration import NodeManager, make_batch
 
+from josefine_tpu.chaos.faults import FaultPlane, NetFaults
+from josefine_tpu.chaos.invariants import check_replica_log_contract
 from josefine_tpu.kafka import client as kafka_client
 from josefine_tpu.kafka.codec import ApiKey, ErrorCode
 from josefine_tpu.node import Node
@@ -112,7 +117,11 @@ async def test_node_crash_restart_acked_records_survive(tmp_path, seed,
     truncate and replicas rebuild their logs from leader transfers — the
     same ack contract must hold. stagger=True runs heartbeats far above
     the election timeout (transport keepalive carries liveness)."""
-    rng = random.Random(seed)
+    # The plane is the run's single randomness source and fault ledger;
+    # wall-clock sockets mean no virtual-tick routing here, just crash
+    # directives (the event log still records who died when).
+    plane = FaultPlane(seed, 3, net=NetFaults.quiet())
+    rng = plane.rng
 
     def tune(n):
         if compact:
@@ -142,6 +151,7 @@ async def test_node_crash_restart_acked_records_survive(tmp_path, seed,
 
         async def crash(i: int):
             down.add(i)
+            plane.crash(i)
             await mgr.nodes[i].stop()
             mgr.nodes[i] = None
 
@@ -153,6 +163,8 @@ async def test_node_crash_restart_acked_records_survive(tmp_path, seed,
             await node.start()
             mgr.nodes[i] = node
             down.discard(i)
+            plane.restart(i)
+            plane.advance(1)  # tick the ledger so the restart is recorded
 
         # 5 crash/restart rounds with traffic before, during, and after.
         for round_no in range(5):
@@ -201,26 +213,10 @@ async def test_node_crash_restart_acked_records_survive(tmp_path, seed,
                 break
             await asyncio.sleep(0.25)
         for part in range(PARTS):
-            per_node = read_part(part)
-            if not (per_node[0] == per_node[1] == per_node[2]):
-                import re as _re
-                orders = [_re.findall(rb"<[rd]\d+-\d+>", d) for d in per_node]
-                raise AssertionError(
-                    f"partition {part}: replica logs diverge "
-                    f"({[len(d) for d in per_node]} bytes): "
-                    f"orders={orders}")
-            # At-least-once is the contract (a timed-out attempt can commit
-            # and its retry commit again; Kafka without idempotence is the
-            # same) — every ACK must be durable, and first occurrences must
-            # respect ack order (the producer is sequential per run).
-            log_bytes = per_node[0]
-            pos = -1
-            for payload in acked[part]:
-                first = log_bytes.find(payload)
-                assert first != -1, f"ACKED record {payload!r} lost (p{part})"
-                assert first > pos, (
-                    f"record {payload!r} out of ack order (p{part})")
-                pos = first
+            check_replica_log_contract(read_part(part), acked[part], part,
+                                       payload_pattern=rb"<[rd]\d+-\d+>")
+        # The run's fault history is a structured, replayable artifact.
+        assert sum(e["kind"] == "node_crashed" for e in plane.events) == 5
         if compact:
             # The scenario must actually have exercised compaction: at
             # least one data-group chain truncated on some node.

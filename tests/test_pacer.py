@@ -89,6 +89,47 @@ def test_lockstep_detach_mid_advance_does_not_deadlock():
     asyncio.run(main())
 
 
+def test_lockstep_release_returns_surplus_permits():
+    """The tick loop acquires up to its max window, then clamps to the
+    engine's post-acquire hint and releases the surplus. Released permits
+    must flow back so advance(k) still executes exactly k ticks (dropping
+    them would skew the virtual clock across nodes)."""
+    async def main():
+        pacer = LockstepPacer(settle_s=0)
+        windows: list[int] = []
+
+        async def node():
+            pacer.attach("n")
+            try:
+                while True:
+                    got = await pacer.acquire("n", 4)
+                    w = min(got, 1)  # post-acquire hint says: single ticks
+                    if got > w:
+                        pacer.release("n", got - w)
+                    windows.append(w)
+                    await pacer.pace("n", w, 0.0, 0.0)
+            except asyncio.CancelledError:
+                pass
+            finally:
+                pacer.detach("n")
+
+        t = asyncio.create_task(node())
+        await asyncio.sleep(0)
+        # Without release(), acquire consumes all 4 permits, 3 evaporate,
+        # and this advance would hang waiting for 4 executed ticks.
+        await asyncio.wait_for(pacer.advance(4), timeout=5.0)
+        assert windows == [1, 1, 1, 1]
+        t.cancel()
+        await asyncio.gather(t, return_exceptions=True)
+
+    asyncio.run(main())
+
+
+def test_wall_clock_release_is_noop():
+    pacer = WallClockPacer()
+    pacer.release("n", 3)  # must simply not raise
+
+
 def test_wall_clock_pacer_sleep_arithmetic():
     async def main():
         pacer = WallClockPacer()

@@ -261,3 +261,51 @@ def test_windowed_server_loop_over_sockets():
                 await n.stop()
 
     asyncio.run(main())
+
+
+def test_window_hint_evaluated_after_acquire():
+    """ADVICE r5 regression: suggest_window used to be evaluated BEFORE
+    pacer.acquire(), which can park indefinitely under LockstepPacer — by
+    grant time the hint could be stale (e.g. a group went leaderless while
+    parked, where a >1 window de-randomizes election timeouts). The loop
+    must (a) re-evaluate the hint after acquire returns and (b) release the
+    surplus permits so the virtual clock stays skew-free."""
+    async def main():
+        pacer = LockstepPacer(settle_s=0)
+        nodes, _ = make_nodes(1, pacer=pacer, window_ticks=4,
+                              heartbeat_timeout_ms=8 * 30)
+        n = nodes[0]
+
+        granted = {"yet": False}
+        orig_acquire = pacer.acquire
+
+        async def acquire(key, want):
+            got = await orig_acquire(key, want)
+            granted["yet"] = True  # state "changes" while we were parked
+            return got
+
+        pacer.acquire = acquire
+        # Hint: full window before the grant, single ticks after — exactly
+        # the stale-hint scenario. The buggy ordering reads 4; the fixed
+        # loop must read 1 on every iteration.
+        n.engine.suggest_window = lambda m: 1 if granted["yet"] else m
+
+        windows: list[int] = []
+        orig_tick = n.engine.tick
+
+        def tick(window=1):
+            windows.append(window)
+            return orig_tick(window=window)
+
+        n.engine.tick = tick
+        await n.start()
+        try:
+            # One multi-tick grant: the fixed loop runs 4 single-tick
+            # dispatches (surplus released and re-acquired); the buggy one
+            # would run a single window=4 dispatch — or hang the advance.
+            await asyncio.wait_for(pacer.advance(4), timeout=10.0)
+            assert windows == [1, 1, 1, 1], windows
+        finally:
+            await n.stop()
+
+    asyncio.run(main())

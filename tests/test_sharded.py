@@ -86,8 +86,11 @@ def _run_sharded(P, N, params, ticks, p_shards, n_shards):
     "p_shards,n_shards,N",
     [
         (8, 1, 3),  # pure partition data-parallelism
-        (4, 2, 4),  # groups split 2-way across chips (all_to_all delivery)
-        (2, 4, 4),  # one node per chip within each p-shard
+        # The node-sharded combos compile a bigger all_to_all program
+        # (~20-25 s each on the CPU backend): they run in the full CI
+        # suite (tools/ci.sh) but sit outside the tier-1 time budget.
+        pytest.param(4, 2, 4, marks=pytest.mark.slow),  # groups split 2-way
+        pytest.param(2, 4, 4, marks=pytest.mark.slow),  # one node per chip
         # (1, 8, 8) — fully node-sharded — is excluded: XLA's CPU backend
         # wedges compiling/running an 8-party all_to_all on 8 virtual
         # devices (hangs >5 min; (2,4) and (4,2) compile in seconds). The

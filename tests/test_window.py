@@ -166,64 +166,35 @@ def test_window_clamped_to_hb_ticks_and_parole():
 
 def test_windowed_chaos_crash_restart_safety():
     """Crash/restart + drop/delay/dup chaos while every live engine steps
-    ADAPTIVE WINDOWS — the full Chaos harness from test_chaos.py (one
-    fault model for both suites), parameterized with window=4 and a
-    staggered-heartbeat config so windows actually open. The windowed
-    schedule only ever loses messages in FIFO order, so every single-tick
-    safety argument carries: election safety and FSM log-matching are
-    checked every round, acked writes must survive, and the cluster must
-    re-converge after healing."""
-    from test_chaos import GROUPS, N_NODES, Chaos, check_linearizable
+    ADAPTIVE WINDOWS — the shared ChaosCluster harness from
+    josefine_tpu.chaos (one fault model for both suites), parameterized
+    with window=4 and a staggered-heartbeat config so windows actually
+    open. The windowed schedule only ever loses messages in FIFO order, so
+    every single-tick safety argument carries: election safety and FSM
+    log-matching are checked every round, acked writes must survive, and
+    the cluster must re-converge after healing. Exactly-once + real-time
+    precedence must survive windowed dispatch too (ack ticks quantize to
+    window boundaries, which only widens the conservative happened-before
+    bound)."""
+    from josefine_tpu.chaos.harness import ChaosCluster
 
     async def main():
-        c = Chaos(11, window=4,
-                  params=step_params(timeout_min=3, timeout_max=8,
-                                     hb_ticks=8))
+        c = ChaosCluster(11, window=4,
+                         params=step_params(timeout_min=3, timeout_max=8,
+                                            hb_ticks=8))
         for _ in range(300):
             c.step()
             c.maybe_propose()
             c.harvest_acks()
             await asyncio.sleep(0)
 
-        # Heal: everyone up, clean network, windowed convergence run.
-        for i in list(c.down):
-            c.down_until[i] = 0
-        deadline = c.tick_no + 120
-        while c.tick_no < deadline:
-            c.tick_no += 1
-            for i in list(c.down):
-                c.engines[i] = c._make(i)
-                c.down.discard(i)
-            for _, dst, m in c.delayed:
-                c.engines[dst].receive(m)
-            c.delayed = []
-            for e in c.engines:
-                res = e.tick(window=e.suggest_window(4))
-                for m in res.outbound:
-                    c.engines[m.dst].receive(m)
-            c.check_election_safety()
-            await asyncio.sleep(0)
+        # Heal: everyone up, clean network, windowed convergence run
+        # (heal() ticks with suggest_window(4) — self.window is 4).
+        c.heal(120)
         c.harvest_acks()
 
         assert c.proposed > 10
-        for g in range(GROUPS):
-            leads = [i for i, e in enumerate(c.engines) if e.is_leader(g)]
-            assert len(leads) == 1, f"group {g}: leaders {leads}"
-            heads = {e.chains[g].head for e in c.engines}
-            commits = {e.chains[g].committed for e in c.engines}
-            assert len(heads) == 1 and len(commits) == 1, (
-                f"group {g} failed to converge: heads={heads} commits={commits}")
-            # Every acked write survived, in an agreed order (FSM logs are
-            # identical after convergence; acked is a subset).
-            logs = [c.fsms[i][g].applied for i in range(N_NODES)]
-            assert logs[0] == logs[1] == logs[2], f"g={g} FSM logs diverge"
-            for payload in c.acked[g]:
-                assert payload in logs[0], f"g={g} lost acked {payload!r}"
-            # Exactly-once + real-time precedence must survive windowed
-            # dispatch too (ack ticks quantize to window boundaries, which
-            # only widens the conservative happened-before bound).
-            check_linearizable(c, g, logs[0])
-        c.check_log_matching()
+        c.assert_converged_and_linearizable()
 
     asyncio.run(main())
 
@@ -235,12 +206,12 @@ def test_windowed_sparse_chaos_all_features():
     delays, crash/restart, one-way link partitions). The invariant epilogue
     is the same as every other chaos run — windows and sparse IO are
     transport/dispatch optimizations and must be safety-invisible."""
-    from test_chaos import Chaos
+    from josefine_tpu.chaos.harness import ChaosCluster
 
     async def main():
-        c = Chaos(23, window=4, groups=96, sparse=True, k_out=8,
-                  params=step_params(timeout_min=3, timeout_max=8,
-                                     hb_ticks=8))
+        c = ChaosCluster(23, window=4, groups=96, sparse=True, k_out=8,
+                         params=step_params(timeout_min=3, timeout_max=8,
+                                            hb_ticks=8))
         for _ in range(300):
             c.step()
             c.maybe_propose()

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Seeded chaos soak CLI: drive a nemesis schedule, enforce invariants.
+
+Usage:
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition
+    python tools/chaos_soak.py --list
+    python tools/chaos_soak.py --seed 3 --schedule crash-loop \
+        --events /tmp/faults.jsonl --dump-schedule /tmp/sched.json
+
+Reproducibility contract: two runs with the same ``--seed`` and schedule
+produce byte-identical fault-event logs (``--events``) and identical final
+cluster state. Exit code 0 means every safety invariant (election safety,
+durability, log matching, post-heal convergence, linearizability) held;
+1 means a violation (the summary line carries it); 2 is usage error.
+
+Runs on the CPU backend by default (``--platform``), so it works inside
+the tier-1 time budget and on machines without a chip.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--schedule", default="leader-partition",
+                    help="bundled schedule name, or @path to a schedule JSON")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--groups", type=int, default=2)
+    ap.add_argument("--window", type=int, default=1,
+                    help="max dispatch window per tick (suggest_window clamps)")
+    ap.add_argument("--horizon", type=int, default=None,
+                    help="override the schedule's chaos-phase tick count")
+    ap.add_argument("--auto-faults", action="store_true",
+                    help="layer random background crashes/partitions over "
+                         "the schedule (hostile mode)")
+    ap.add_argument("--quiet-net", action="store_true",
+                    help="no probabilistic drop/dup/delay noise; the "
+                         "schedule is the only fault source")
+    ap.add_argument("--events", default=None,
+                    help="write the fault-event log (JSONL) here")
+    ap.add_argument("--dump-schedule", default=None,
+                    help="write the resolved schedule DSL (JSON) here")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform for the engines (default cpu)")
+    ap.add_argument("--list", action="store_true",
+                    help="list bundled schedules and exit")
+    args = ap.parse_args()
+
+    # Pin the backend before anything imports jax (the sandbox's
+    # sitecustomize pins JAX_PLATFORMS, so the config update is what sticks).
+    os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    from josefine_tpu.chaos.faults import NetFaults
+    from josefine_tpu.chaos.nemesis import SCHEDULES
+    from josefine_tpu.chaos.soak import run_soak
+
+    if args.list:
+        for name, builder in sorted(SCHEDULES.items()):
+            sched = builder(args.nodes)
+            print(f"{name:20s} horizon={sched.horizon:4d} "
+                  f"steps={len(sched.steps):2d}  "
+                  f"{(builder.__doc__ or '').strip().splitlines()[0]}")
+        return 0
+
+    schedule = args.schedule
+    if schedule.startswith("@"):
+        with open(schedule[1:]) as fh:
+            schedule = fh.read()
+    elif schedule not in SCHEDULES:
+        print(f"unknown schedule {schedule!r}; use --list or @file.json",
+              file=sys.stderr)
+        return 2
+
+    result = run_soak(
+        args.seed, schedule, n_nodes=args.nodes, groups=args.groups,
+        window=args.window, horizon=args.horizon,
+        net=NetFaults.quiet() if args.quiet_net else None,
+        auto_faults=args.auto_faults)
+
+    if args.events:
+        with open(args.events, "w") as fh:
+            fh.write(result["event_log"])
+    if args.dump_schedule:
+        with open(args.dump_schedule, "w") as fh:
+            fh.write(result["schedule_json"])
+
+    summary = {k: result[k] for k in
+               ("schedule", "seed", "nodes", "groups", "window", "ticks",
+                "proposed", "acked", "fault_events", "chaos_counters",
+                "invariants", "violation")}
+    print(json.dumps(summary))
+    return 0 if result["invariants"] == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,10 +34,21 @@ for mod in ("kafka_codec", "seglog"):
     print(f"built {mod}")
 EOF
 
+chaos_smoke() {
+    # One short seeded nemesis schedule end-to-end through the soak CLI,
+    # invariants enforced (exit 1 on any violation). Seed 7 + the bundled
+    # leader-partition schedule is the canonical repro pair; --horizon
+    # shortens the chaotic phase to fit the smoke budget.
+    echo "== chaos smoke =="
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200
+}
+
 echo "== tests =="
 if [[ "${1:-}" == "quick" ]]; then
     python -m pytest tests/test_chained_raft.py tests/test_engine.py \
         tests/test_integration.py tests/test_kafka_codec.py -q -x
+    chaos_smoke
 else
     # Chunked to fit runner time limits; order mirrors the dependency
     # stack (kernel -> engine -> broker -> chaos).
@@ -61,6 +72,8 @@ else
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
+        tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_reset_safety.py -q
+    chaos_smoke
 fi
 echo "CI OK"
