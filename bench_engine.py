@@ -19,6 +19,16 @@ Writes BENCH_engine.json and prints one JSON line per size.
 With --kernel, times only the bare packed device step per size (no
 cluster, no wire; --ticks overrides the per-size iteration count) and
 writes BENCH_engine_kernel.json instead.
+
+--profile lands the engine's per-tick phase breakdown (inbox / stage /
+dispatch / fetch / decode / apply, cluster-aggregated) into each row's
+``extra.profile_phases``; every row also carries a commit-latency axis
+(``extra.commit_latency_ticks``: p50/p99 proposal→commit in device
+ticks). --pipeline drives the cluster through engine.tick_pipelined
+(host work overlaps device compute; +1 tick wire latency PER HOP, so
+commit p50 roughly doubles — recorded by the latency axis). --proposals
+sets the offered client load (distinct groups offered one payload per
+tick).
 """
 
 from __future__ import annotations
@@ -67,7 +77,22 @@ PROPOSALS_PER_TICK = 256  # distinct groups offered one payload each tick
 PAYLOAD = b"x" * 64
 
 
-async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
+class _BenchFsm:
+    """Constant-work apply target. Without an FSM the engine resolves a
+    proposal future at MINT time (nothing to apply), which would make the
+    commit-latency axis report mint latency (always 1 tick); with one, the
+    future resolves when the block actually commits and applies — the
+    product path."""
+
+    __slots__ = ()
+
+    def transition(self, data: bytes) -> bytes:
+        return b""
+
+
+async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
+                    pipeline: bool = False, profile: bool = False,
+                    proposals_per_tick: int = PROPOSALS_PER_TICK) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
     # leader is 200k messages/tick of pure liveness noise). Election
@@ -75,40 +100,82 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
     # aggregate keepalive (engine peer_fresh / kernel node_step).
     params = step_params(timeout_min=3, timeout_max=8, hb_ticks=16)
     t0 = time.perf_counter()
+    fsm = _BenchFsm()  # stateless: one instance can serve every group
     engines = [
-        RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params)
+        RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params,
+                   fsms={g: fsm for g in range(P)})
         for i in range(N)
     ]
     init_s = time.perf_counter() - t0
+    if profile:
+        for e in engines:
+            e.enable_profiling()
 
     rng = np.random.default_rng(0)
     proposed = committed = 0
 
     executed = [0] * N  # device ticks actually run per engine
+    # Commit-latency axis: (future, submit tick) pairs polled each round;
+    # latency is proposal→commit in DEVICE ticks (the protocol's clock).
+    pending_lat: list[tuple] = []
+    latencies: list[int] = []
+
+    def poll_latencies():
+        if not pending_lat:
+            return
+        now = executed[0]
+        still = []
+        for fut, t0_ in pending_lat:
+            if fut.done():
+                if not fut.cancelled() and fut.exception() is None:
+                    latencies.append(now - t0_)
+            else:
+                still.append((fut, t0_))
+        pending_lat[:] = still
 
     def one_tick(live: bool):
         nonlocal proposed, committed
         outbound = []
-        # Split-phase: dispatch all three engines' device steps before
-        # fetching any result, so their (tunnel) round trips overlap.
-        # Each engine applies the adaptive window policy (single ticks
-        # until leaders exist, then the full fused window).
-        handles = [e.tick_begin(e.suggest_window(window)) for e in engines]
-        for i, (e, h) in enumerate(zip(engines, handles)):
-            executed[i] += h["window"]
-            res = e.tick_finish(h)
-            outbound.extend(res.outbound)
-            committed += len(res.committed)
+        if pipeline:
+            # Double-buffered: each call fetches tick t, dispatches t+1,
+            # and does t's host work under t+1's device compute. The
+            # returned result is tick t's, so routing here lands messages
+            # for tick t+2 — one extra tick of wire latency, bought back
+            # many times over in wall time per tick.
+            for i, e in enumerate(engines):
+                # Credit the tick that COMPLETES inside this round (the
+                # in-flight dispatch tick_pipelined is about to fetch),
+                # not the one it dispatches — the new dispatch is still
+                # running when the timer is read, so counting it would
+                # overstate ticks_per_sec by the final in-flight round.
+                done_w = e.pipeline_window
+                res = e.tick_pipelined(e.suggest_window(window))
+                executed[i] += done_w
+                outbound.extend(res.outbound)
+                committed += len(res.committed)
+        else:
+            # Split-phase: dispatch all three engines' device steps before
+            # fetching any result, so their (tunnel) round trips overlap.
+            # Each engine applies the adaptive window policy (single ticks
+            # until leaders exist, then the full fused window).
+            handles = [e.tick_begin(e.suggest_window(window)) for e in engines]
+            for i, (e, h) in enumerate(zip(engines, handles)):
+                executed[i] += h["window"]
+                res = e.tick_finish(h)
+                outbound.extend(res.outbound)
+                committed += len(res.committed)
         for m in outbound:
             engines[m.dst].receive(m)
         if live:
-            groups = rng.integers(0, P, PROPOSALS_PER_TICK)
+            groups = rng.integers(0, P, proposals_per_tick)
             for g in set(int(g) for g in groups):
                 for e in engines:
                     if e.is_leader(g):
-                        e.propose(g, PAYLOAD)
+                        fut = e.propose(g, PAYLOAD)
+                        pending_lat.append((fut, executed[0]))
                         proposed += 1
                         break
+        poll_latencies()
 
     for _ in range(warmup):
         one_tick(live=False)
@@ -116,6 +183,11 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
 
     proposed = committed = 0
     executed = [0] * N
+    pending_lat.clear()
+    latencies.clear()
+    if profile:
+        for e in engines:
+            e.profiler.reset()  # profile the timed loop only
     t0 = time.perf_counter()
     for _ in range(ticks):
         one_tick(live=True)
@@ -126,17 +198,38 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
     # Snapshot before the drain loop below adds more.
     timed_executed = list(executed)
     dev_ticks = min(timed_executed) if min(timed_executed) else ticks
+    prof_snap = None
+    if profile:
+        # Cluster aggregate per phase: summed wall, worst-node p99.
+        prof_snap = {}
+        for e in engines:
+            for phase, s in e.profiler.snapshot().items():
+                agg = prof_snap.setdefault(phase, {
+                    "count": 0, "total_ms": 0.0, "p99_ms": 0.0})
+                agg["count"] += s["count"]
+                agg["total_ms"] = round(agg["total_ms"] + s["total_ms"], 2)
+                agg["p99_ms"] = max(agg["p99_ms"], s["p99_ms"])
+        for phase, agg in prof_snap.items():
+            agg["ms_per_round"] = round(agg["total_ms"] / ticks, 3)
 
     # Let in-flight commits drain so the commit count is meaningful.
     for _ in range(20):
         one_tick(live=False)
-    return {
+    for e in engines:
+        if e.pipeline_window:
+            res = e.tick_drain()
+            committed += len(res.committed)
+    poll_latencies()
+
+    row = {
         "P": P,
         "nodes": N,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
         "ticks": dev_ticks,
         "window": window,
+        "pipeline": pipeline,
+        "proposals_per_tick": proposals_per_tick,
         "window_executed_avg": round(sum(timed_executed) / (N * ticks), 2),
         "dispatch_rounds": ticks,
         "ticks_per_sec": round(dev_ticks / dt, 2),
@@ -146,6 +239,20 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1) -> dict:
         "committed_group_advances": committed,
         "proposals_per_sec": round(proposed / dt, 1),
     }
+    extra = {}
+    if latencies:
+        lat = np.asarray(latencies)
+        extra["commit_latency_ticks"] = {
+            "n": int(lat.size),
+            "p50": float(np.percentile(lat, 50)),
+            "p99": float(np.percentile(lat, 99)),
+            "max": int(lat.max()),
+        }
+    if prof_snap is not None:
+        extra["profile_phases"] = dict(sorted(prof_snap.items()))
+    if extra:
+        row["extra"] = extra
+    return row
 
 
 def bench_kernel(P: int, iters: int) -> dict:
@@ -234,8 +341,22 @@ async def main():
     ap.add_argument("--window", type=int, default=1,
                     help="fused ticks per dispatch in steady state "
                          "(engine.suggest_window drops to 1 during elections)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="double-buffered tick pipeline: tick t's host work "
+                         "overlaps tick t+1's device compute "
+                         "(engine.tick_pipelined)")
+    ap.add_argument("--profile", action="store_true",
+                    help="per-tick phase profile (inbox/stage/dispatch/"
+                         "fetch/decode/apply) landed into each row's extra")
+    ap.add_argument("--proposals", type=int, default=PROPOSALS_PER_TICK,
+                    help="distinct groups offered one payload per tick "
+                         "(the offered client load)")
     ap.add_argument("--kernel", action="store_true",
                     help="time the bare packed step only (no cluster, no wire)")
+    ap.add_argument("--out", default=None,
+                    help="write results to this path verbatim (no merge "
+                         "with committed artifacts; CI smoke uses a tmp "
+                         "path so it can never dirty BENCH_engine.json)")
     args = ap.parse_args()
 
     results = []
@@ -249,29 +370,52 @@ async def main():
                      else max(30, 3_000_000 // P))
             if args.ticks is None:
                 ticks = min(200, ticks)
-            r = await bench_one(P, ticks, args.warmup, window=args.window)
+            r = await bench_one(P, ticks, args.warmup, window=args.window,
+                                pipeline=args.pipeline, profile=args.profile,
+                                proposals_per_tick=args.proposals)
         results.append(r)
         print(json.dumps(r))
 
     import jax
 
     name = "engine_packed_step" if args.kernel else "engine_host_bridge"
+    device = str(jax.devices()[0])
+    if args.out:
+        for r in results:
+            r["backend"] = _BACKEND
+        with open(args.out, "w") as f:
+            json.dump({"bench": name, "device": device, "results": results},
+                      f, indent=1)
+        return
     out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
     # A CPU run writes a suffixed artifact so it can never clobber
-    # device-measured rows (the merge below only keeps same-device rows).
+    # device-measured rows — UNLESS the main artifact's rows are themselves
+    # CPU-measured (device matches), in which case updating it in place is
+    # the honest refresh (the merge below only keeps same-device rows).
     if jax.default_backend() == "cpu":
-        out_path = out_path.replace(".json", "_cpu.json")
-    # Merge by (P, window) with any existing same-device results so a
-    # partial-size rerun never silently drops rows the README cites, and
-    # window-1 and window-K rows of the same size coexist (they are
-    # different measurements, not reruns of each other).
-    device = str(jax.devices()[0])
+        try:
+            with open(out_path) as f:
+                main_dev = json.load(f).get("device")
+        except (OSError, ValueError, AttributeError):
+            main_dev = None
+        if main_dev != device:
+            out_path = out_path.replace(".json", "_cpu.json")
+    # Merge by (P, window, pipeline, offered load) with any existing
+    # same-device results so a partial-size rerun never silently drops rows
+    # the README cites, and window-1/window-K/pipelined rows of the same
+    # size coexist (they are different measurements, not reruns of each
+    # other).
     for r in results:
         r["backend"] = _BACKEND
-    # Legacy rows lacking a window key are single-tick measurements —
-    # normalize to window 1 so a rerun replaces them instead of leaving a
-    # stale twin row beside the fresh one.
-    merged = {(r["P"], r.get("window") or 1): r for r in results}
+
+    def _key(r):
+        # Legacy rows lacking the newer keys are single-tick, non-pipelined,
+        # 256-proposal measurements — normalize so a rerun replaces them
+        # instead of leaving a stale twin row beside the fresh one.
+        return (r["P"], r.get("window") or 1, bool(r.get("pipeline")),
+                r.get("proposals_per_tick", 256))
+
+    merged = {_key(r): r for r in results}
     try:
         with open(out_path) as f:
             prev = json.load(f)
@@ -279,7 +423,7 @@ async def main():
             # Same-device rows only (older files carried device per row).
             if prev.get("device", r.get("device")) == device and "P" in r:
                 r.setdefault("window", 1)  # stamp legacy rows: see merge key
-                merged.setdefault((r["P"], r["window"]), r)
+                merged.setdefault(_key(r), r)
     except (OSError, ValueError, AttributeError, KeyError, TypeError):
         pass
     keys = sorted(merged)

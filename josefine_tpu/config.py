@@ -73,6 +73,17 @@ class RaftConfig:
     # a node configured with a smaller window than its peers would judge
     # them stale and fire spurious elections.
     window_ticks: int = 1
+    # Double-buffered tick pipeline: the server loop keeps one device
+    # dispatch in flight and does tick t's host-side work (outbox decode,
+    # chain appends, FSM apply) while the device computes tick t+1
+    # (RaftEngine.tick_pipelined). Throughput: the host bridge hides
+    # behind device latency. Cost: outbound consensus traffic leaves one
+    # tick later PER HOP, so multi-hop exchanges stretch accordingly —
+    # proposal→commit p50 roughly doubles (measured 3 → 6 ticks,
+    # BENCH_engine.json pipelined row) and election rounds stretch the
+    # same way. Off by default — turn on for throughput-bound deployments
+    # at large P where device latency dominates the tick.
+    pipeline_ticks: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120

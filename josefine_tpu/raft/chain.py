@@ -125,22 +125,46 @@ class Chain:
     def range(self, from_id: int, to_id: int) -> list[Block]:
         """Blocks on the branch ending at ``to_id``, exclusive of ``from_id``,
         oldest first (reference chain.rs:208-228 but branch-walking: the id
-        keyspace may contain dead branches, so we follow parent pointers)."""
-        out: list[Block] = []
-        cur = to_id
-        while cur != from_id:
-            if cur < self.floor:
-                raise ChainError(
-                    f"range: {cur:#x} below snapshot floor {self.floor:#x}"
-                )
-            b = self.get(cur)
-            if b is None:
-                raise ChainError(f"range: missing block {cur:#x}")
-            out.append(b)
-            if cur == GENESIS or cur == self.floor:
-                raise ChainError(f"range: {from_id:#x} not an ancestor of {to_id:#x}")
-            cur = b.parent
-        out.reverse()
+        keyspace may contain dead branches, so we follow parent pointers).
+        Delegates to :meth:`range_many` so the walk and its error semantics
+        live in exactly one place."""
+        return self.range_many([(from_id, to_id)])[0]
+
+    def range_many(self, spans: list[tuple[int, int]]) -> list[list[Block]]:
+        """Bulk :meth:`range`: materialize several ``(from_id, to_id]`` spans
+        in one call, reading each distinct block from the KV exactly once.
+
+        The hot caller is the outbox decoder attaching AE payload spans: a
+        leader replicating to k followers requests k spans that share the
+        top of the branch (same head, different per-follower bottoms), so a
+        per-span ``range()`` walk re-reads the shared suffix k times. Here a
+        block cache shared across the spans makes the whole call O(distinct
+        blocks) KV reads. Per-span errors carry ``range``'s exact semantics
+        (below-floor, missing block, not-an-ancestor all raise ChainError).
+        """
+        cache: dict[int, Block] = {}
+        out: list[list[Block]] = []
+        for from_id, to_id in spans:
+            blks: list[Block] = []
+            cur = to_id
+            while cur != from_id:
+                if cur < self.floor:
+                    raise ChainError(
+                        f"range: {cur:#x} below snapshot floor {self.floor:#x}"
+                    )
+                b = cache.get(cur)
+                if b is None:
+                    b = self.get(cur)
+                    if b is None:
+                        raise ChainError(f"range: missing block {cur:#x}")
+                    cache[cur] = b
+                blks.append(b)
+                if cur == GENESIS or cur == self.floor:
+                    raise ChainError(
+                        f"range: {from_id:#x} not an ancestor of {to_id:#x}")
+                cur = b.parent
+            blks.reverse()
+            out.append(blks)
         return out
 
     # ------------------------------------------------------------ writes
@@ -174,6 +198,36 @@ class Chain:
         # regress head.
         if block.id > self.head:
             self._set_head(block.id)
+
+    def extend_many(self, blocks: list[Block]) -> None:
+        """Batched :meth:`extend`: adopt an oldest-first parent-linked run
+        of blocks with ONE KV transaction for the block records plus the
+        head pointer, instead of 2 puts per block. Validation is identical
+        to per-block extend (the first block's parent must already exist;
+        each subsequent block must chain onto its predecessor), and blocks
+        are ordered before the head pointer in the batch so a torn batch on
+        a non-transactional KV can never persist a head the blocks don't
+        back."""
+        if not blocks:
+            return
+        if not self.has(blocks[0].parent):
+            raise ChainError(
+                f"extend: parent {blocks[0].parent:#x} of {blocks[0].id:#x} unknown")
+        prev = blocks[0].parent
+        for b in blocks:
+            if b.parent != prev:
+                raise ChainError(
+                    f"extend_many: {b.id:#x} does not chain onto {prev:#x}")
+            prev = b.id
+        puts = [(self._pfx + _block_key(b.id), _encode_block(b))
+                for b in blocks]
+        top = blocks[-1].id
+        if top > self.head:
+            puts.append((self._pfx + _HEAD_KEY, struct.pack(">Q", top)))
+            self._kv.put_many(puts)
+            self.head = top
+        else:
+            self._kv.put_many(puts)
 
     def commit(self, bid: int) -> list[Block]:
         """Advance the commit pointer; returns newly committed blocks

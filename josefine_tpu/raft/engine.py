@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import asyncio
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +74,7 @@ from josefine_tpu.raft.result import NotLeader, TickResult
 from josefine_tpu.raft.snap_transfer import SnapshotTransfer, _SnapStream
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.metrics import REGISTRY
+from josefine_tpu.utils.profiling import NULL_PROFILER, PhaseProfiler
 from josefine_tpu.utils.tracing import get_logger
 
 __all__ = ["RaftEngine", "NotLeader", "TickResult"]
@@ -401,6 +403,32 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # head (chain/device divergence) or ship its frames under the new
         # incarnation stamp.
         self._recycled_this_tick: set[int] = set()
+        # Send-pointer re-roots recorded by the outbox decoder (AE frames
+        # capped at max_append_entries), applied as ONE scatter + upload by
+        # the NEXT tick_begin (_drain_nxt_fixups) — never at decode time,
+        # which would force a device sync while tick_pipelined has the next
+        # dispatch in flight. _reset_group purges a reset row's entries.
+        self._nxt_fixups: list[tuple[int, int, int]] = []
+        # Per-tick phase profiler (inbox / stage / dispatch / fetch /
+        # decode / apply). NULL_PROFILER's phase() is a shared no-op
+        # context manager, so the disabled hot path costs two C calls per
+        # phase; enable_profiling() swaps in a recording instance.
+        self.profiler = NULL_PROFILER
+        # Pipelined-tick state: the in-flight tick handle (tick_pipelined's
+        # double buffer), the dispatch-in-flight flag (True from tick_begin
+        # until the tick's device fetch materializes), and host-side
+        # messages (snapshot chunks/acks) deferred while a tick is in
+        # flight — staging them mid-flight would mutate chain/device rows
+        # the outstanding dispatch already snapshotted.
+        self._pipeline_h: dict | None = None
+        self._tick_inflight = False
+        self._deferred_host: list[rpc.WireMsg] = []
+
+    def enable_profiling(self, ring: int = 512) -> PhaseProfiler:
+        """Attach (and return) a recording phase profiler; idempotent."""
+        if self.profiler is NULL_PROFILER:
+            self.profiler = PhaseProfiler(ring=ring)
+        return self.profiler
 
     # ------------------------------------------------------------ intake
 
@@ -416,15 +444,20 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             self._h_src_seen[msg.src] = self._ticks
         if msg.kind == rpc.MSG_PING:
             return  # pure keepalive: the liveness stamp above is its payload
-        if msg.kind == rpc.MSG_SNAPSHOT:
+        if msg.kind in (rpc.MSG_SNAPSHOT, rpc.MSG_SNAPSHOT_ACK):
+            if self._tick_inflight:
+                # A snapshot install/ack mutates chain + device rows the
+                # in-flight dispatch already snapshotted (the begin/finish
+                # no-group-mutation contract). Defer to the next quiesced
+                # tick_begin — pipelined drivers quiesce on seeing these.
+                self._deferred_host.append(msg)
+                return
             if not self._inc_ok(msg):
                 return
-            self._stage_snapshot(msg)
-            return
-        if msg.kind == rpc.MSG_SNAPSHOT_ACK:
-            if not self._inc_ok(msg):
-                return
-            self._handle_snap_ack(msg)
+            if msg.kind == rpc.MSG_SNAPSHOT:
+                self._stage_snapshot(msg)
+            else:
+                self._handle_snap_ack(msg)
             return
         if msg.kind not in _CONSENSUS_KIND_SET:
             raise ValueError(f"engine.receive: not a consensus message kind {msg.kind}")
@@ -560,6 +593,9 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
     # -------------------------------------------------------------- tick
 
     def tick(self, window: int = 1) -> TickResult:
+        if self._pipeline_h is not None:
+            raise RuntimeError(
+                "pipelined tick in flight; call tick_drain() before tick()")
         return self.tick_finish(self.tick_begin(window))
 
     def suggest_window(self, max_window: int) -> int:
@@ -620,11 +656,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         """Dispatch one tick's device step WITHOUT fetching results.
 
         Splitting begin/finish lets co-located engines (the in-process
-        bench cluster; a future pipelined server loop) overlap their
-        device round trips — on a tunneled TPU the per-dispatch latency
-        (~65 ms) dominates at scale, and three sequential engine ticks
-        would pay it three times. Contract: no receive() and no group
-        mutation between begin and finish of the same engine.
+        bench cluster) overlap their device round trips — on a tunneled
+        TPU the per-dispatch latency (~65 ms) dominates at scale, and
+        three sequential engine ticks would pay it three times — and is
+        what tick_pipelined builds its double buffer on. Contract: no
+        group mutation between begin and finish of the same engine.
+        receive() IS safe mid-flight: consensus traffic only queues, and
+        host-side snapshot messages (which mutate chain/device rows) are
+        deferred to the next quiesced tick_begin automatically.
 
         ``window > 1`` folds that many consecutive ticks into the one
         dispatch (see the window-step commentary above _window_step_fn):
@@ -634,6 +673,16 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         is on vote parole (the parole elapsed-hold is re-asserted per
         dispatch, so a long window would let a paroled timer run).
         """
+        prof = self.profiler
+        if self._deferred_host and not self._tick_inflight:
+            # Host-side messages (snapshot chunks/acks) deferred while a
+            # tick was in flight: the engine is quiesced here, stage them
+            # before this tick's device step runs. A resulting install's
+            # group reset is an OUT-of-tick reset — the clear() below is
+            # what keeps this tick from suppressing the new incarnation.
+            pend, self._deferred_host = self._deferred_host, []
+            for m in pend:
+                self.receive(m)
         window = max(1, min(int(window), int(self.params.hb_ticks)))
         if self._parole:
             window = 1
@@ -651,16 +700,26 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             pidx = jnp.asarray(list(self._parole), jnp.int32)
             self.state = self.state.replace(
                 elapsed=self.state.elapsed.at[pidx].set(jnp.asarray(0, _I32)))
+        if self._nxt_fixups:
+            # Last tick's AE-cap send-pointer re-roots, as one scatter just
+            # before the step reads state.nxt (see _drain_nxt_fixups).
+            self._drain_nxt_fixups()
         pf = self._peer_fresh(window)
         if self._sparse:
-            idx, vals, staged, deferred, deferred_b = self._build_inbox_sparse()
-            step = (functools.partial(_py_sparse_window, self._k_out,
-                                      ticks=window)
-                    if self._backend == "python"
-                    else _sparse_window_fn(self._k_out, window))
-            new_state, flat, sv_dev, ov_dev = step(
-                self.params, self.member, self._me_dev, self.state,
-                jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
+            with prof.phase("inbox"):
+                # Proposal staging (sparse row 9) happens inside the
+                # builder; the dense branch's separate "stage" phase is
+                # folded into "inbox" here.
+                (idx, vals, staged,
+                 deferred, deferred_b) = self._build_inbox_sparse()
+            with prof.phase("dispatch"):
+                step = (functools.partial(_py_sparse_window, self._k_out,
+                                          ticks=window)
+                        if self._backend == "python"
+                        else _sparse_window_fn(self._k_out, window))
+                new_state, flat, sv_dev, ov_dev = step(
+                    self.params, self.member, self._me_dev, self.state,
+                    jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
             h = {"mode": "sparse", "flat": flat, "sv": sv_dev, "ov": ov_dev,
                  "staged": staged, "k_out": self._k_out, "window": window,
                  # Transfer accounting (benchable without extra fetches:
@@ -670,16 +729,21 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                                      + np.asarray(vals).nbytes),
                  "fetch_bytes": int(np.prod(flat.shape)) * 4}
         else:
-            in10, staged, deferred, deferred_b = self._build_inbox()
-            for g in self._prop_groups:
-                in10[9, g, 0] = len(self._proposals[g])
-            self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
-            step = (functools.partial(_py_packed_window, ticks=window)
-                    if self._backend == "python"
-                    else _window_step_fn(window))
-            new_state, flat = step(
-                self.params, self.member, self._me_dev, self.state, in10,
-                jnp.asarray(pf))
+            with prof.phase("inbox"):
+                in10, staged, deferred, deferred_b = self._build_inbox()
+            with prof.phase("stage"):
+                if self._prop_groups:
+                    prop_groups = list(self._prop_groups)
+                    pg = np.asarray(prop_groups, np.intp)
+                    self._scatter_proposal_counts(in10[9], pg, prop_groups)
+                self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
+            with prof.phase("dispatch"):
+                step = (functools.partial(_py_packed_window, ticks=window)
+                        if self._backend == "python"
+                        else _window_step_fn(window))
+                new_state, flat = step(
+                    self.params, self.member, self._me_dev, self.state, in10,
+                    jnp.asarray(pf))
             h = {"mode": "dense", "flat": flat, "staged": staged,
                  "window": window,
                  "upload_bytes": int(in10.nbytes),
@@ -696,9 +760,82 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # being failed NotLeader on a leader (round-4 advisor finding).
         h["props"] = {g: self._proposals.pop(g) for g in list(self._prop_groups)}
         self._prop_groups.clear()
+        self._tick_inflight = True
         return h
 
+    def tick_fetch(self, h: dict) -> dict:
+        """Materialize the tick's device→host transfer (blocking) WITHOUT
+        any of the host-side mirror/decode work. Idempotent; tick_finish
+        calls it implicitly. Pipelined drivers call it explicitly so the
+        next tick_begin can dispatch before tick_finish's host work runs
+        (the host work then overlaps the new dispatch's device compute)."""
+        if "flat_np" not in h:
+            with self.profiler.phase("fetch"):
+                h["flat_np"] = np.asarray(h["flat"])
+            self._tick_inflight = False
+        return h
+
+    def tick_pipelined(self, window: int = 1) -> TickResult:
+        """Double-buffered tick: fetch tick t's results, dispatch tick t+1,
+        THEN run tick t's host-side finish (decode, chain append, FSM apply)
+        while the device computes t+1 — the host bridge hides behind device
+        latency instead of serializing with it (the begin/finish split was
+        designed for exactly this; see tick_begin's contract note).
+
+        Returns tick t's TickResult — an empty one on the priming call, and
+        the pipeline stays one tick deep thereafter. Outbound messages
+        therefore reach peers one tick later than under tick() — and the
+        cost applies PER MESSAGE HOP, so a proposal→commit round trip
+        (AE out + ack back + one more tick to learn the commit) roughly
+        doubles: measured p50 3 → 6 ticks in BENCH_engine.json's
+        pipelined row (bench_engine.py --pipeline). Deferred
+        host-side messages (snapshot chunks) quiesce the pipeline for one
+        round: tick t finishes fully before t+1 dispatches. Call
+        tick_drain() before switching back to tick()."""
+        prev = self._pipeline_h
+        self._pipeline_h = None
+        res: TickResult | None = None
+        if prev is not None:
+            self.tick_fetch(prev)  # wait out tick t's device step
+            if self._deferred_host:
+                # Snapshot traffic needs a quiesced engine: close out tick
+                # t before tick_begin stages it and dispatches t+1.
+                res = self.tick_finish(prev)
+                prev = None
+        h = self.tick_begin(window)
+        # Publish the in-flight handle BEFORE tick t's finish runs: a group
+        # reset/recycle inside that finish happens AFTER tick t+1's state
+        # was snapshotted by the dispatch, so _reset_group records the row
+        # on THIS handle (skip_rows) and finish(t+1) will discard its
+        # stale fetched values — the same protocol _recycled_this_tick
+        # implements for in-tick resets, which cannot cover this case
+        # because tick_begin(t+2) clears it before finish(t+1) reads it.
+        self._pipeline_h = h
+        if prev is not None:
+            res = self.tick_finish(prev)  # overlaps t+1's device compute
+        return res if res is not None else TickResult()
+
+    def tick_drain(self) -> TickResult | None:
+        """Finish the in-flight pipelined tick (shutdown / mode switch);
+        None if the pipeline is empty."""
+        h, self._pipeline_h = self._pipeline_h, None
+        return self.tick_finish(h) if h is not None else None
+
+    @property
+    def pipeline_window(self) -> int:
+        """Ticks the in-flight pipelined dispatch will execute — 0 when the
+        pipeline is empty. Driver accounting (the bench's device-tick
+        clock) without reaching into the tick handle."""
+        return int(self._pipeline_h["window"]) if self._pipeline_h else 0
+
     def tick_finish(self, h: dict) -> TickResult:
+        self.tick_fetch(h)  # no-op if the pipelined driver already fetched
+        # Rows reset/recycled AFTER this tick was dispatched but before
+        # this finish (pipelined mode: the overlapped finish of the
+        # previous tick can reset groups) — their fetched values predate
+        # the reset exactly like a mid-tick recycle, so fold them into the
+        # same skip protocol.
+        self._recycled_this_tick |= h.pop("skip_rows", set())
         staged = h["staged"]
         # The proposal queues THIS tick presented to the device (snapshotted
         # by tick_begin); self._proposals may already hold newer entries for
@@ -713,13 +850,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         if h["mode"] == "dense":
             # ONE flat fetch holding the (10, P) scalar mirror and the
             # (9, P, N) outbox.
-            flat = np.asarray(h["flat"])
+            flat = h["flat_np"]
             cut = 10 * self.P
             sv = flat[:cut].reshape(10, self.P).astype(np.int64, copy=False)
             ov = flat[cut:].reshape(9, self.P, self.N)
             dense = True
         else:
-            flat = np.asarray(h["flat"])
+            flat = h["flat_np"]
             k_out = h["k_out"]
             total = int(flat[0])
             C = 10 + 9 * self.N
@@ -727,13 +864,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 # Compaction overflow (burst bigger than capacity):
                 # materialize the dense device-resident outputs — correct,
                 # just a bigger transfer — and grow the bucket.
-                sv32 = np.asarray(h["sv"])
+                with self.profiler.phase("fetch"):
+                    sv32 = np.asarray(h["sv"])
+                    ov = np.asarray(h["ov"])
                 # Transfer accounting must cover the fallback fetch too —
                 # it is exactly the worst-case transfer the sparse floor
                 # numbers would otherwise hide. Counted at the int32 wire
                 # width, BEFORE the int64 host cast below.
                 sv = sv32.astype(np.int64, copy=False)
-                ov = np.asarray(h["ov"])
                 h["fetch_bytes"] += sv32.nbytes + ov.nbytes
                 dense = True
                 while self._k_out < min(self.P, total):
@@ -838,6 +976,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
 
         res = TickResult()
         reset_rows: set[int] = set()
+        prof = self.profiler
+        _t_apply = time.perf_counter_ns() if prof.enabled else 0
         # Host work is only needed where host-visible state moved. In steady
         # state most fetched rows are outbox-only (staggered heartbeats /
         # replies): the device compaction (or the dense active predicate)
@@ -852,7 +992,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 | ((self._h_role[proc] == LEADER) & (n_role != LEADER)))
         if prop_gs:
             need |= np.isin(proc, np.fromiter(prop_gs, np.int64, len(prop_gs)))
-        for pos in np.nonzero(need)[0].tolist():
+        need_rows = np.nonzero(need)[0].tolist()
+        for pos in need_rows:
             g = int(proc[pos])
             if g in self._recycled_this_tick:
                 # Recycled by a group-0 commit hook earlier in THIS loop
@@ -931,7 +1072,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # Accepted spans (follower): reconcile the chain to the device's
             # new head by walking parent pointers through the staged blocks.
             # This is robust to several AEs landing in one tick: only the
-            # branch the device actually adopted is persisted.
+            # branch the device actually adopted is persisted. The whole
+            # path lands in ONE KV transaction (Chain.extend_many — blocks
+            # before the head pointer, one WAL commit on SqliteKV instead
+            # of two puts per block).
             if new_head != self._h_head[g] and not minted[pos] and not became[pos]:
                 by_id = {b.id: b for b in staged.get(g, [])}
                 path = []
@@ -944,8 +1088,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                         )
                     path.append(blk)
                     cur = blk.parent
-                for blk in reversed(path):
-                    ch.extend(blk)
+                path.reverse()
+                ch.extend_many(path)
                 if ch.head != new_head:
                     ch.force_head(new_head)
 
@@ -1020,12 +1164,15 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         if self._conf_notify:
             res.conf_changes.extend(self._conf_notify)
             self._conf_notify.clear()
+        if prof.enabled:
+            prof.add_ns("apply", time.perf_counter_ns() - _t_apply)
         # Skip rows reset mid-tick too, not just recycled ones: a
         # ReplicaDiverged reset discards the blocks this tick's computed
         # AE-ack claims to hold, and a same-tick vote grant from the wiped
         # row is exactly the forgotten-ack vote parole exists to prevent.
         skip = self._recycled_this_tick | reset_rows
-        res.outbound = self._decode_outbox(ov_c, proc, skip=skip or None)
+        with prof.phase("decode"):
+            res.outbound = self._decode_outbox(ov_c, proc, skip=skip or None)
         if self._snap_acks:
             # Snapshot-transfer acks queued by receive() (which has no send
             # channel of its own) ride this tick's outbound.

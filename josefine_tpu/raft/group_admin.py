@@ -250,6 +250,17 @@ class GroupAdmin:
         self.kv.delete(b"g%d:snap" % g)
         self._snap_cache.pop(g, None)
         self._drop_group_transfers(g)
+        if self._nxt_fixups:
+            # Deferred send-pointer re-roots recorded for this row predate
+            # the reset — the reset zeroes the row's nxt below, and a later
+            # _drain_nxt_fixups scatter must not resurrect the old pointer.
+            self._nxt_fixups = [f for f in self._nxt_fixups if f[0] != g]
+        if self._pipeline_h is not None:
+            # A dispatch is in flight (pipelined driver): its fetched
+            # values for this row were computed from pre-reset state —
+            # record the row on the handle so its finish discards them
+            # (tick_finish folds skip_rows into _recycled_this_tick).
+            self._pipeline_h.setdefault("skip_rows", set()).add(g)
         # INVARIANT: every out-of-tick chain mutation must refresh the
         # _h_head/_h_commit mirrors itself — tick_finish's need-mask skips
         # quiet rows, so it will NOT heal a mirror this site leaves stale

@@ -8,7 +8,10 @@ packed outbox back into columnar per-peer MsgBatches, attaching chain
 payload spans to AppendEntries (with max_append_entries flow control) and
 snapshot messages where the span bottom fell below the truncation floor.
 
-Split out of engine.py in round 5; behavior unchanged, pinned by
+Split out of engine.py in round 5; decode vectorized in this round (one
+columnar pass + per-chain Chain.range_many bulk span reads + deferred
+nxt-fixup scatter), pinned byte-identical to the retained scalar reference
+by tests/test_decode_differential.py and behaviorally by
 tests/test_engine.py, test_sparse_io.py, test_rpc_batch.py.
 
 Reference parity: the per-peer bounded send queue with carry-over replaces
@@ -23,7 +26,6 @@ import numpy as np
 
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
-from josefine_tpu.raft.chain import id_seq, id_term
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("raft.engine")
@@ -194,26 +196,165 @@ class HostIO:
         gi_loc, si_loc = np.nonzero(vals[0])
         if len(gi_loc):
             self._h_last_seen[idx[gi_loc], si_loc] = self._ticks
-        for g in prop_groups:
-            vals[9, np.searchsorted(G, g), 0] = len(self._proposals[g])
+        if prop_groups:
+            pg = np.asarray(prop_groups, np.int64)
+            self._scatter_proposal_counts(
+                vals[9], np.searchsorted(G, pg), prop_groups)
         return idx, vals, staged, deferred, deferred_b
+
+    def _scatter_proposal_counts(self, plane, rows, groups) -> None:
+        """Row-9 proposal-depth lane: one scatter over the pending groups'
+        target rows (the per-group Python loop was measurable at P=100k
+        under a deep proposal load). ``rows`` maps each group in ``groups``
+        to its row in ``plane`` — identity for the dense inbox, the
+        searchsorted compaction index for the sparse one."""
+        plane[rows, 0] = np.fromiter(
+            (len(self._proposals[g]) for g in groups), np.int32, len(groups))
 
     def _decode_outbox(self, ov, groups, skip: set[int] | None = None) -> list:
         """Decode the packed outbox into ONE columnar MsgBatch per peer (plus
         any InstallSnapshot WireMsgs). The batch IS the wire form — per-tick
-        consensus traffic to a peer is a single binary frame end to end; the
-        only per-entry Python work left is for AEs that carry payload spans.
+        consensus traffic to a peer is a single binary frame end to end.
 
         ``ov`` is COMPACT: (9, R, N) covering only the processed rows, with
         ``groups`` (R,) mapping each row to its group id — the dense form
         is just R == P with groups == arange(P).
+
+        This is the columnar fast path (the profiled P=100k hot spot): one
+        ``np.nonzero`` over the whole outbox, per-entry 64-bit id combines
+        on the selected entries only (never the full (R, N) planes), AE
+        payload spans grouped per chain and served by one
+        :meth:`Chain.range_many` bulk read per group (followers of one
+        leader share the branch top, so per-dst ``range()`` walks re-read
+        it N-1 times), and send-pointer fixups recorded for the next
+        tick_begin's single scatter (``_drain_nxt_fixups``) instead of a
+        device round trip here — which would also force a sync with the
+        in-flight dispatch under ``tick_pipelined``. Byte-identical output
+        is pinned against :meth:`_decode_outbox_reference` by
+        tests/test_decode_differential.py.
         """
+        kind = ov[0]
+        if skip:
+            smask = np.isin(np.asarray(groups),
+                            np.fromiter(skip, np.int64, len(skip)))
+            if smask.any():
+                # Mid-tick-recycled rows: their outbox was computed by the
+                # dead incarnation but would be stamped with the new one.
+                kind = kind.copy()
+                kind[smask] = 0
+        ri, di = np.nonzero(kind)
+        if not len(ri):
+            return []
+        i64 = np.int64
+        # Columnar gather: every field once, entries only.
+        k_all = kind[ri, di].astype(np.int32)
+        t_all = ov[1][ri, di].astype(i64)
+        ok_all = ov[8][ri, di].astype(np.int32)
+        x_all = (ov[2][ri, di].astype(i64) << 32) | ov[3][ri, di].astype(i64)
+        y_all = (ov[4][ri, di].astype(i64) << 32) | ov[5][ri, di].astype(i64)
+        z_all = (ov[6][ri, di].astype(i64) << 32) | ov[7][ri, di].astype(i64)
+        g_all = np.asarray(groups)[ri].astype(np.intp)
+        inc_all = self._h_ginc[g_all]
+
+        # AE entries with a non-empty span need chain payloads attached.
+        # Group them per chain so each group's spans come from ONE bulk
+        # read; snapshot-floor probes and span errors keep the per-entry
+        # semantics of the reference decoder.
+        blocks_by_dst: dict[int, dict[int, list]] = {}
+        snaps_by_dst: dict[int, list] = {}
+        ae = np.nonzero((k_all == rpc.MSG_APPEND) & (y_all != x_all))[0]
+        if len(ae):
+            cap = self.max_append_entries
+            order = ae[np.argsort(g_all[ae], kind="stable")]
+            edges = np.nonzero(np.diff(g_all[order]))[0] + 1
+            for run in np.split(order, edges):
+                grp = int(g_all[run[0]])
+                ch = self.chains[grp]
+                floor = ch.floor
+                pend: list[int] = []   # entries whose span we will read
+                for i in run.tolist():
+                    mx = int(x_all[i])
+                    if mx < floor:
+                        # Span bottom below our truncation floor: log replay
+                        # cannot reach this follower — ship the snapshot
+                        # (throttled; it is the large message here) plus a
+                        # heartbeat probe. The probe keeps the device-level
+                        # reject/re-root loop alive, so once the follower
+                        # has installed, its reject hint (= snapshot id)
+                        # re-roots our send pointer above the floor within
+                        # 2 ticks.
+                        snap = self._snapshot_msg(grp, int(di[i]), int(t_all[i]))
+                        if snap is not None:
+                            snaps_by_dst.setdefault(int(di[i]), []).append(snap)
+                        y_all[i] = mx
+                        z_all[i] = min(int(z_all[i]), mx)
+                    else:
+                        pend.append(i)
+                if not pend:
+                    continue
+                try:
+                    many = ch.range_many(
+                        [(int(x_all[i]), int(y_all[i])) for i in pend])
+                except Exception:
+                    # A span this tick cannot materialize (e.g. probe
+                    # pointer on a branch we no longer hold): fall back to
+                    # per-span reads so ONLY the broken span degrades to a
+                    # heartbeat probe; the rest of the group's spans still
+                    # ship (identical per-entry semantics to the reference
+                    # decoder's per-dst loop).
+                    many = []
+                    for i in pend:
+                        mx, my = int(x_all[i]), int(y_all[i])
+                        try:
+                            many.append(ch.range(mx, my))
+                        except Exception:
+                            log.warning(
+                                "span (%#x, %#x] unavailable g=%d; "
+                                "heartbeat only", mx, my, grp)
+                            y_all[i] = mx
+                            z_all[i] = min(int(z_all[i]), mx)
+                            many.append(None)
+                for i, blks in zip(pend, many):
+                    if blks is None:
+                        continue
+                    # Flow control: cap the frame at max_append_entries
+                    # blocks (a follower 1M blocks behind must catch up in
+                    # bounded frames, not one giant message). The device's
+                    # optimistic send pointer is re-rooted at the capped top
+                    # so the NEXT tick continues from there — a pipelined
+                    # chunked catch-up, no reject round-trips needed.
+                    if cap is not None and len(blks) > cap:
+                        blks = blks[:cap]
+                        top = blks[-1].id
+                        y_all[i] = top
+                        z_all[i] = min(int(z_all[i]), top)
+                        self._nxt_fixups.append((grp, int(di[i]), top))
+                    blocks_by_dst.setdefault(int(di[i]), {})[grp] = blks
+
+        out: list = []
+        for dst in range(self.N):
+            sel = np.nonzero(di == dst)[0]
+            if not len(sel):
+                continue
+            out.extend(snaps_by_dst.get(dst, ()))
+            out.append(rpc.MsgBatch(
+                self.me, dst, g_all[sel], k_all[sel], t_all[sel], x_all[sel],
+                y_all[sel], z_all[sel], ok_all[sel],
+                blocks=blocks_by_dst.get(dst) or {}, inc=inc_all[sel]))
+        return out
+
+    def _decode_outbox_reference(self, ov, groups,
+                                 skip: set[int] | None = None) -> list:
+        """Retained scalar reference for :meth:`_decode_outbox` — the per-dst
+        loop with per-entry ``ch.range()`` reads. The differential test
+        (tests/test_decode_differential.py) pins the columnar path
+        byte-identical to this across dense/sparse modes, snapshot-floor
+        spans, max_append_entries capping, and mid-tick-recycled skip rows.
+        Never called on the product hot path."""
         kind = ov[0]
         if skip:
             rows = [i for i, g in enumerate(groups) if int(g) in skip]
             if rows:
-                # Mid-tick-recycled rows: their outbox was computed by the
-                # dead incarnation but would be stamped with the new one.
                 kind = kind.copy()
                 kind[rows] = 0
         if not kind.any():
@@ -224,7 +365,6 @@ class HostIO:
         ycol = (ov[4].astype(i64) << 32) | ov[5].astype(i64)
         zcol = (ov[6].astype(i64) << 32) | ov[7].astype(i64)
         out: list = []
-        nxt_fixups: list[tuple[int, int, int]] = []
         for dst in range(self.N):
             sel = di == dst
             if not sel.any():
@@ -239,20 +379,12 @@ class HostIO:
             bz = zcol[r, dst]
             batch = rpc.MsgBatch(self.me, dst, g, kcol, tcol, bx, by, bz,
                                  okcol, inc=self._h_ginc[g])
-            # AE entries with a non-empty span need chain payloads attached.
             ae = np.nonzero((kcol == rpc.MSG_APPEND) & (by != bx))[0]
             for i in ae.tolist():
                 grp = int(g[i])
                 ch = self.chains[grp]
                 mx, my, mz = int(bx[i]), int(by[i]), int(bz[i])
                 if mx < ch.floor:
-                    # The span bottom is below our truncation floor: log
-                    # replay cannot reach this follower — ship the snapshot
-                    # (throttled; it is the large message here) plus a
-                    # heartbeat probe. The probe keeps the device-level
-                    # reject/re-root loop alive, so once the follower has
-                    # installed, its reject hint (= snapshot id) re-roots
-                    # our send pointer above the floor within 2 ticks.
                     snap = self._snapshot_msg(grp, dst, int(tcol[i]))
                     if snap is not None:
                         out.append(snap)
@@ -262,36 +394,48 @@ class HostIO:
                 try:
                     blks = ch.range(mx, my)
                 except Exception:
-                    # Can't materialize the span (e.g. probe pointer on a
-                    # branch we no longer hold): send a pure heartbeat at the
-                    # probe point instead; the follower's reject hint will
-                    # re-root us.
                     log.warning("span (%#x, %#x] unavailable g=%d; heartbeat only",
                                 mx, my, grp)
                     by[i] = mx
                     bz[i] = min(mz, mx)
                 else:
-                    # Flow control: cap the frame at max_append_entries
-                    # blocks (a follower 1M blocks behind must catch up in
-                    # bounded frames, not one giant message). The device's
-                    # optimistic send pointer is re-rooted at the capped top
-                    # so the NEXT tick continues from there — a pipelined
-                    # chunked catch-up, no reject round-trips needed.
                     cap = self.max_append_entries
                     if cap is not None and len(blks) > cap:
                         blks = blks[:cap]
                         top = blks[-1].id
                         by[i] = top
                         bz[i] = min(mz, top)
-                        nxt_fixups.append((grp, dst, top))
+                        self._nxt_fixups.append((grp, dst, top))
                     batch.blocks[grp] = blks
             out.append(batch)
-        if nxt_fixups:
-            nt = np.array(self.state.nxt.t)
-            ns = np.array(self.state.nxt.s)
-            for g, dst, top in nxt_fixups:
-                nt[g, dst] = id_term(top)
-                ns[g, dst] = id_seq(top)
-            self.state = self.state.replace(
-                nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))
         return out
+
+    def _drain_nxt_fixups(self) -> None:
+        """Apply the outbox decoder's recorded send-pointer re-roots as ONE
+        vectorized scatter + device upload, just before the next dispatch
+        reads ``state.nxt``. Deferring from decode time to here (a) turns
+        K scalar writes into one scatter, and (b) keeps tick_finish free of
+        device-state syncs so ``tick_pipelined`` can decode tick t while
+        tick t+1 is in flight (an ``np.asarray(state.nxt)`` inside decode
+        would block on the in-flight step). Rows reset or recycled since
+        decode are purged by ``_reset_group`` before they reach this
+        scatter.
+
+        Known pipelined-mode cost: under ``tick_pipelined`` the decode
+        that records a fixup runs AFTER the next tick was dispatched with
+        the old ``nxt``, so a ``max_append_entries``-capped catch-up span
+        is re-read and re-sent once before the re-root lands (and a
+        device-side reject re-root from the intervening tick loses to
+        this scatter, costing one extra reject round trip). Fixing it
+        means decode consulting the pending fixup list as the effective
+        span bottom — in both the columnar path and its pinned scalar
+        reference — which is deliberately not done yet; followers only
+        pay while > cap behind."""
+        fx = np.asarray(self._nxt_fixups, np.int64).reshape(-1, 3)
+        self._nxt_fixups.clear()
+        nt = np.array(self.state.nxt.t)
+        ns = np.array(self.state.nxt.s)
+        nt[fx[:, 0], fx[:, 1]] = fx[:, 2] >> 32
+        ns[fx[:, 0], fx[:, 1]] = fx[:, 2] & 0xFFFFFFFF
+        self.state = self.state.replace(
+            nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))

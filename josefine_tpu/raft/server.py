@@ -305,6 +305,12 @@ class JosefineRaft:
         iteration steps the engine once and flushes its outbox."""
         interval = self.config.tick_ms / 1000
         max_window = max(1, int(getattr(self.config, "window_ticks", 1)))
+        # Double-buffered tick pipeline (raft.pipeline_ticks): keep one
+        # device dispatch in flight and do tick t's host work while the
+        # device computes t+1. res then describes the PREVIOUS tick — its
+        # outbound flushes one loop iteration later, the +1-tick latency
+        # the knob's docstring prices in.
+        pipeline = bool(getattr(self.config, "pipeline_ticks", False))
         try:
             while not self.shutdown.is_shutdown:
                 t0 = asyncio.get_running_loop().time()
@@ -323,7 +329,8 @@ class JosefineRaft:
                 w = min(got, self.engine.suggest_window(max_window))
                 if got > w:
                     self.pacer.release(self, got - w)
-                res = self.engine.tick(window=w)
+                res = (self.engine.tick_pipelined(window=w) if pipeline
+                       else self.engine.tick(window=w))
                 for ch in res.conf_changes:
                     if ch.node_id == self.config.id:
                         continue
@@ -347,4 +354,11 @@ class JosefineRaft:
             log.exception("tick loop crashed")
             self.shutdown.shutdown()
         finally:
+            if pipeline:
+                # Complete the in-flight tick so pending proposal futures
+                # resolve/fail deterministically instead of dangling.
+                try:
+                    self.engine.tick_drain()
+                except Exception:
+                    log.exception("pipeline drain failed")
             self.pacer.detach(self)

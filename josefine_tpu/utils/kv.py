@@ -15,7 +15,7 @@ from __future__ import annotations
 import os
 import sqlite3
 import threading
-from typing import Iterator
+from typing import Iterable, Iterator
 
 
 class DiskFault(OSError):
@@ -39,6 +39,14 @@ class KV:
     def delete(self, key: bytes) -> None:
         raise NotImplementedError
 
+    def put_many(self, items: Iterable[tuple[bytes, bytes]]) -> None:
+        """Write a batch of (key, value) pairs as one transaction where the
+        backend supports it (SqliteKV: one commit instead of one per put —
+        the chain's per-tick block writes ride this). Default: put() loop,
+        so every KV stays correct even without a native batch path."""
+        for k, v in items:
+            self.put(k, v)
+
     def scan_prefix(self, prefix: bytes) -> Iterator[tuple[bytes, bytes]]:
         raise NotImplementedError
 
@@ -61,6 +69,9 @@ class MemKV(KV):
 
     def delete(self, key):
         self._d.pop(key, None)
+
+    def put_many(self, items):
+        self._d.update((k, bytes(v)) for k, v in items)
 
     def scan_prefix(self, prefix):
         for k in sorted(self._d):
@@ -106,6 +117,19 @@ class SqliteKV(KV):
     def delete(self, key):
         with self._lock:
             self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._db.commit()
+
+    def put_many(self, items):
+        # One executemany + one commit: a tick's staged blocks across all
+        # groups land in a single WAL transaction (crash-atomic as a set,
+        # which is strictly safer than the per-put schedule — a partial
+        # tick can never persist a head pointer without its blocks when
+        # the caller orders blocks before pointers in the batch).
+        with self._lock:
+            self._db.executemany(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                [(k, bytes(v)) for k, v in items],
+            )
             self._db.commit()
 
     def scan_prefix(self, prefix):
@@ -164,6 +188,22 @@ class InterceptedKV(KV):
     def delete(self, key):
         self._hook("delete", key)
         self.inner.delete(key)
+
+    def put_many(self, items):
+        # Consult the hook per key (fault injection stays per-operation)
+        # and, on a fault, persist the prefix that already passed before
+        # re-raising — the same torn-write shape the per-put schedule this
+        # batch replaced would have produced (callers order blocks before
+        # pointers precisely so a persisted prefix is always safe).
+        items = list(items)
+        for n, (k, _) in enumerate(items):
+            try:
+                self._hook("put", k)
+            except Exception:
+                if n:
+                    self.inner.put_many(items[:n])
+                raise
+        self.inner.put_many(items)
 
     def scan_prefix(self, prefix):
         self._hook("scan", prefix)

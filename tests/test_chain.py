@@ -90,3 +90,106 @@ def test_compact_gc_dead_branches():
     assert removed == 1
     assert not ch.has(dead.id)
     assert ch.has(b1.id) and ch.has(w2.id)
+
+
+def test_range_many_matches_per_span_range():
+    ch = Chain(MemKV())
+    blocks = [ch.append(1, b"b%d" % i) for i in range(8)]
+    spans = [
+        (GENESIS, blocks[7].id),
+        (blocks[3].id, blocks[7].id),     # shared suffix with the first
+        (blocks[6].id, blocks[7].id),
+        (blocks[2].id, blocks[2].id),     # empty span
+    ]
+    got = ch.range_many(spans)
+    want = [ch.range(f, t) for f, t in spans]
+    assert got == want
+
+
+def test_range_many_error_semantics_match_range():
+    ch = Chain(MemKV())
+    b1 = ch.append(1, b"a")
+    with pytest.raises(ChainError):
+        ch.range_many([(GENESIS, pack_id(9, 9))])  # missing block
+    ch.append(1, b"b")
+    ch.commit(ch.head)
+    # Below-floor span raises like range() after truncation.
+    snapshot_point = ch.head
+    ch.truncate(snapshot_point)
+    with pytest.raises(ChainError):
+        ch.range_many([(GENESIS, b1.id)])
+
+
+def test_extend_many_single_transaction(tmp_path):
+    from josefine_tpu.utils.kv import SqliteKV
+
+    kv = SqliteKV(tmp_path / "c.db")
+    ch = Chain(kv)
+    leader = Chain(MemKV())
+    path = [leader.append(1, b"x%d" % i) for i in range(5)]
+    ch.extend_many(path)
+    assert ch.head == path[-1].id
+    assert [b.data for b in ch.range(GENESIS, ch.head)] == [b.data for b in path]
+    # Durable: reopen sees the same head and blocks.
+    ch2 = Chain(SqliteKV(tmp_path / "c.db"))
+    assert ch2.head == path[-1].id
+
+
+def test_extend_many_validation():
+    ch = Chain(MemKV())
+    leader = Chain(MemKV())
+    b1 = leader.append(1, b"a")
+    b2 = leader.append(1, b"b")
+    orphan = Block(id=pack_id(3, 9), parent=pack_id(3, 8))
+    with pytest.raises(ChainError):
+        ch.extend_many([b2])  # first parent unknown
+    with pytest.raises(ChainError):
+        ch.extend_many([b1, orphan])  # broken linkage
+    assert ch.head == GENESIS  # nothing persisted on failure
+    ch.extend_many([])  # no-op
+    ch.extend_many([b1, b2])
+    assert ch.head == b2.id
+
+
+def test_extend_many_does_not_regress_head():
+    ch = Chain(MemKV())
+    ch.append(1, b"a")
+    winner = Block(id=pack_id(5, 2), parent=ch.head, data=b"w")
+    ch.extend(winner)
+    # A late dead-branch run with lower ids must store blocks but keep head.
+    stale = Block(id=pack_id(1, 2), parent=pack_id(1, 1), data=b"s")
+    ch.extend_many([stale])
+    assert ch.head == winner.id
+    assert ch.has(stale.id)
+
+
+def test_kv_put_many_all_backends(tmp_path):
+    from josefine_tpu.utils.kv import InterceptedKV, SqliteKV
+
+    items = [(b"k%d" % i, b"v%d" % i) for i in range(4)]
+    for kv in (MemKV(), SqliteKV(tmp_path / "pm.db"),
+               InterceptedKV(MemKV(), lambda op, key: None)):
+        kv.put_many(list(items))
+        for k, v in items:
+            assert kv.get(k) == v
+
+
+def test_intercepted_put_many_torn_batch_prefix():
+    """A fault mid-batch persists the passed prefix, then raises — the
+    torn-write shape the per-put schedule produced (blocks-before-head
+    ordering makes any prefix safe)."""
+    from josefine_tpu.utils.kv import DiskFault, InterceptedKV
+
+    calls = []
+
+    def hook(op, key):
+        calls.append((op, key))
+        if key == b"k2":
+            raise DiskFault("injected")
+
+    kv = InterceptedKV(MemKV(), hook)
+    items = [(b"k%d" % i, b"v%d" % i) for i in range(4)]
+    with pytest.raises(DiskFault):
+        kv.put_many(list(items))
+    assert kv.inner.get(b"k0") == b"v0" and kv.inner.get(b"k1") == b"v1"
+    assert kv.inner.get(b"k2") is None and kv.inner.get(b"k3") is None

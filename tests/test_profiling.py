@@ -1,0 +1,142 @@
+"""PhaseProfiler unit tests + the engine's phase instrumentation."""
+
+import asyncio
+import json
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.profiling import NULL_PROFILER, PhaseProfiler
+
+
+def test_basic_phase_recording():
+    prof = PhaseProfiler()
+    for _ in range(5):
+        with prof.phase("a"):
+            pass
+    snap = prof.snapshot()
+    assert snap["a"]["count"] == 5
+    assert snap["a"]["total_ms"] >= 0
+    assert snap["a"]["max_ms"] >= snap["a"]["p50_ms"]
+
+
+def test_nested_phases_record_under_paths():
+    prof = PhaseProfiler()
+    with prof.phase("outer"):
+        with prof.phase("inner"):
+            pass
+        with prof.phase("inner"):
+            pass
+    snap = prof.snapshot()
+    assert set(snap) == {"outer", "outer/inner"}
+    assert snap["outer/inner"]["count"] == 2
+    assert snap["outer"]["count"] == 1
+    # Outer wall covers both inner phases.
+    assert snap["outer"]["total_ms"] >= snap["outer/inner"]["total_ms"]
+
+
+def test_disabled_profiler_records_nothing():
+    prof = PhaseProfiler(enabled=False)
+    with prof.phase("x"):
+        pass
+    prof.add_ns("y", 123)
+    assert prof.snapshot() == {}
+    # The shared null profiler behaves the same and is reusable.
+    with NULL_PROFILER.phase("z"):
+        with NULL_PROFILER.phase("z2"):
+            pass
+    assert NULL_PROFILER.snapshot() == {}
+
+
+def test_ring_is_bounded_but_totals_are_not():
+    prof = PhaseProfiler(ring=8)
+    for i in range(100):
+        prof.add_ns("p", 1000)
+    s = prof.snapshot()["p"]
+    assert s["count"] == 100
+    assert abs(s["total_ms"] - 0.1) < 1e-9
+
+
+def test_dump_json_roundtrip(tmp_path):
+    prof = PhaseProfiler()
+    with prof.phase("tick"):
+        pass
+    path = tmp_path / "prof.json"
+    raw = prof.dump_json(str(path))
+    assert json.loads(raw) == json.loads(path.read_text())
+    assert "tick" in json.loads(raw)
+
+
+def test_reset_clears_stats():
+    prof = PhaseProfiler()
+    prof.add_ns("a", 5)
+    prof.reset()
+    assert prof.snapshot() == {}
+
+
+def test_exception_inside_phase_still_records():
+    prof = PhaseProfiler()
+    try:
+        with prof.phase("boom"):
+            raise ValueError
+    except ValueError:
+        pass
+    assert prof.snapshot()["boom"]["count"] == 1
+    # The pooled context manager is reusable afterwards.
+    with prof.phase("ok"):
+        pass
+    assert prof.snapshot()["ok"]["count"] == 1
+
+
+def _run_cluster_ticks(sparse):
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+    engines = [RaftEngine(MemKV(), [0, 1, 2], i, groups=2, params=params,
+                          base_seed=i, sparse_io=sparse) for i in range(3)]
+    profs = [e.enable_profiling() for e in engines]
+    assert engines[0].enable_profiling() is profs[0]  # idempotent
+    for _ in range(12):
+        results = [e.tick() for e in engines]
+        for res in results:
+            for m in res.outbound:
+                engines[m.dst].receive(m)
+    return profs
+
+
+def test_engine_phases_recorded_dense_and_sparse():
+    async def main():
+        for sparse in (False, True):
+            profs = _run_cluster_ticks(sparse)
+            snap = profs[0].snapshot()
+            want = {"inbox", "dispatch", "fetch", "decode", "apply"}
+            if not sparse:
+                want.add("stage")  # sparse folds staging into inbox build
+            assert want <= set(snap), (sparse, sorted(snap))
+            for phase in want:
+                assert snap[phase]["count"] >= 12
+
+    asyncio.run(main())
+
+
+def test_profiling_does_not_change_results():
+    """Profiled and unprofiled engines produce identical protocol state
+    from the same seeds/schedule (the profiler is observation only)."""
+    async def main():
+        def run(profile):
+            params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+            engines = [RaftEngine(MemKV(), [0, 1, 2], i, groups=2,
+                                  params=params, base_seed=i)
+                       for i in range(3)]
+            if profile:
+                for e in engines:
+                    e.enable_profiling()
+            for _ in range(20):
+                results = [e.tick() for e in engines]
+                for res in results:
+                    for m in res.outbound:
+                        engines[m.dst].receive(m)
+            return [(list(e._h_role), list(e._h_term),
+                     [ch.head for ch in e.chains]) for e in engines]
+
+        assert run(False) == run(True)
+
+    asyncio.run(main())

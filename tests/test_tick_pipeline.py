@@ -1,0 +1,139 @@
+"""tick_pipelined: the double-buffered engine driver mode.
+
+Semantic (not byte-level) equivalence with tick(): the pipeline adds one
+tick of wire latency, so traffic schedules differ — but elections must
+converge, proposals must commit exactly once on every node, chains must
+agree, and mixing modes without tick_drain() must be refused.
+"""
+
+import asyncio
+
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class ListFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data: bytes) -> bytes:
+        self.applied.append(data)
+        return b"ok:" + data
+
+
+def make_cluster(groups=1, sparse=False):
+    engines, fsms = [], []
+    for i in range(3):
+        fsm = ListFsm()
+        fsms.append(fsm)
+        engines.append(RaftEngine(MemKV(), [0, 1, 2], i, groups=groups,
+                                  fsms={0: fsm}, params=PARAMS, base_seed=i,
+                                  sparse_io=sparse))
+    return engines, fsms
+
+
+def run_pipelined(engines, n, down=()):
+    for _ in range(n):
+        outbound = []
+        for i, e in enumerate(engines):
+            if i in down:
+                continue
+            outbound.extend(e.tick_pipelined().outbound)
+        for m in outbound:
+            if m.dst not in down:
+                engines[m.dst].receive(m)
+
+
+def wait_leader_pipelined(engines, max_ticks=120, down=()):
+    for _ in range(max_ticks):
+        run_pipelined(engines, 1, down=down)
+        leaders = [i for i, e in enumerate(engines)
+                   if i not in down and e.is_leader(0)]
+        if len(leaders) == 1:
+            lidx = leaders[0]
+            if all(engines[i].leader_index(0) == lidx
+                   for i in range(len(engines)) if i not in down):
+                return lidx
+    raise AssertionError("no leader elected under pipelined ticks")
+
+
+@pytest.mark.parametrize("sparse", [False, True])
+def test_pipelined_election_and_commit(sparse):
+    async def main():
+        engines, fsms = make_cluster(sparse=sparse)
+        lead = wait_leader_pipelined(engines)
+        fut = engines[lead].propose(0, b"hello")
+        run_pipelined(engines, 14)
+        assert fut.done()
+        assert (await fut) == b"ok:hello"
+        for e in engines:
+            e.tick_drain()
+        for fsm in fsms:
+            assert fsm.applied == [b"hello"]
+        heads = {e.chains[0].head for e in engines}
+        assert len(heads) == 1
+
+    asyncio.run(main())
+
+
+def test_pipelined_sustained_load_commits_exactly_once():
+    async def main():
+        engines, fsms = make_cluster()
+        lead = wait_leader_pipelined(engines)
+        futs = []
+        for k in range(10):
+            futs.append(engines[lead].propose(0, b"p%d" % k))
+            run_pipelined(engines, 3)
+        run_pipelined(engines, 20)
+        for e in engines:
+            e.tick_drain()
+        for f in futs:
+            assert f.done() and f.exception() is None
+        want = [b"p%d" % k for k in range(10)]
+        for fsm in fsms:
+            assert fsm.applied == want
+
+    asyncio.run(main())
+
+
+def test_mixing_tick_and_pipeline_requires_drain():
+    async def main():
+        engines, _ = make_cluster()
+        e = engines[0]
+        e.tick_pipelined()
+        with pytest.raises(RuntimeError):
+            e.tick()
+        res = e.tick_drain()
+        assert res is not None
+        assert e.tick_drain() is None  # empty pipeline -> None
+        e.tick()  # sequential mode works again
+
+    asyncio.run(main())
+
+
+def test_pipelined_leader_failover():
+    """The +1-tick latency must not break failover: crash the leader, the
+    survivors re-elect and keep committing under pipelined ticks."""
+    async def main():
+        engines, fsms = make_cluster()
+        lead = wait_leader_pipelined(engines)
+        fut = engines[lead].propose(0, b"one")
+        run_pipelined(engines, 14)
+        await fut
+        lead2 = wait_leader_pipelined(engines, down=(lead,))
+        assert lead2 != lead
+        fut2 = engines[lead2].propose(0, b"two")
+        run_pipelined(engines, 14, down=(lead,))
+        assert (await fut2) == b"ok:two"
+        live = [i for i in range(3) if i != lead]
+        for i in live:
+            engines[i].tick_drain()
+        for i in live:
+            assert fsms[i].applied == [b"one", b"two"]
+
+    asyncio.run(main())

@@ -44,11 +44,23 @@ chaos_smoke() {
         --horizon 200
 }
 
+perf_smoke() {
+    # Host-bridge perf floor: bench_engine.py --profile at P=1k for a few
+    # ticks on CPU; fail if ms/tick regresses >2x vs tools/perf_floor.json
+    # (the checked-in floor). Catches silent re-growth of the per-entry
+    # Python path; prints the per-phase breakdown so a failure names the
+    # phase. Regenerate the floor after intentional perf changes with
+    # `python tools/perf_smoke.py --write-floor`.
+    echo "== perf smoke =="
+    python tools/perf_smoke.py
+}
+
 echo "== tests =="
 if [[ "${1:-}" == "quick" ]]; then
     python -m pytest tests/test_chained_raft.py tests/test_engine.py \
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
+    perf_smoke
 else
     # Chunked to fit runner time limits; order mirrors the dependency
     # stack (kernel -> engine -> broker -> chaos).
@@ -58,7 +70,9 @@ else
         tests/test_window.py tests/test_chain.py tests/test_snapshot.py \
         tests/test_membership.py tests/test_raft_server.py \
         tests/test_rpc_batch.py tests/test_tcp_coalesce.py \
-        tests/test_config.py tests/test_pacer.py -q
+        tests/test_config.py tests/test_pacer.py \
+        tests/test_decode_differential.py tests/test_tick_pipeline.py \
+        tests/test_profiling.py -q
     # Real-socket timing suite in its own chunk: it shares the box with no
     # other suite so CPU contention cannot flake its wall-clock deadlines
     # (ADVICE r3).
@@ -75,5 +89,6 @@ else
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_reset_safety.py -q
     chaos_smoke
+    perf_smoke
 fi
 echo "CI OK"

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""perf-smoke CI stage: the host bridge must not silently re-grow.
+
+Runs ``bench_engine.py --profile`` at the floor file's P for a few ticks on
+the CPU backend and FAILS (exit 1) if ms/tick regresses beyond the allowed
+ratio against the checked-in floor (``tools/perf_floor.json``). The floor
+ratio is deliberately loose (2x by default): CI boxes vary, and the stage
+exists to catch the "someone re-grew the per-entry Python path" class of
+regression (10-50x at scale), not 10% noise. The per-phase profile is
+printed either way, so a failing run says WHERE the regression lives.
+
+Regenerate the floor after an intentional perf change:
+
+    python tools/perf_smoke.py --write-floor
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLOOR_PATH = os.path.join(ROOT, "tools", "perf_floor.json")
+
+
+def run_bench(floor: dict) -> dict:
+    out = os.path.join(tempfile.gettempdir(),
+                       "josefine_perf_smoke_%d.json" % os.getpid())
+    cmd = [
+        sys.executable, os.path.join(ROOT, "bench_engine.py"),
+        "--platform", "cpu",
+        "--sizes", str(floor["P"]),
+        "--ticks", str(floor.get("ticks", 20)),
+        "--warmup", str(floor.get("warmup", 20)),
+        "--profile",
+        "--out", out,
+    ]
+    env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
+    subprocess.run(cmd, check=True, cwd=ROOT, env=env,
+                   timeout=floor.get("timeout_s", 600))
+    try:
+        with open(out) as f:
+            rows = json.load(f)["results"]
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    return next(r for r in rows if r["P"] == floor["P"])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write-floor", action="store_true",
+                    help="measure and (re)write tools/perf_floor.json "
+                         "instead of checking against it")
+    args = ap.parse_args()
+
+    if args.write_floor:
+        floor = {"P": 1000, "ticks": 20, "warmup": 20, "max_regression": 2.0}
+        row = run_bench(floor)
+        floor["ms_per_tick_floor"] = row["ms_per_tick"]
+        floor["recorded_profile"] = row.get("extra", {}).get("profile_phases")
+        with open(FLOOR_PATH, "w") as f:
+            json.dump(floor, f, indent=1)
+        print(f"floor written: {row['ms_per_tick']} ms/tick at "
+              f"P={floor['P']} -> {FLOOR_PATH}")
+        return 0
+
+    with open(FLOOR_PATH) as f:
+        floor = json.load(f)
+    row = run_bench(floor)
+    ms = row["ms_per_tick"]
+    limit = floor["ms_per_tick_floor"] * floor.get("max_regression", 2.0)
+    phases = row.get("extra", {}).get("profile_phases", {})
+    print(f"perf-smoke: P={floor['P']} ms/tick={ms} "
+          f"(floor {floor['ms_per_tick_floor']}, limit {round(limit, 2)})")
+    for phase, s in sorted(phases.items()):
+        print(f"  {phase:>10}: {s['ms_per_round']:8.3f} ms/round "
+              f"(p99 {s['p99_ms']} ms)")
+    if ms > limit:
+        print(f"perf-smoke FAILED: host bridge regressed "
+              f"{round(ms / floor['ms_per_tick_floor'], 2)}x past the "
+              f"{floor.get('max_regression', 2.0)}x budget", file=sys.stderr)
+        return 1
+    print("perf-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
