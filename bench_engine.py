@@ -29,6 +29,15 @@ ticks). --pipeline drives the cluster through engine.tick_pipelined
 commit p50 roughly doubles — recorded by the latency axis). --proposals
 sets the offered client load (distinct groups offered one payload per
 tick).
+
+--active-set runs the engines under the active-set compacted scheduler
+(raft.active_set): only groups the wake predicate proves changeable go
+through the device step, the idle rest through the decay kernel, adding
+the compact/scatter/decay phases to the profile. --active-frac F makes
+the offered load an activity fraction — exactly round(F*P) distinct
+groups get one payload per tick (the dense-vs-active-set comparison
+axis; both knobs land in the row and the merge key, so dense and
+active-set rows of the same size coexist in BENCH_engine.json).
 """
 
 from __future__ import annotations
@@ -92,18 +101,29 @@ class _BenchFsm:
 
 async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                     pipeline: bool = False, profile: bool = False,
-                    proposals_per_tick: int = PROPOSALS_PER_TICK) -> dict:
+                    proposals_per_tick: int = PROPOSALS_PER_TICK,
+                    active_set: bool = False,
+                    active_frac: float | None = None) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
     # leader is 200k messages/tick of pure liveness noise). Election
     # timers stay at 3-8 ticks because transport traffic feeds the
     # aggregate keepalive (engine peer_fresh / kernel node_step).
     params = step_params(timeout_min=3, timeout_max=8, hb_ticks=16)
+    if active_frac is not None:
+        # Offered load AS an activity fraction: exactly round(frac * P)
+        # distinct groups get one payload per tick (a permutation slice,
+        # not integers() — sampling with replacement at frac 1.0 would
+        # only touch ~63% of groups). The steady-state active fraction
+        # runs ~3x the offered one (mint + ack + commit echo ticks), so
+        # the row records both (extra.active_set_stats when active).
+        proposals_per_tick = max(1, round(active_frac * P))
     t0 = time.perf_counter()
     fsm = _BenchFsm()  # stateless: one instance can serve every group
     engines = [
         RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params,
-                   fsms={g: fsm for g in range(P)})
+                   fsms={g: fsm for g in range(P)},
+                   active_set=active_set)
         for i in range(N)
     ]
     init_s = time.perf_counter() - t0
@@ -167,7 +187,10 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         for m in outbound:
             engines[m.dst].receive(m)
         if live:
-            groups = rng.integers(0, P, proposals_per_tick)
+            if active_frac is not None:
+                groups = rng.permutation(P)[:proposals_per_tick]
+            else:
+                groups = rng.integers(0, P, proposals_per_tick)
             for g in set(int(g) for g in groups):
                 for e in engines:
                     if e.is_leader(g):
@@ -177,14 +200,27 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                         break
         poll_latencies()
 
+    # Warm up UNDER the offered load: steady state includes the client
+    # lane, and for --active-set the load sets which power-of-two bucket
+    # the compact step runs in — idle warmup would leave that shape to
+    # compile inside the timed loop (a one-off multi-second XLA compile
+    # polluting a 20-tick measurement). Counters reset below either way.
     for _ in range(warmup):
-        one_tick(live=False)
+        one_tick(live=True)
     leaders = sum(int((e._h_role == 2).sum()) for e in engines)
 
     proposed = committed = 0
     executed = [0] * N
+    # The discarded warmup futures may still get NotLeader set later (the
+    # drivers hold references) — retrieve it so the drop doesn't spray
+    # "exception was never retrieved" into the bench output at GC.
+    for fut, _ in pending_lat:
+        fut.add_done_callback(lambda f: f.cancelled() or f.exception())
     pending_lat.clear()
     latencies.clear()
+    for e in engines:
+        e.active_sched_ticks = e.active_sched_rows = 0
+        e.active_fallback_ticks = 0
     if profile:
         for e in engines:
             e.profiler.reset()  # profile the timed loop only
@@ -192,6 +228,8 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     for _ in range(ticks):
         one_tick(live=True)
     dt = time.perf_counter() - t0
+    sched_snap = [(e.active_sched_ticks, e.active_sched_rows,
+                   e.active_fallback_ticks) for e in engines]
     # Windows each dispatch ACTUALLY executed during the timed loop
     # (suggest_window / tick_begin may clamp below the requested --window);
     # min across the cluster's engines is the conservative tick count.
@@ -224,6 +262,8 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     row = {
         "P": P,
         "nodes": N,
+        "active_set": active_set,
+        "active_frac": active_frac,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
         "ticks": dev_ticks,
@@ -240,6 +280,20 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         "proposals_per_sec": round(proposed / dt, 1),
     }
     extra = {}
+    if active_set:
+        # Measured scheduler behavior over the timed loop (cluster totals):
+        # how often compaction actually ran, the realized active fraction
+        # (proposal echo makes it ~3x the offered --active-frac), and any
+        # dense fallbacks (active fraction above the threshold).
+        s_ticks = sum(s[0] for s in sched_snap)
+        extra["active_set_stats"] = {
+            "sched_ticks": s_ticks,
+            "fallback_ticks": sum(s[2] for s in sched_snap),
+            "avg_active_rows": round(
+                sum(s[1] for s in sched_snap) / max(1, s_ticks), 1),
+            "avg_active_frac": round(
+                sum(s[1] for s in sched_snap) / max(1, s_ticks) / P, 4),
+        }
     if latencies:
         lat = np.asarray(latencies)
         extra["commit_latency_ticks"] = {
@@ -351,6 +405,15 @@ async def main():
     ap.add_argument("--proposals", type=int, default=PROPOSALS_PER_TICK,
                     help="distinct groups offered one payload per tick "
                          "(the offered client load)")
+    ap.add_argument("--active-set", action="store_true",
+                    help="engines run the active-set compacted scheduler "
+                         "(raft.active_set): only provably-changeable "
+                         "groups go through the device step")
+    ap.add_argument("--active-frac", type=float, default=None,
+                    help="offered activity as a fraction of P: exactly "
+                         "round(frac*P) distinct groups get one proposal "
+                         "per tick (overrides --proposals; the dense-vs-"
+                         "active-set comparison axis)")
     ap.add_argument("--kernel", action="store_true",
                     help="time the bare packed step only (no cluster, no wire)")
     ap.add_argument("--out", default=None,
@@ -372,7 +435,9 @@ async def main():
                 ticks = min(200, ticks)
             r = await bench_one(P, ticks, args.warmup, window=args.window,
                                 pipeline=args.pipeline, profile=args.profile,
-                                proposals_per_tick=args.proposals)
+                                proposals_per_tick=args.proposals,
+                                active_set=args.active_set,
+                                active_frac=args.active_frac)
         results.append(r)
         print(json.dumps(r))
 
@@ -410,10 +475,16 @@ async def main():
 
     def _key(r):
         # Legacy rows lacking the newer keys are single-tick, non-pipelined,
-        # 256-proposal measurements — normalize so a rerun replaces them
-        # instead of leaving a stale twin row beside the fresh one.
+        # 256-proposal, dense-scheduler measurements — normalize so a rerun
+        # replaces them instead of leaving a stale twin row beside the
+        # fresh one.
+        # active_frac must sort against legacy rows' None — normalize to a
+        # float sentinel so mixed keys stay orderable.
+        frac = r.get("active_frac")
         return (r["P"], r.get("window") or 1, bool(r.get("pipeline")),
-                r.get("proposals_per_tick", 256))
+                r.get("proposals_per_tick", 256),
+                bool(r.get("active_set")),
+                -1.0 if frac is None else float(frac))
 
     merged = {_key(r): r for r in results}
     try:

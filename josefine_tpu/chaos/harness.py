@@ -138,7 +138,8 @@ class ChaosCluster(_PlaneDrivenCluster):
                  sparse: bool = False, k_out: int | None = None,
                  plane: FaultPlane | None = None, net: NetFaults | None = None,
                  auto_crash: bool = True, auto_links: bool = True,
-                 propose_rate: float = 0.15, max_proposals: int = 40):
+                 propose_rate: float = 0.15, max_proposals: int = 40,
+                 active_set: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -149,6 +150,11 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.k_out = k_out
         self.auto_crash = auto_crash
         self.auto_links = auto_links
+        # Engines run the active-set compacted scheduler: chaos schedules
+        # (partition heals = mass wake-ups, crash/restart churn) are the
+        # hostile environment for its wake predicate, so nemesis runs can
+        # pin the invariants under it, not just fault-free equality.
+        self.active_set = active_set
         self.propose_rate = propose_rate
         self.max_proposals = max_proposals
         self.ids = list(range(1, n_nodes + 1))
@@ -172,6 +178,7 @@ class ChaosCluster(_PlaneDrivenCluster):
             params=self.params, base_seed=100 + i,
             snapshot_threshold=6,
             sparse_io=True if self.sparse else None,
+            active_set=self.active_set,
         )
         if self.k_out is not None:
             e._k_out = self.k_out

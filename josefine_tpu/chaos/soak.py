@@ -14,9 +14,10 @@ from __future__ import annotations
 import asyncio
 
 from josefine_tpu.chaos.faults import FaultPlane, NetFaults
-from josefine_tpu.chaos.harness import ChaosCluster
+from josefine_tpu.chaos.harness import DEFAULT_PARAMS, ChaosCluster
 from josefine_tpu.chaos.invariants import InvariantViolation
 from josefine_tpu.chaos.nemesis import SCHEDULES, Nemesis, Schedule
+from josefine_tpu.models.types import step_params
 from josefine_tpu.utils.metrics import REGISTRY
 
 
@@ -34,16 +35,28 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          groups: int = 2, window: int = 1,
                          net: NetFaults | None = None,
                          auto_faults: bool = False,
-                         horizon: int | None = None) -> dict:
+                         horizon: int | None = None,
+                         active_set: bool = False,
+                         hb_ticks: int | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
-    the bundled schedules' invariant guarantees are stated against."""
+    the bundled schedules' invariant guarantees are stated against.
+
+    ``hb_ticks`` overrides the harness default of 1: per-tick heartbeats
+    wake every row every tick, so an --active-set soak at the default
+    spends nearly all its ticks in the dense fallback. Raising it opens
+    quiescent gaps between heartbeats and makes the soak exercise the
+    compacted gather/step/scatter/decay path the flag asks for (the
+    summary's active_set_stats shows which path actually ran)."""
     sched = resolve_schedule(schedule, n_nodes)
     plane = FaultPlane(seed, n_nodes, net=net)
+    params = DEFAULT_PARAMS if hb_ticks is None else step_params(
+        timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
     cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
-                           window=window, plane=plane,
-                           auto_crash=auto_faults, auto_links=auto_faults)
+                           window=window, plane=plane, params=params,
+                           auto_crash=auto_faults, auto_links=auto_faults,
+                           active_set=active_set)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -71,6 +84,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         "nodes": n_nodes,
         "groups": groups,
         "window": window,
+        "active_set": active_set,
         "ticks": cluster.tick_no,
         "proposed": cluster.proposed,
         "acked": acked_total,
@@ -80,6 +94,12 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
             for name, m in sorted(REGISTRY._metrics.items())
             if name.startswith("chaos_")
         },
+        "active_set_stats": {
+            "compacted_ticks": sum(e.active_sched_ticks
+                                   for e in cluster.engines),
+            "fallback_ticks": sum(e.active_fallback_ticks
+                                  for e in cluster.engines),
+        } if active_set else None,
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "event_log": plane.event_log_jsonl(),

@@ -84,6 +84,18 @@ class RaftConfig:
     # same way. Off by default — turn on for throughput-bound deployments
     # at large P where device latency dominates the tick.
     pipeline_ticks: bool = False
+    # Active-set compacted stepping: each tick the engine proves which
+    # groups can change (pending traffic, proposals, election/heartbeat
+    # timers inside the window horizon), steps ONLY those through the
+    # device kernel in a power-of-two bucket, and advances the quiescent
+    # rest with a closed-form timer decay — at 100k mostly-idle groups the
+    # device step stops paying for the idle 95%+. Bit-exact with the dense
+    # schedule (tests/test_active_set.py); auto-falls-back to the dense
+    # step on any tick where most groups are active (e.g. cold-start
+    # election storms). Off by default: at small P the dense step is
+    # already cheap and the scheduler is pure overhead. Incompatible with
+    # engine.partitions > 1 (the sharded engine keeps the dense schedule).
+    active_set: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120

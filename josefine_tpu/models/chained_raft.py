@@ -237,7 +237,12 @@ def node_step(
     what vmap's batching rules emit for it — but its hand-vectorized twin
     (``ops/pallas_step._tile_step``) mirrors it statement for statement, and
     ``tests/test_pallas_step.py`` asserts exact integer equality between the
-    two. Any semantic change here must be mirrored there.
+    two. Any semantic change here must be mirrored there — and in
+    :func:`decay_idle`, the closed form of this function restricted to
+    provably idle rows (the active-set scheduler's quiescent path): a change
+    to the timer arithmetic in §2/§6 must update decay_idle (and its scalar
+    twin ``py_step.py_decay_idle``) or active-set stepping diverges from
+    dense stepping. ``tests/test_active_set.py`` pins all three.
     """
     N = member.shape[0]
     dstN = jnp.arange(N, dtype=_I32)
@@ -429,6 +434,62 @@ def node_step(
         became_leader=elected & st_in.alive,
     )
     return st, out, metrics
+
+
+def decay_idle(params: StepParams, state: NodeState, peer_fresh, ticks,
+               xp=jnp) -> NodeState:
+    """Advance ``ticks`` ticks of :func:`node_step` for rows that are
+    provably IDLE — the active-set scheduler's quiescent path.
+
+    For a row with an empty inbox and zero proposals, node_step can only
+    move two fields: ``elapsed`` (§2 timers) and ``hb_elapsed`` (§6
+    broadcast cadence). Everything else is invariant: the inbox fold is a
+    no-op on MSG_NONE, the election tally cannot promote without new votes,
+    minting needs proposals, the leader self-progress write is idempotent
+    (a leader's match/nxt self rows already equal its head — head only
+    moves on mint/election, both of which refresh them), and quorum commit
+    cannot advance without match movement. This function is therefore the
+    exact closed form of K idle node_step ticks PROVIDED the caller's wake
+    predicate holds (see ``packed_step.host_wake_mask``):
+
+    * no election fire within the window: for keepalive-held rows
+      (``ka``) the hold cannot lapse mid-window, otherwise
+      ``elapsed + ticks < timeout``;
+    * no leader heartbeat due within the window
+      (``hb_elapsed + ticks - 1 < hb_ticks``);
+    * no lagging-peer AE (``nxt < head``) — an idle leader's optimistic
+      send pointers equal its head (node_step advances nxt on every AE
+      send, and the engine force-wakes the AE-cap re-roots that undo
+      that — there is no generic "changed last tick" carry to rely on).
+
+    Per tick: non-leaders ``elapsed += 1`` unless the aggregate keepalive
+    resets it (same rule as node_step §2 — leader known, its node fresh,
+    ``hb_elapsed < hb_ticks * 8``); leaders hold ``elapsed = 0``; everyone
+    alive ``hb_elapsed += 1``; crashed rows are frozen entirely. ``ka`` is
+    window-stable for quiescent rows (peer_fresh is fixed per dispatch and
+    the predicate wakes rows whose hb-staleness bound could lapse), so K
+    ticks collapse to one vectorized update. ``xp`` selects the array
+    backend (jnp for the device kernel, np for the scalar-engine twin).
+
+    Mirror contract: any change here must be mirrored in
+    ``py_step.py_decay_idle`` and re-checked against node_step by
+    tests/test_active_set.py's decay oracle suite.
+    """
+    N = state.votes.shape[-1]
+    is_leader = state.role == LEADER
+    if peer_fresh is None:
+        ka = xp.zeros(state.role.shape, bool)
+    else:
+        lead = xp.clip(state.leader, 0, N - 1)
+        ka = ((state.leader >= 0) & (peer_fresh[lead] != 0)
+              & (state.hb_elapsed < params.hb_ticks * 8))
+    elapsed = xp.where(is_leader | ka, 0, state.elapsed + ticks)
+    hb = state.hb_elapsed + ticks
+    alive = state.alive
+    return state.replace(
+        elapsed=xp.where(alive, elapsed, state.elapsed).astype(state.elapsed.dtype),
+        hb_elapsed=xp.where(alive, hb, state.hb_elapsed).astype(state.hb_elapsed.dtype),
+    )
 
 
 # vmap over the node axis, then the partition axis. ``peer_fresh`` is a

@@ -317,6 +317,27 @@ def py_node_step(st: PyNode, member: list[bool], inbox: list[PyMsg],
     return st, out, met
 
 
+def py_decay_idle(st: PyNode, ticks: int, hb_ticks: int,
+                  peer_fresh: list | None = None) -> PyNode:
+    """Scalar oracle of ``chained_raft.decay_idle``: ``ticks`` idle
+    :func:`py_node_step` ticks (empty inbox, zero proposals) collapsed to
+    the closed-form timer update. Valid only for rows the active-set wake
+    predicate left quiescent (no election fire, no heartbeat due, no
+    lagging peer, keepalive hold window-stable — see decay_idle's
+    docstring); tests/test_active_set.py checks this function equals the
+    full step on exactly those rows."""
+    if not st.alive:
+        return st
+    st = replace(st)
+    is_leader = st.role == LEADER
+    ka = (peer_fresh is not None and st.leader >= 0
+          and peer_fresh[min(max(st.leader, 0), st.n - 1)]
+          and st.hb_elapsed < hb_ticks * 8)
+    st.elapsed = 0 if (is_leader or ka) else st.elapsed + ticks
+    st.hb_elapsed = st.hb_elapsed + ticks
+    return st
+
+
 # --------------------------------------------------------------- clusters
 
 

@@ -62,13 +62,23 @@ from josefine_tpu.raft.group_admin import (
 from josefine_tpu.raft.hostio import HostIO
 from josefine_tpu.raft.membership import ConfChange, MemberTable, is_conf
 from josefine_tpu.raft.packed_step import (
+    _MIRROR13_ROWS,
+    _active_window_fn,
+    _decay_only_fn,
+    _decay_scatter_fn,
+    _gather_active,
     _node_view,
     _packed_over_groups,
+    _py_active_window,
+    _py_decay_scatter,
+    _py_gather_active,
     _py_packed_step,
     _py_packed_window,
     _py_sparse_window,
     _sparse_window_fn,
     _window_step_fn,
+    active_bucket,
+    host_wake_mask,
 )
 from josefine_tpu.raft.result import NotLeader, TickResult
 from josefine_tpu.raft.snap_transfer import SnapshotTransfer, _SnapStream
@@ -119,6 +129,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         backend: str = "jax",
         max_append_entries: int | None = 64,
         sparse_io: bool | None = None,
+        active_set: bool = False,
         mesh=None,
     ):
         self.kv = kv
@@ -353,6 +364,58 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # large P, where dense per-tick transfers are megabytes of zeros.
         self._sparse = (groups > 4096) if sparse_io is None else bool(sparse_io)
         self._backend = backend
+        # Active-set compacted stepping (see the packed_step.py active-set
+        # commentary and ARCHITECTURE.md "Active-set scheduling"): per tick
+        # the host proves which rows can change this window (host_wake_mask
+        # over the mirrors below plus pending IO / force-woken rows),
+        # gathers exactly those into a power-of-two bucket, steps the
+        # bucket through the same window step as the dense path, and
+        # advances every quiescent row through the closed-form decay
+        # kernel. Off by default (the dense/sparse step over all P rows);
+        # bit-exactness between the two is pinned by
+        # tests/test_active_set.py.
+        if active_set and mesh is not None:
+            # Gather/scatter by arbitrary row ids across a sharded P axis
+            # would turn the pure data-parallel step into all-to-all
+            # traffic; the sharded engine keeps the dense schedule.
+            raise ValueError("active_set requires an unsharded engine (mesh=None)")
+        self._active_set = bool(active_set)
+        # Auto-fallback: when the scheduler wakes more than this fraction
+        # of rows, compaction overhead exceeds the dense step's — run the
+        # plain dense/sparse dispatch for the tick (timer mirrors refetch
+        # on re-entry).
+        self.active_fallback_frac = 0.5
+        # Rows that MUST be stepped next tick regardless of the wake
+        # predicate: reset/recycled rows, snapshot installs, send-pointer
+        # fixups, claim changes — every out-of-tick device-state mutation
+        # site registers itself here.
+        self._force_active: set[int] = set()
+        self._wake_role, self._wake_leader = self._h_role, self._h_leader
+        # Active sets dispatched but not yet adopted by tick_finish (the
+        # pipelined driver schedules tick t+1 before tick t's finish runs,
+        # so those rows' mirrors are stale — forcing them active keeps the
+        # wake predicate sound on mirrors one tick behind).
+        self._sched_pending: list[np.ndarray] = []
+        # True after a dense/sparse tick ran while active_set is on: the
+        # timer mirrors below were not maintained and must be refetched
+        # before the next active schedule.
+        self._timers_stale = False
+        # Host timer mirrors (the wake predicate's inputs): exact for every
+        # quiescent row by construction (the host decay arithmetic IS the
+        # device decay kernel), refreshed for active rows from the compact
+        # step's 13-row mirror fetch. alive never moves on the engine path
+        # (crash() is model-level fault injection), so it is a startup
+        # snapshot.
+        self._h_elapsed = np.zeros(groups, np.int32)
+        self._h_timeout = np.array(np.asarray(self.state.timeout), np.int32)
+        self._h_hb = np.zeros(groups, np.int32)
+        self._h_alive = np.array(np.asarray(self.state.alive), bool)
+        # Scheduler observability (read by bench_engine's active-set rows):
+        # ticks run compacted vs through the dense fallback, and the summed
+        # active-row count (avg active fraction = rows / (ticks * P)).
+        self.active_sched_ticks = 0
+        self.active_sched_rows = 0
+        self.active_fallback_ticks = 0
         # Adaptive outbox-compaction capacity: grows on overflow and shrinks
         # again after a long quiet run (each size is its own compiled
         # variant, cached by jit, so resizing costs at most one compile per
@@ -652,6 +715,151 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         fresh[self.me] = False
         return fresh.astype(np.int32)
 
+    # ------------------------------------------------- active-set scheduler
+
+    def _schedule_active(self, window: int, pf: np.ndarray) -> np.ndarray | None:
+        """Partition this tick's groups: returns the sorted active-set ids
+        (rows a ``window``-tick dispatch could change beyond decay), or
+        None to fall back to the dense/sparse dispatch (active fraction
+        above ``active_fallback_frac``). Pure host work — no device sync
+        except the one-off timer-mirror refetch after a fallback run.
+
+        The set is the union of the predicate family:
+
+        * :func:`packed_step.host_wake_mask` over the host mirrors —
+          election-timer and heartbeat horizons, candidates, leaderless
+          member rows;
+        * host-known IO: pending wire messages/batches, queued proposals;
+        * force-woken rows (reset/recycle, snapshot install, nxt fixups,
+          claim changes — ``_force_active``);
+        * rows dispatched but not yet adopted (``_sched_pending``): under
+          tick_pipelined the next begin runs before the previous finish,
+          so those rows' mirrors are one tick stale — keeping them active
+          makes the staleness harmless (their fresh state is on device).
+        """
+        role, leader = self._h_role, self._h_leader
+        if self._timers_stale:
+            # Re-entering active mode after a dense/sparse fallback tick:
+            # those steps do not return timers, so refetch the three
+            # (P,)-vectors once (mode transitions only, never steady state).
+            # tick_finish never diffs timer mirrors, so overwriting them is
+            # safe even with the fallback tick's finish still pending.
+            self._h_elapsed = np.array(np.asarray(self.state.elapsed), np.int32)
+            self._h_timeout = np.array(np.asarray(self.state.timeout), np.int32)
+            self._h_hb = np.array(np.asarray(self.state.hb_elapsed), np.int32)
+            # Role/leader too — but as LOCALS, never into the mirrors.
+            # Under tick_pipelined this begin runs BEFORE the fallback
+            # tick's finish, and the fallback has no _sched_pending entry
+            # protecting its rows: judged on the mirror, a follower that
+            # reached candidacy during the dense tick would read as a
+            # quiescent FOLLOWER with elapsed=0 and sleep through its own
+            # election, so the predicate needs the post-step values
+            # (self.state is already post-step here — tick_begin replaces
+            # it at dispatch). The MIRRORS however are the pending finish's
+            # pre-step baseline: it diffs _h_role/_h_leader to emit
+            # lost_leadership and drop NotLeader waiters, so clobbering
+            # them would swallow every transition of the fallback tick.
+            # The finish adopts them on its own schedule (split-phase: it
+            # already has, and these locals equal the mirrors).
+            role = np.array(np.asarray(self.state.role), np.int64)
+            leader = np.array(np.asarray(self.state.leader), np.int64)
+            self._timers_stale = False
+        # _decay_mirrors must advance the quiescent timers with the same
+        # role/leader view the device decay kernel sees (post-step on a
+        # fallback re-entry tick), not the possibly-stale mirrors.
+        self._wake_role, self._wake_leader = role, leader
+        wake = host_wake_mask(
+            int(self.params.hb_ticks), role, leader,
+            self._h_elapsed, self._h_timeout, self._h_hb, self._h_alive,
+            self._mask_np[:, self.me], pf, window)
+        for b in self._pending_batches:
+            wake[b.group] = True
+        if self._pending_msgs:
+            wake[np.fromiter((m.group for m in self._pending_msgs),
+                             np.intp, len(self._pending_msgs))] = True
+        if self._prop_groups:
+            wake[np.fromiter(self._prop_groups, np.intp,
+                             len(self._prop_groups))] = True
+        if self._force_active:
+            fa = [g for g in self._force_active if 0 <= g < self.P]
+            if fa:
+                wake[fa] = True
+            # Cleared even on fallback below: the dense step covers every
+            # row, which is exactly what a force-wake asks for.
+            self._force_active.clear()
+        for gp in self._sched_pending:
+            wake[gp] = True
+        G = np.nonzero(wake)[0]
+        if len(G) > self.active_fallback_frac * self.P:
+            return None
+        return G
+
+    def _step_active(self, G: np.ndarray, k: int, vals: np.ndarray,
+                     pf: np.ndarray, window: int, prof):
+        """Gather the active rows into the bucket, run the compact window
+        step, and scatter back fused with the quiescent decay kernel.
+        Returns (new full state, flat output or None, upload/fetch bytes)."""
+        A = len(G)
+        if A == 0:
+            # All-quiescent tick: decay IS the device step; nothing to
+            # gather, step, or fetch.
+            with prof.phase("dispatch"):
+                if self._backend == "python":
+                    new_state = cr.decay_idle(
+                        self.params, jax.tree.map(np.array, self.state),
+                        pf, window, xp=np)
+                else:
+                    new_state = _decay_only_fn(window)(
+                        self.params, self.state, jnp.asarray(pf))
+            return new_state, None, 0, 0
+        idx = np.full(k, self.P, np.int32)
+        idx[:A] = G
+        if self._backend == "python":
+            with prof.phase("compact"):
+                state_c, member_c = _py_gather_active(
+                    self.state, self.member, idx)
+            with prof.phase("dispatch"):
+                new_rows, flat = _py_active_window(
+                    self.params, member_c, self._me_dev, state_c, vals, pf,
+                    window)
+            with prof.phase("scatter"):
+                new_state = _py_decay_scatter(
+                    self.params, self.state, pf, idx, new_rows, window)
+        else:
+            idx_dev = jnp.asarray(idx)
+            pf_dev = jnp.asarray(pf)
+            with prof.phase("compact"):
+                state_c, member_c = _gather_active(
+                    self.state, self.member, idx_dev)
+            with prof.phase("dispatch"):
+                new_rows, flat = _active_window_fn(window)(
+                    self.params, member_c, self._me_dev, state_c,
+                    jnp.asarray(vals), pf_dev)
+            with prof.phase("scatter"):
+                new_state = _decay_scatter_fn(window)(
+                    self.params, self.state, pf_dev, idx_dev, new_rows)
+        return (new_state, flat, int(idx.nbytes + vals.nbytes),
+                int(np.prod(flat.shape)) * 4)
+
+    def _decay_mirrors(self, G: np.ndarray, window: int, pf: np.ndarray) -> None:
+        """Host twin of the device decay kernel, applied to the QUIESCENT
+        rows' timer mirrors (active rows adopt theirs from the 13-row
+        mirror fetch in tick_finish). Same integer arithmetic as
+        ``chained_raft.decay_idle``, so the mirrors stay bit-exact. Reads
+        the role/leader view _schedule_active just used (post-step locals
+        on a fallback re-entry tick, the mirrors otherwise) — the device
+        decay kernel runs on post-step state, and the twin must match."""
+        role, leader = self._wake_role, self._wake_leader
+        quiet = self._h_alive.copy()
+        quiet[G] = False
+        lead = np.clip(leader, 0, self.N - 1).astype(np.intp)
+        hb8 = int(self.params.hb_ticks) * 8
+        ka = (leader >= 0) & (pf[lead] != 0) & (self._h_hb < hb8)
+        new_e = np.where((role == LEADER) | ka, 0,
+                         self._h_elapsed + window)
+        self._h_elapsed[quiet] = new_e[quiet].astype(np.int32)
+        self._h_hb[quiet] += window
+
     def tick_begin(self, window: int = 1) -> dict:
         """Dispatch one tick's device step WITHOUT fetching results.
 
@@ -697,15 +905,56 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # Vote parole: hold every paroled group's election timer at
             # zero so it can never reach candidacy (timeout_min >= 2 ticks;
             # elapsed is +1 per step). Grant-suppression happens at intake.
-            pidx = jnp.asarray(list(self._parole), jnp.int32)
+            pidx_l = list(self._parole)
+            pidx = jnp.asarray(pidx_l, jnp.int32)
             self.state = self.state.replace(
                 elapsed=self.state.elapsed.at[pidx].set(jnp.asarray(0, _I32)))
+            # Keep the host timer mirror in lockstep with the device-side
+            # hold (the active-set wake predicate reads the mirror).
+            self._h_elapsed[pidx_l] = 0
         if self._nxt_fixups:
             # Last tick's AE-cap send-pointer re-roots, as one scatter just
             # before the step reads state.nxt (see _drain_nxt_fixups).
             self._drain_nxt_fixups()
         pf = self._peer_fresh(window)
-        if self._sparse:
+        G = None
+        if self._active_set:
+            # "compact" is entered twice per compacted tick: the predicate
+            # here and the gather in _step_active. Its snapshot count is
+            # therefore 2x the other phases'; per-tick cost comparisons use
+            # ms_per_round (total/ticks), which is denominator-uniform.
+            with prof.phase("compact"):
+                G = self._schedule_active(window, pf)
+            if G is None:
+                # Auto-fallback: the active fraction exceeds the threshold,
+                # so compaction overhead would exceed the dense step — run
+                # the plain dispatch below. The dense step does not return
+                # timers, so the mirrors go stale until the next active
+                # tick refetches them.
+                self._timers_stale = True
+                self.active_fallback_ticks += 1
+            else:
+                self.active_sched_ticks += 1
+                self.active_sched_rows += len(G)
+        if G is not None:
+            A = len(G)
+            k = active_bucket(A, self.P)
+            with prof.phase("inbox"):
+                # Compact-domain inbox: rows line up with the gathered
+                # state rows (G is a superset of every pending group).
+                # Proposal staging happens inside the builder, as in the
+                # sparse branch.
+                (vals, staged,
+                 deferred, deferred_b) = self._build_inbox_active(G, k)
+            new_state, flat, upload, fetchb = self._step_active(
+                G, k, vals, pf, window, prof)
+            with prof.phase("decay"):
+                self._decay_mirrors(G, window, pf)
+            h = {"mode": "active", "flat": flat, "G": G, "k": k,
+                 "staged": staged, "window": window,
+                 "upload_bytes": upload, "fetch_bytes": fetchb}
+            self._sched_pending.append(G)
+        elif self._sparse:
             with prof.phase("inbox"):
                 # Proposal staging (sparse row 9) happens inside the
                 # builder; the dense branch's separate "stage" phase is
@@ -771,7 +1020,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         (the host work then overlaps the new dispatch's device compute)."""
         if "flat_np" not in h:
             with self.profiler.phase("fetch"):
-                h["flat_np"] = np.asarray(h["flat"])
+                # flat is None on an all-quiescent active tick (nothing to
+                # fetch: the decay program is the whole device step).
+                h["flat_np"] = (None if h["flat"] is None
+                                else np.asarray(h["flat"]))
             self._tick_inflight = False
         return h
 
@@ -847,7 +1099,29 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # values, position-aligned. Sparse mode never materializes dense
         # (10, P)/(9, P, N) views — at P=100k that would be tens of MB of
         # host zero-fill per tick, the exact cost sparse IO removes.
-        if h["mode"] == "dense":
+        if h["mode"] == "active":
+            # Compact fetch over the scheduled rows: the (13, A) mirror
+            # (the dense 10 plus elapsed/timeout/hb_elapsed, adopted into
+            # the host timer mirrors below) and the (9, A, N) outbox.
+            # ``proc`` IS the active set — sorted ascending (the group-0-
+            # first recycle protocol), a superset of every row needing host
+            # work, and of every proposal group (so the NotLeader fast-fail
+            # needs no appended extras, unlike the sparse path).
+            proc = h["G"].astype(np.int64, copy=False)
+            A = len(proc)
+            if A:
+                flat = h["flat_np"]
+                cut = _MIRROR13_ROWS * h["k"]
+                sv13 = (flat[:cut].reshape(_MIRROR13_ROWS, h["k"])
+                        [:, :A].astype(np.int64))
+                ov_c = flat[cut:].reshape(9, h["k"], self.N)[:, :A, :]
+            else:
+                # All-quiescent tick: the decay program was the whole step.
+                sv13 = np.zeros((13, 0), np.int64)
+                ov_c = np.zeros((9, 0, self.N), np.int32)
+            v = sv13[:10]
+            dense = False
+        elif h["mode"] == "dense":
             # ONE flat fetch holding the (10, P) scalar mirror and the
             # (9, P, N) outbox.
             flat = h["flat_np"]
@@ -908,7 +1182,9 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 else:
                     self._k_out_quiet = 0
 
-        if dense:
+        if h["mode"] == "active":
+            pass  # proc / v / ov_c already compact, computed above
+        elif dense:
             (n_term, n_voted, n_role, n_leader,
              n_head_t, n_head_s, n_commit_t, n_commit_s,
              minted_a, became_a) = sv
@@ -1160,6 +1436,19 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         self._h_voted[upd] = n_voted[keep]
         self._h_role[upd] = n_role[keep]
         self._h_leader[upd] = n_leader[keep]
+        if h["mode"] == "active":
+            # Timer-mirror adoption (rows 10..12 of the compact mirror).
+            # Skip rows keep their reset-site mirror values, exactly like
+            # the scalar mirrors above; quiescent rows were advanced by the
+            # host decay twin at tick_begin.
+            sv13k = sv13[:, keep]
+            self._h_elapsed[upd] = sv13k[10].astype(np.int32)
+            self._h_timeout[upd] = sv13k[11].astype(np.int32)
+            self._h_hb[upd] = sv13k[12].astype(np.int32)
+            # This dispatch's rows are adopted — the scheduler no longer
+            # needs to force them awake for mirror staleness.
+            self._sched_pending = [gp for gp in self._sched_pending
+                                   if gp is not h["G"]]
 
         if self._conf_notify:
             res.conf_changes.extend(self._conf_notify)

@@ -90,6 +90,12 @@ class GroupAdmin:
         # Incremental: rewrite only row g of the host mask, re-upload.
         self._mask_np[g] = self._claim_row(g, self._active_vec())
         self.member = jnp.asarray(self._mask_np)
+        # A claim change moves quorum/membership for the row — wake it so
+        # the full kernel (not the decay closed form) sees the new mask.
+        # (Dense engines never drain _force_active, so only track it when
+        # the active-set scheduler is on.)
+        if self._active_set:
+            self._force_active.add(g)
 
     def group_members(self, g: int) -> frozenset[int] | None:
         return self._group_claims.get(g)
@@ -269,6 +275,14 @@ class GroupAdmin:
         self._h_commit[g] = GENESIS
         self._h_role[g] = 0
         self._h_leader[g] = -1
+        # Timer mirrors follow the device-row demotion below (elapsed and
+        # hb_elapsed zeroed; timeout keeps its old draw), and the recycled
+        # row is forced into the next active set — its next step must run
+        # through the full kernel under the new incarnation, not decay.
+        self._h_elapsed[g] = 0
+        self._h_hb[g] = 0
+        if self._active_set:
+            self._force_active.add(g)
         # Full device-row demotion, not just head/commit: a row that was
         # leading (or campaigning) before the reset must not keep its role,
         # ballot box, or progress rows — they describe state the chain no

@@ -135,6 +135,29 @@ class HostIO:
             K = len(G)
         idx = np.full(K, self.P, np.int32)
         idx[:len(G)] = G
+        vals, staged, deferred, deferred_b = self._pack_inbox_rows(G, K)
+        return idx, vals, staged, deferred, deferred_b
+
+    def _build_inbox_active(self, G: np.ndarray, K: int) -> tuple[
+            np.ndarray, dict[int, list],
+            list[rpc.WireMsg], list[rpc.MsgBatch]]:
+        """Active-set twin of :meth:`_build_inbox_sparse`: the compact
+        domain is the scheduler's active set ``G`` (sorted global ids,
+        guaranteed a superset of every pending message/batch/proposal
+        group) padded to bucket ``K``, so the packed rows line up with the
+        gathered state rows — the compact↔global remap is one searchsorted
+        per frame, same as the sparse path."""
+        return self._pack_inbox_rows(G, K)
+
+    def _pack_inbox_rows(self, G: np.ndarray, K: int) -> tuple[
+            np.ndarray, dict[int, list],
+            list[rpc.WireMsg], list[rpc.MsgBatch]]:
+        """Shared compact inbox-packing core (sparse + active-set builders):
+        pack queued batches/messages into a (10, K, N) bucket at rows
+        ``searchsorted(G, group)`` (every pending group must be in ``G``),
+        update the per-(group, src) delivery stamps, and scatter proposal
+        counts into row 9. Slot-conflict carry-over semantics are identical
+        to the dense builder."""
         vals = np.zeros((10, K, self.N), np.int32)
         staged: dict[int, list] = {}
         deferred: list[rpc.WireMsg] = []
@@ -192,15 +215,17 @@ class HostIO:
                 vals[7, gi, si] = z & 0xFFFFFFFF
                 vals[8, gi, si] = np.fromiter((m.ok for m in keep), np.int32, k)
         # Per-(group, src) delivery stamp (ISR liveness), sparse form of the
-        # dense path's full-array mask.
+        # dense path's full-array mask. Packed rows always index the real
+        # prefix of the bucket, so G (not the padded idx) maps them back.
         gi_loc, si_loc = np.nonzero(vals[0])
         if len(gi_loc):
-            self._h_last_seen[idx[gi_loc], si_loc] = self._ticks
+            self._h_last_seen[G[gi_loc], si_loc] = self._ticks
+        prop_groups = list(self._prop_groups)
         if prop_groups:
             pg = np.asarray(prop_groups, np.int64)
             self._scatter_proposal_counts(
                 vals[9], np.searchsorted(G, pg), prop_groups)
-        return idx, vals, staged, deferred, deferred_b
+        return vals, staged, deferred, deferred_b
 
     def _scatter_proposal_counts(self, plane, rows, groups) -> None:
         """Row-9 proposal-depth lane: one scatter over the pending groups'
@@ -433,6 +458,12 @@ class HostIO:
         pay while > cap behind."""
         fx = np.asarray(self._nxt_fixups, np.int64).reshape(-1, 3)
         self._nxt_fixups.clear()
+        # The re-rooted rows now have nxt < head — the leader must keep
+        # streaming the capped catch-up, so the active-set scheduler may
+        # not leave them quiescent this tick. (Dense engines never drain
+        # _force_active; don't let it grow there.)
+        if self._active_set:
+            self._force_active.update(int(g) for g in fx[:, 0])
         nt = np.array(self.state.nxt.t)
         ns = np.array(self.state.nxt.s)
         nt[fx[:, 0], fx[:, 1]] = fx[:, 2] >> 32

@@ -39,7 +39,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from josefine_tpu.models import chained_raft as cr
-from josefine_tpu.models.types import Msgs, NodeState
+from josefine_tpu.models.types import (
+    CANDIDATE,
+    LEADER,
+    PRECANDIDATE,
+    Msgs,
+    NodeState,
+)
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
 
@@ -324,3 +330,187 @@ def _py_sparse_window(k_out, params, member, me, state, peer_fresh, idx, vals,
     state_np = jax.tree.map(np.asarray, state)
     flat, sv, ov = _sparse_outputs(np, state_np, st, out, met, k_out)
     return st, flat, sv.astype(np.int32), ov.astype(np.int32)
+
+
+# Active-set compacted stepping (PR 4). The sparse-IO path above shrinks
+# the TRANSFERS for idle groups but still runs the full message-processing
+# kernel over all P rows every tick — at P=100k on XLA:CPU that program is
+# ~250 ms/engine of a ~750 ms tick with ~1-5% of groups doing any work.
+# The active-set contract moves the frontier into the kernel itself: the
+# host scheduler (engine._schedule_active, predicate host_wake_mask below)
+# proves which rows can change this window, gathers exactly those into a
+# power-of-two bucket (static jit shapes; one compile per bucket level, not
+# per tick), steps the bucket through the SAME window step as the dense
+# path, and scatters the results back while every quiescent row advances
+# through chained_raft.decay_idle — the closed form of an idle tick. The
+# compact mirror carries 13 rows (the dense 10 plus elapsed/timeout/
+# hb_elapsed) so the host's timer mirrors stay exact without extra fetches.
+# Bit-exactness against dense stepping is pinned by tests/test_active_set.py.
+
+# Compact-mirror row order: the dense _flat_outputs 10 plus the three
+# timer rows the scheduler mirrors host-side.
+_MIRROR13_ROWS = 13
+
+
+def active_bucket(n: int, P: int) -> int:
+    """Smallest power-of-two bucket >= n (floor 64, clamped to P). The
+    bucket IS the compiled shape: distinct compiled step programs are
+    bounded by the ~log2(P) bucket levels, not by per-tick fluctuation of
+    the active count (pinned by the recompile-discipline test)."""
+    b = 64
+    while b < n:
+        b *= 2
+    return min(b, P) if P >= 64 else P
+
+
+def host_wake_mask(hb_ticks: int, role, leader, elapsed, timeout, hb_elapsed,
+                   alive, my_member, peer_fresh, window: int) -> np.ndarray:
+    """The active-set wake predicate over the engine's host mirrors: rows
+    where a ``window``-tick dispatch could do anything beyond
+    :func:`chained_raft.decay_idle`'s timer arithmetic. Everything here is
+    host data — no device sync on the scheduling path.
+
+    * election-timer horizon (alive member non-leaders): with the
+      aggregate keepalive holding (``ka`` — leader known, its node fresh
+      this dispatch, hb-staleness bound not reachable within the window)
+      the timer is pinned at 0 and cannot fire, but the row must wake if
+      the hold could LAPSE mid-window (``hb_elapsed + window - 1`` crosses
+      ``hb_ticks * 8``); without the hold it wakes when
+      ``elapsed + window >= timeout`` — i.e. exactly the tick(s) the dense
+      step would reach candidacy, never later (tick-exact elections);
+    * heartbeat horizon (alive leaders, member or not — a non-member
+      leader's hb cadence still cycles on device): wakes when
+      ``hb_elapsed + window - 1 >= hb_ticks``, the first tick hb_due can
+      fire;
+    * role: candidates/pre-candidates (awaiting responses/redraws) and
+      leaderless member rows (campaign pressure) are always awake — the
+      cheap, conservative half of the predicate family.
+
+    The engine unions in the host-known sources on top of this mask:
+    pending inbox rows, queued proposals, force-woken rows (recycle/reset,
+    snapshot install, nxt fixups, membership-mask changes), and — under
+    tick_pipelined — rows dispatched but not yet adopted
+    (``_sched_pending``). There is deliberately NO "changed last tick"
+    carry: a quiescent leader's send pointers already equal its head
+    (node_step advances nxt optimistically on every AE send), and the one
+    case that breaks that — an AE-cap re-root putting nxt < head — is
+    force-woken via ``_drain_nxt_fixups``. Changing either mechanism
+    (AE resend policy, optimistic nxt advance) invalidates the predicate's
+    never-later-than-dense guarantee and needs a new wake source here.
+    """
+    N = len(peer_fresh)
+    nonlead = role != LEADER
+    hb8 = hb_ticks * 8
+    ka = ((leader >= 0)
+          & (np.asarray(peer_fresh)[np.clip(leader, 0, N - 1)] != 0)
+          & (hb_elapsed < hb8))
+    wake_e = alive & my_member & nonlead & np.where(
+        ka, hb_elapsed + window - 1 >= hb8, elapsed + window >= timeout)
+    wake_hb = alive & ~nonlead & (hb_elapsed + window - 1 >= hb_ticks)
+    wake_role = alive & ((role == CANDIDATE) | (role == PRECANDIDATE)
+                         | ((leader < 0) & my_member))
+    return wake_e | wake_hb | wake_role
+
+
+def _active_outputs(xp, st, out, met):
+    """Compact-step flat output: the (13, A) mirror (dense 10 + elapsed/
+    timeout/hb_elapsed) followed by the (9, A, N) outbox, one fetch."""
+    sv = xp.stack([
+        st.term, st.voted_for, st.role, st.leader,
+        st.head.t, st.head.s, st.commit.t, st.commit.s,
+        met.minted, xp.asarray(met.became_leader).astype(xp.int32),
+        st.elapsed, st.timeout, st.hb_elapsed,
+    ])
+    ov = xp.stack([
+        out.kind, out.term, out.x.t, out.x.s, out.y.t, out.y.s,
+        out.z.t, out.z.s, out.ok,
+    ])
+    return xp.concatenate([sv.reshape(-1).astype(xp.int32),
+                           ov.reshape(-1).astype(xp.int32)])
+
+
+@jax.jit
+def _gather_active(state, member, idx):
+    """Gather the active rows (bucketed ``idx``; padding entries carry id P
+    and clamp to row P-1 — their stepped results are dropped at scatter)."""
+    return jax.tree.map(lambda a: a[idx], state), member[idx]
+
+
+@functools.lru_cache(maxsize=None)
+def _active_window_fn(ticks: int):
+    """Compact-domain window step (jitted per bucket shape x length): the
+    SAME tick-1 + quiet-ticks pipeline as _window_step_fn, over the
+    gathered (A, ...) rows, returning the 13-row mirror + outbox flat."""
+
+    def fn(params, member_c, me, state_c, in10_c, peer_fresh):
+        inbox = _msgs_from_packed(in10_c)
+        props = in10_c[9, :, 0]
+        st, out, met = _vstep_nodes(params, member_c, me, state_c, inbox,
+                                    props, peer_fresh)
+        st, out, met = _scan_quiet_ticks(params, member_c, me, st, out, met,
+                                         inbox, props, peer_fresh, ticks)
+        return st, _active_outputs(jnp, st, out, met)
+
+    return jax.jit(fn, donate_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=None)
+def _decay_scatter_fn(ticks: int):
+    """Quiescent-row decay + active-row scatter-back, one program: advance
+    every row's timers by the idle closed form (garbage for active rows —
+    overwritten by the scatter, which drops the bucket's padding ids)."""
+
+    def fn(params, state, peer_fresh, idx, new_rows):
+        st = cr.decay_idle(params, state, peer_fresh, ticks)
+        return jax.tree.map(
+            lambda full, rows: full.at[idx].set(rows, mode="drop"),
+            st, new_rows)
+
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _decay_only_fn(ticks: int):
+    """Fully idle tick (empty active set): decay is the whole device step."""
+
+    def fn(params, state, peer_fresh):
+        return cr.decay_idle(params, state, peer_fresh, ticks)
+
+    return jax.jit(fn, donate_argnums=(1,))
+
+
+def _py_gather_active(state, member, idx):
+    """Scalar-engine twin of _gather_active (numpy leaves, clamp padding)."""
+    member_np = np.asarray(member)
+    P = member_np.shape[0]
+    cidx = np.minimum(np.asarray(idx), P - 1)
+    return (jax.tree.map(lambda a: np.asarray(a)[cidx], state),
+            member_np[cidx])
+
+
+def _py_active_window(params, member_c, me, state_c, in10_c, peer_fresh,
+                      ticks):
+    """Scalar-engine twin of the compact window step."""
+    in10_c = np.asarray(in10_c)
+    st, out, met = _py_window(params, member_c, me, state_c,
+                              _msgs_from_packed(in10_c), in10_c[9, :, 0],
+                              peer_fresh, ticks)
+    return st, _active_outputs(np, st, out, met)
+
+
+def _py_decay_scatter(params, state, peer_fresh, idx, new_rows, ticks):
+    """Scalar-engine twin of _decay_scatter_fn."""
+    state_np = jax.tree.map(np.array, state)
+    st = cr.decay_idle(params, state_np,
+                       None if peer_fresh is None else np.asarray(peer_fresh),
+                       ticks, xp=np)
+    idx = np.asarray(idx)
+    P = st.role.shape[0]
+    sel = idx < P
+
+    def sc(full, rows):
+        full = np.array(full)
+        full[idx[sel]] = np.asarray(rows)[sel]
+        return full
+
+    return jax.tree.map(sc, st, new_rows)

@@ -89,6 +89,7 @@ class JosefineRaft:
             max_nodes=config.max_nodes,
             backend=backend,
             max_append_entries=config.max_append_entries,
+            active_set=config.active_set and mesh is None,
             mesh=mesh,
         )
         # Peer addresses: configured nodes, plus any members the durable

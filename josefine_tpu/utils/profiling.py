@@ -8,6 +8,14 @@ bridge collapsing 150x from P=1k to P=100k with no way to say WHERE the
 1.7 s/tick went; this module makes the per-phase breakdown a recorded
 artifact instead of a guess.
 
+Phases register on first use, so the set is open: active-set compacted
+stepping (PR 4, ARCHITECTURE.md "Active-set scheduling") adds ``compact``
+(wake-predicate scheduling + the device gather), ``scatter`` (compact
+results back into the full state fused with the device decay), and
+``decay`` (the host timer-mirror twin) alongside the six PR 2 phases above
+— which keep their names and meanings exactly, so perf-floor comparisons
+across PRs stay valid (a dense-path engine records only the original six).
+
 Design constraints, in order:
 
 1. **Disabled is (almost) free.** The engine calls ``profiler.phase(name)``

@@ -1,0 +1,529 @@
+"""Active-set compacted stepping: the differential + oracle suites (PR 4).
+
+The active-set scheduler (engine._schedule_active + packed_step's compact
+step / decay kernel) claims BIT-EXACT equivalence with dense stepping: a
+row the wake predicate leaves quiescent can only move its two timer fields,
+and exactly as ``chained_raft.decay_idle`` computes them. These suites pin
+that claim at every layer:
+
+* decay oracle — ``py_decay_idle`` / ``decay_idle`` equal K full idle
+  steps of the scalar / vmapped kernel on exactly the rows the wake
+  predicate leaves quiescent (the closed form IS the step, not an
+  approximation);
+* engine differential — twin clusters (active-set on vs off) driven
+  through identical schedules stay equal on EVERY tick: full device state,
+  scalar + timer mirrors, chains, commits, and byte-identical outbound
+  wire traffic; across dense/sparse IO x window 1/8 x split-phase/
+  pipelined drivers, through a partition chaos phase (mass wake-up on
+  heal) and a mid-run group recycle (pipelined: while a dispatch is in
+  flight, exercising the skip_rows protocol);
+* recompile discipline — compiled compact-step shapes are bounded by the
+  power-of-two bucket count, not per-tick fluctuation of the active count;
+* quiescent floor — an all-idle tick runs the decay program alone (no
+  gather, no step, no fetch).
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from josefine_tpu.models import chained_raft as cr
+from josefine_tpu.models.py_step import (
+    PyMsg,
+    PyNode,
+    draw_timeout,
+    py_decay_idle,
+    py_node_step,
+)
+from josefine_tpu.models.types import FOLLOWER, LEADER, step_params
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.packed_step import (
+    _active_window_fn,
+    active_bucket,
+    host_wake_mask,
+)
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=8)
+
+
+class ListFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data):
+        self.applied.append(bytes(data))
+        return b"ok:" + data
+
+
+# ------------------------------------------------------------ decay oracle
+
+
+def _settled_nodes(rng, n_rows, N=3, hb_ticks=8):
+    """Random scalar states run through ONE idle step so non-timer fields
+    sit at their idle fixed point (e.g. an idle leader's nxt rows equal its
+    head, commit is quorum-stable) — the invariant the engine flow
+    maintains for every row the scheduler could leave quiescent."""
+    nodes = []
+    for i in range(n_rows):
+        role = int(rng.choice([FOLLOWER, FOLLOWER, LEADER]))
+        head = (int(rng.integers(0, 4)), int(rng.integers(0, 50)))
+        me = int(rng.integers(0, N))
+        st = PyNode(
+            n=N, me=me, seed=int(rng.integers(0, 2**32)),
+            term=max(head[0], int(rng.integers(0, 5))),
+            voted_for=int(rng.choice([-1, 0, 1, 2])),
+            role=role,
+            leader=me if role == LEADER else int(rng.choice([-1, 0, 1, 2])),
+            head=head,
+            commit=(0, 0),
+            elapsed=int(rng.integers(0, 6)),
+            timeout=int(rng.integers(3, 9)),
+            hb_elapsed=int(rng.integers(0, hb_ticks * 9)),
+            alive=bool(rng.random() > 0.1),
+        )
+        if role == LEADER:
+            st.leader = me
+            st.match = [head if j == me else
+                        (0, int(rng.integers(0, head[1] + 1)))
+                        for j in range(N)]
+            st.nxt = [head] * N
+            # hb_elapsed below the cadence: an idle leader between
+            # broadcasts (hb_due rows are woken by the predicate anyway).
+            st.hb_elapsed = int(rng.integers(1, hb_ticks))
+        member = [True] * N
+        pf = [bool(rng.random() > 0.3) for _ in range(N)]
+        empty = [PyMsg() for _ in range(N)]
+        st, _, _ = py_node_step(st, member, empty, 0, 3, 8, hb_ticks,
+                                peer_fresh=pf)
+        nodes.append((st, member, pf))
+    return nodes
+
+
+def _wake_scalar(st: PyNode, member, pf, window, hb_ticks=8) -> bool:
+    m = host_wake_mask(
+        hb_ticks,
+        np.asarray([st.role]), np.asarray([st.leader]),
+        np.asarray([st.elapsed]), np.asarray([st.timeout]),
+        np.asarray([st.hb_elapsed]), np.asarray([st.alive]),
+        np.asarray([member[st.me]]), np.asarray(pf, np.int32), window)
+    return bool(m[0])
+
+
+def test_decay_oracle_scalar():
+    """py_decay_idle == K idle py_node_step ticks on every row the wake
+    predicate leaves quiescent (and the predicate never sleeps a row whose
+    K idle steps would move a non-timer field)."""
+    rng = np.random.default_rng(7)
+    checked = 0
+    for st, member, pf in _settled_nodes(rng, 400):
+        for K in (1, 2, 4, 8):
+            if _wake_scalar(st, member, pf, K):
+                continue
+            full = st
+            for _ in range(K):
+                out = None
+                full, out, met = py_node_step(
+                    full, member, [PyMsg() for _ in range(st.n)], 0,
+                    3, 8, 8, peer_fresh=pf)
+                assert all(m.kind == 0 for m in out), \
+                    "quiescent row emitted a message"
+                assert not met.became_leader and met.minted == 0
+            fast = py_decay_idle(st, K, 8, peer_fresh=pf)
+            assert full == fast, f"K={K}: {full} != {fast}"
+            checked += 1
+    assert checked > 200  # the filter must leave a real population
+
+
+def test_decay_oracle_jax():
+    """decay_idle (the vectorized device kernel) == K idle node_step ticks
+    on quiescent rows — same bar as the scalar oracle, on the XLA path."""
+    rng = np.random.default_rng(11)
+    N, hb = 3, 8
+    nodes = _settled_nodes(rng, 128, N=N, hb_ticks=hb)
+    pf = np.asarray([1, 0, 1], np.int32)  # one fixed liveness vector
+    pf_dev = jax.numpy.asarray(pf)
+    member = np.ones((len(nodes), N), bool)
+
+    def stack(f):
+        return np.asarray([f(st) for st, _, _ in nodes])
+
+    from josefine_tpu.ops import ids as _ids
+    mk = lambda pairs: _ids.Bid(
+        np.asarray([t for t, _ in pairs], np.int32),
+        np.asarray([s for _, s in pairs], np.int32))
+    state = cr.NodeState(
+        term=stack(lambda s: s.term).astype(np.int32),
+        voted_for=stack(lambda s: s.voted_for).astype(np.int32),
+        role=stack(lambda s: s.role).astype(np.int32),
+        leader=stack(lambda s: s.leader).astype(np.int32),
+        head=mk([s.head for s, _, _ in nodes]),
+        commit=mk([s.commit for s, _, _ in nodes]),
+        elapsed=stack(lambda s: s.elapsed).astype(np.int32),
+        timeout=stack(lambda s: s.timeout).astype(np.int32),
+        hb_elapsed=stack(lambda s: s.hb_elapsed).astype(np.int32),
+        alive=stack(lambda s: s.alive),
+        seed=stack(lambda s: s.seed).astype(np.uint32),
+        votes=np.zeros((len(nodes), N), bool),
+        match=_ids.Bid(
+            np.asarray([[t for t, _ in s.match] for s, _, _ in nodes], np.int32),
+            np.asarray([[x for _, x in s.match] for s, _, _ in nodes], np.int32)),
+        nxt=_ids.Bid(
+            np.asarray([[t for t, _ in s.nxt] for s, _, _ in nodes], np.int32),
+            np.asarray([[x for _, x in s.nxt] for s, _, _ in nodes], np.int32)),
+    )
+    state = jax.tree.map(lambda a: np.asarray(a), state)
+    mes = np.asarray([s.me for s, _, _ in nodes], np.int32)
+    vstep = jax.vmap(cr.node_step, in_axes=(None, 0, 0, 0, 0, 0, None))
+    empty = cr.empty_msgs((len(nodes), N))
+    props = np.zeros(len(nodes), np.int32)
+
+    for K in (1, 3, 8):
+        wake = host_wake_mask(
+            hb, np.asarray(state.role), np.asarray(state.leader),
+            np.asarray(state.elapsed), np.asarray(state.timeout),
+            np.asarray(state.hb_elapsed), np.asarray(state.alive),
+            member[np.arange(len(nodes)), mes], pf, K)
+        quiet = ~wake
+        assert quiet.sum() > 20
+        full = state
+        for _ in range(K):
+            full, out, _ = vstep(PARAMS, member, mes, full, empty, props,
+                                 pf_dev)
+        fast = cr.decay_idle(PARAMS, state, pf, K)
+        for name in ("term", "voted_for", "role", "leader", "elapsed",
+                     "timeout", "hb_elapsed"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(full, name))[quiet],
+                np.asarray(getattr(fast, name))[quiet],
+                err_msg=f"{name} K={K}")
+        assert not np.asarray(out.kind)[quiet].any()
+
+
+# ------------------------------------------------------ engine differential
+
+
+def _wire_key(m):
+    """Canonical bytes-comparable form of an outbound wire message."""
+    if isinstance(m, rpc.MsgBatch):
+        blocks = sorted(
+            (g, tuple((b.id, b.parent, b.term, bytes(b.data)) for b in blks))
+            for g, blks in m.blocks.items())
+        return ("batch", m.src, m.dst, m.group.tobytes(),
+                m.kind_col.tobytes(), m.term.tobytes(), m.x.tobytes(),
+                m.y.tobytes(), m.z.tobytes(), m.ok.tobytes(),
+                np.asarray(m.inc).tobytes(), tuple(blocks))
+    blocks = tuple((b.id, b.parent, b.term, bytes(b.data))
+                   for b in (m.blocks or ()))
+    return ("msg", m.kind, m.src, m.dst, m.group, m.term, m.x, m.y, m.z,
+            m.ok, m.inc, blocks)
+
+
+def _assert_engines_equal(ea: RaftEngine, er: RaftEngine, tag: str):
+    for la, lr in zip(jax.tree.leaves(ea.state), jax.tree.leaves(er.state)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lr),
+                                      err_msg=f"state {tag}")
+    for name in ("_h_term", "_h_voted", "_h_role", "_h_leader",
+                 "_h_head", "_h_commit"):
+        np.testing.assert_array_equal(getattr(ea, name), getattr(er, name),
+                                      err_msg=f"{name} {tag}")
+    for g, (cha, chr_) in enumerate(zip(ea.chains, er.chains)):
+        assert cha.head == chr_.head, f"chain head g={g} {tag}"
+        assert cha.committed == chr_.committed, f"chain commit g={g} {tag}"
+    # The active engine's timer mirrors are exact against its own device
+    # state — the property that makes the wake predicate sound. Exceptions
+    # where staleness is by design (and covered by forcing the affected
+    # rows active): right after a dense fallback tick (_timers_stale), and
+    # while a pipelined dispatch is outstanding (_sched_pending — the next
+    # begin runs before this tick's finish adopts).
+    if not ea._timers_stale and not ea._sched_pending:
+        np.testing.assert_array_equal(
+            ea._h_elapsed, np.asarray(ea.state.elapsed),
+            err_msg=f"elapsed mirror {tag}")
+        np.testing.assert_array_equal(
+            ea._h_hb, np.asarray(ea.state.hb_elapsed),
+            err_msg=f"hb mirror {tag}")
+        np.testing.assert_array_equal(
+            ea._h_timeout, np.asarray(ea.state.timeout),
+            err_msg=f"timeout mirror {tag}")
+
+
+# The three heaviest matrix cases are `slow` (outside the tier-1 time
+# budget; `tools/ci.sh` full runs this file unfiltered): tier-1 keeps one
+# case per mode axis — sparse split-phase, both pipelined drivers, and the
+# dense fallback-flip case, which exercises the dense window path too.
+@pytest.mark.parametrize("sparse,window,pipeline,fallback_frac", [
+    pytest.param(False, 1, False, 1.0, marks=pytest.mark.slow),
+    pytest.param(False, 8, False, 1.0, marks=pytest.mark.slow),
+    (True, 1, False, 1.0),
+    pytest.param(True, 8, False, 1.0, marks=pytest.mark.slow),
+    (False, 1, True, 1.0),
+    (True, 1, True, 1.0),
+    # Mid-run mode flips: a tight threshold forces dense fallback during
+    # the election storm / partition wake-ups and active mode when quiet,
+    # exercising the timer-mirror refetch on every re-entry. The pipelined
+    # variants pin the refetch under the begin-before-finish overlap, where
+    # the fallback tick's role/leader adoption has NOT yet run when the
+    # next begin schedules (the mirror refetch must cover role/leader too,
+    # or a follower that reached candidacy during the dense tick sleeps
+    # through its own election).
+    (False, 1, False, 0.34),
+    (False, 1, True, 0.34),
+    (True, 1, True, 0.34),
+])
+def test_engine_differential_bitexact(sparse, window, pipeline, fallback_frac):
+    """Twin 3-node clusters — active-set on vs off — driven through an
+    identical schedule (cold-start elections, proposal drizzle, a 15-tick
+    partition of node 2 with mass wake-up on heal, a mid-run data-group
+    recycle) must stay bit-exact on EVERY tick: device state, mirrors,
+    chains, and byte-identical outbound wire traffic. Election/heartbeat
+    timing is tick-identical by construction of the comparison."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+
+        def mk(active):
+            return [RaftEngine(MemKV(), ids3, ids3[i], groups=6,
+                               fsms={0: ListFsm(), 3: ListFsm()},
+                               params=PARAMS, base_seed=i, sparse_io=sparse,
+                               active_set=active)
+                    for i in range(3)]
+
+        act, ref = mk(True), mk(False)
+        for e in act:
+            e.active_fallback_frac = fallback_frac
+        committed = [0, 0]
+        for t in range(75):
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                # Deterministic proposal drizzle to whichever engine leads
+                # (mirrors are equal, so both clusters pick the same one).
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                e.propose(g, b"t%d-g%d" % (t, g))
+                                break
+                if t == 40:
+                    # Mid-run recycle — under the pipelined driver a
+                    # dispatch is IN FLIGHT here, so this exercises the
+                    # skip_rows discard protocol on the live handle.
+                    for e in cl:
+                        e.recycle_group(2)
+                        e.set_group_incarnation(2, 1)
+                for e in cl:
+                    w = e.suggest_window(window)
+                    res = e.tick_pipelined(w) if pipeline else e.tick(w)
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    # Partition chaos: node index 2 cut off for ticks
+                    # 15-29; the heal at 30 is the mass wake-up (queued
+                    # elections, catch-up replication).
+                    if 15 <= t < 30 and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            assert [_wire_key(m) for m in outs[0]] == \
+                   [_wire_key(m) for m in outs[1]], f"outbound tick {t}"
+            _assert_engines_equal(act[0], ref[0], f"t={t}")
+            _assert_engines_equal(act[1], ref[1], f"t={t}")
+            _assert_engines_equal(act[2], ref[2], f"t={t}")
+            await asyncio.sleep(0)
+        for cl in (act, ref):
+            for e in cl:
+                if e.pipeline_window:
+                    e.tick_drain()
+        assert committed[0] == committed[1]
+        assert committed[0] > 0, "schedule must exercise real commits"
+        assert sum(e.is_leader(0) for e in act) == 1
+
+    asyncio.run(main())
+
+
+def test_fallback_refetch_covers_role_mirrors():
+    """The post-fallback refetch must give the wake predicate EVERY
+    post-step input — role and leader included, not just the three timer
+    vectors — WITHOUT clobbering the role/leader mirrors. Under
+    tick_pipelined the next begin schedules BEFORE the fallback tick's
+    finish adopts mirrors: judged on the stale mirror, a follower that
+    transitioned during the dense tick would be read as a led follower
+    (keepalive hold pinning its host elapsed at 0 while the device timer
+    climbs), deferring its re-campaign far past the dense schedule's. But
+    the mirrors ARE that pending finish's pre-step baseline — tick_finish
+    diffs _h_role to emit became/lost_leadership and drop NotLeader
+    waiters — so the refetch must read post-step role/leader into the
+    predicate only and leave the mirrors for the finish to adopt."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=4,
+                       params=step_params(timeout_min=3, timeout_max=3,
+                                          hb_ticks=8),
+                       active_set=True)
+        # Two active warmup ticks: elapsed reaches 2 everywhere, one tick
+        # short of the uniform timeout-3 campaign.
+        for _ in range(2):
+            e.tick()
+        assert (np.asarray(e.state.role) == 0).all()
+        # The campaign tick runs as a dense fallback (threshold 0): every
+        # row transitions follower -> (pre)candidate -> self-elected leader
+        # inside this dispatch, and no finish has adopted mirrors yet when
+        # the next begin schedules (the pipelined overlap, hand-driven).
+        e.active_fallback_frac = 0.0
+        h = e.tick_begin()
+        assert h["mode"] == "dense" and e._timers_stale
+        stale_roles = e._h_role.copy()
+        stale_leaders = e._h_leader.copy()
+        e.active_fallback_frac = 1.0
+        G = e._schedule_active(1, e._peer_fresh(1))
+        # The predicate's view is the post-step truth...
+        np.testing.assert_array_equal(
+            e._wake_role, np.asarray(e.state.role),
+            err_msg="wake predicate must see the post-step roles")
+        np.testing.assert_array_equal(
+            e._wake_leader, np.asarray(e.state.leader),
+            err_msg="wake predicate must see the post-step leaders")
+        assert not (e._wake_role == stale_roles).all(), \
+            "campaign tick must actually change roles for this test to bite"
+        # ...but the mirrors keep the finish's pre-step baseline.
+        np.testing.assert_array_equal(
+            e._h_role, stale_roles,
+            err_msg="refetch must not clobber the finish's role baseline")
+        np.testing.assert_array_equal(
+            e._h_leader, stale_leaders,
+            err_msg="refetch must not clobber the finish's leader baseline")
+        res = e.tick_finish(h)
+        # With the baseline intact the fallback tick's transitions are
+        # still observed (self-election in every 1-node group).
+        assert sorted(res.became_leader) == [0, 1, 2, 3]
+        np.testing.assert_array_equal(e._h_role, np.asarray(e.state.role))
+
+    asyncio.run(main())
+
+
+def test_fallback_threshold_selects_dense():
+    """active_fallback_frac=0 degrades to the dense/sparse dispatch every
+    tick (the selectable escape hatch), and the handle mode says so."""
+
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=4, params=PARAMS,
+                       active_set=True)
+        e.active_fallback_frac = 0.0
+        h = e.tick_begin()
+        assert h["mode"] == "dense"
+        e.tick_finish(h)
+        assert e._timers_stale
+        # Re-entry refetches the timer mirrors and goes active again.
+        e.active_fallback_frac = 1.0
+        h = e.tick_begin()
+        assert h["mode"] == "active"
+        e.tick_finish(h)
+        assert not e._timers_stale
+        np.testing.assert_array_equal(e._h_elapsed, np.asarray(e.state.elapsed))
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_quiescent_tick_is_decay_only():
+    """Once leaders settle and heartbeats are staggered, fully idle ticks
+    run the decay program alone: empty active set, no gather/step, nothing
+    fetched, zero transfer bytes."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+        engines = [RaftEngine(MemKV(), ids3, ids3[i], groups=4,
+                              params=PARAMS, base_seed=i, active_set=True)
+                   for i in range(3)]
+        for _ in range(40):  # settle elections
+            results = [e.tick() for e in engines]
+            for res in results:
+                for m in res.outbound:
+                    engines[m.dst].receive(m)
+        assert sum(int((e._h_role == LEADER).sum()) for e in engines) == 4
+        saw_empty = 0
+        for _ in range(16):
+            handles = [e.tick_begin() for e in engines]
+            for e, h in zip(engines, handles):
+                if h["mode"] == "active" and len(h["G"]) == 0:
+                    saw_empty += 1
+                    assert h["flat"] is None
+                    assert h["upload_bytes"] == 0 and h["fetch_bytes"] == 0
+                res = e.tick_finish(h)
+                for m in res.outbound:
+                    engines[m.dst].receive(m)
+        assert saw_empty > 0, "no all-quiescent tick in 16 idle ticks"
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_recompile_discipline():
+    """Distinct compiled compact-step shapes are bounded by the bucket
+    count: as the active count fluctuates tick to tick, only a new BUCKET
+    level may compile — never a per-tick shape."""
+
+    async def main():
+        P = 600
+        e = RaftEngine(MemKV(), [1], 1, groups=P,
+                       params=step_params(timeout_min=3, timeout_max=8,
+                                          hb_ticks=16),
+                       active_set=True)
+        e.active_fallback_frac = 1.0
+        for _ in range(20):  # settle: every single-node group elects itself
+            e.tick()
+        rng = np.random.default_rng(3)
+        fn = _active_window_fn(1)
+        before = fn._cache_size()
+        buckets = set()
+        for t in range(60):
+            n = int(rng.integers(1, 520))
+            for g in rng.choice(P, size=n, replace=False):
+                e.propose(int(g), b"x")
+            h = e.tick_begin()
+            assert h["mode"] == "active"
+            buckets.add(active_bucket(len(h["G"]), P))
+            e.tick_finish(h)
+        grown = fn._cache_size() - before
+        assert grown <= len(buckets), \
+            f"{grown} new compiles for {len(buckets)} buckets {buckets}"
+        assert len(buckets) >= 2, "load variation must span bucket levels"
+
+    asyncio.run(main())
+
+
+def test_python_backend_differential():
+    """The scalar-engine twins (_py_gather_active/_py_active_window/
+    _py_decay_scatter) match the python dense step — the third backend of
+    the three-way equivalence contract."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+
+        def mk(active):
+            return [RaftEngine(MemKV(), ids3, ids3[i], groups=3,
+                               fsms={0: ListFsm()}, params=PARAMS,
+                               base_seed=i, backend="python",
+                               active_set=active)
+                    for i in range(3)]
+
+        act, ref = mk(True), mk(False)
+        for e in act:
+            e.active_fallback_frac = 1.0
+        for t in range(45):
+            for cl in (act, ref):
+                if t == 25:
+                    for e in cl:
+                        if e.is_leader(0):
+                            e.propose(0, b"p")
+                results = [e.tick() for e in cl]
+                for res in results:
+                    for m in res.outbound:
+                        cl[m.dst].receive(m)
+            _assert_engines_equal(act[0], ref[0], f"py t={t}")
+            await asyncio.sleep(0)
+
+    asyncio.run(main())

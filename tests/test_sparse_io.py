@@ -255,3 +255,47 @@ def test_sparse_outbox_capacity_shrinks_after_quiet_run():
         assert (await fut) == b""  # no FSM driver: bare commit ack
 
     asyncio.run(main())
+
+
+def test_sparse_outbox_shrink_hysteresis_resets_on_burst():
+    """The 64-tick shrink hysteresis counts CONSECUTIVE quiet ticks: a
+    mid-run burst (total * 2 > the shrink target) must zero the counter and
+    restart the clock, not merely pause it — otherwise 63 quiet ticks +
+    one burst + one quiet tick would shrink the capacity right back into
+    the burst's working set and thrash the compiled-shape ladder."""
+
+    async def main():
+        P = 8192  # > the 4096 capacity floor so shrink has a level to drop
+        e = RaftEngine(MemKV(), [0], 0, groups=P,
+                       params=step_params(timeout_min=3, timeout_max=3,
+                                          hb_ticks=16),
+                       sparse_io=True)
+        # Cold-start burst: every single-member group elects itself on the
+        # same tick, overflowing the 4096 bucket up to P.
+        for _ in range(10):
+            e.tick()
+        assert e._k_out == P, e._k_out
+        # Mid-hysteresis: quiet ticks accumulate but 64 have not elapsed.
+        for _ in range(40):
+            e.tick()
+        assert e._k_out == P
+        assert e._k_out_quiet > 10
+        # Burst: mint on >target/2 groups in one tick — the changed-row
+        # total exceeds half the 4096 shrink target, so the quiet counter
+        # must restart (no overflow: 2500 < the current 8192 capacity).
+        for g in range(2500):
+            e.propose(g, b"x")
+        e.tick()
+        await asyncio.sleep(0)
+        assert e._k_out_quiet == 0, e._k_out_quiet
+        assert e._k_out == P
+        # A fresh sub-64 quiet run still must not shrink...
+        for _ in range(30):
+            e.tick()
+        assert e._k_out == P, "shrink fired before the restarted hysteresis"
+        # ...and a full uninterrupted one does.
+        for _ in range(80):
+            e.tick()
+        assert e._k_out == 4096, e._k_out
+
+    asyncio.run(main())

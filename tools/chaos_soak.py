@@ -38,6 +38,17 @@ def main() -> int:
                     help="max dispatch window per tick (suggest_window clamps)")
     ap.add_argument("--horizon", type=int, default=None,
                     help="override the schedule's chaos-phase tick count")
+    ap.add_argument("--active-set", action="store_true",
+                    help="engines run the active-set compacted scheduler "
+                         "(raft.active_set) under the schedule — partitions "
+                         "and heals exercise mass wake-ups of the wake "
+                         "predicate with the invariants enforced")
+    ap.add_argument("--hb-ticks", type=int, default=None,
+                    help="heartbeat interval in ticks (harness default 1; "
+                         "per-tick heartbeats wake every row every tick, so "
+                         "an --active-set soak needs a larger value to spend "
+                         "ticks on the compacted path instead of the dense "
+                         "fallback — see active_set_stats in the summary)")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -86,7 +97,8 @@ def main() -> int:
         args.seed, schedule, n_nodes=args.nodes, groups=args.groups,
         window=args.window, horizon=args.horizon,
         net=NetFaults.quiet() if args.quiet_net else None,
-        auto_faults=args.auto_faults)
+        auto_faults=args.auto_faults, active_set=args.active_set,
+        hb_ticks=args.hb_ticks)
 
     if args.events:
         with open(args.events, "w") as fh:
@@ -96,9 +108,11 @@ def main() -> int:
             fh.write(result["schedule_json"])
 
     summary = {k: result[k] for k in
-               ("schedule", "seed", "nodes", "groups", "window", "ticks",
-                "proposed", "acked", "fault_events", "chaos_counters",
-                "invariants", "violation")}
+               ("schedule", "seed", "nodes", "groups", "window",
+                "active_set", "ticks", "proposed", "acked", "fault_events",
+                "chaos_counters", "invariants", "violation")}
+    if result.get("active_set_stats"):
+        summary["active_set_stats"] = result["active_set_stats"]
     print(json.dumps(summary))
     return 0 if result["invariants"] == "ok" else 1
 

@@ -44,6 +44,21 @@ chaos_smoke() {
         --horizon 200
 }
 
+chaos_smoke_active_set() {
+    # The same canonical nemesis pair with the active-set compacted
+    # scheduler on: the partition + heal is a mass wake-up of the wake
+    # predicate, and every safety invariant must stay green (the
+    # bit-exactness suite lives in tests/test_active_set.py; this pins the
+    # end-to-end soak path). hb_ticks=4 and 8 groups matter: at the
+    # harness default of per-tick heartbeats every row wakes every tick
+    # and the scheduler falls back to the dense dispatch, so the smoke
+    # would never run the compacted path it exists to cover (the summary's
+    # active_set_stats shows the compacted/fallback split).
+    echo "== chaos smoke (active-set) =="
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200 --active-set --groups 8 --hb-ticks 4
+}
+
 perf_smoke() {
     # Host-bridge perf floor: bench_engine.py --profile at P=1k for a few
     # ticks on CPU; fail if ms/tick regresses >2x vs tools/perf_floor.json
@@ -85,10 +100,14 @@ else
         tests/test_idempotent_produce.py tests/test_metrics.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
+    # The active-set differential suite in its own chunk: the twin-cluster
+    # bit-exactness matrix is the heaviest single file in the suite.
+    python -m pytest tests/test_active_set.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_reset_safety.py -q
     chaos_smoke
+    chaos_smoke_active_set
     perf_smoke
 fi
 echo "CI OK"

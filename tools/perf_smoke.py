@@ -1,15 +1,23 @@
 #!/usr/bin/env python
 """perf-smoke CI stage: the host bridge must not silently re-grow.
 
-Runs ``bench_engine.py --profile`` at the floor file's P for a few ticks on
-the CPU backend and FAILS (exit 1) if ms/tick regresses beyond the allowed
-ratio against the checked-in floor (``tools/perf_floor.json``). The floor
-ratio is deliberately loose (2x by default): CI boxes vary, and the stage
-exists to catch the "someone re-grew the per-entry Python path" class of
-regression (10-50x at scale), not 10% noise. The per-phase profile is
-printed either way, so a failing run says WHERE the regression lives.
+Runs ``bench_engine.py --profile`` for each row of the checked-in floor
+file (``tools/perf_floor.json``) for a few ticks on the CPU backend and
+FAILS (exit 1) if any row's ms/tick regresses beyond its allowed ratio.
+Two rows are checked:
 
-Regenerate the floor after an intentional perf change:
+* the dense P=1k floor (PR 2) — catches "someone re-grew the per-entry
+  Python path" regressions of the classic bridge;
+* an idle-heavy active-set row (P=10k, --active-frac 0.01) — catches
+  regressions of the active-set scheduler path (wake predicate, compact
+  gather/step/scatter, decay kernel), which the dense floor never runs.
+
+The floor ratio is deliberately loose (2x by default): CI boxes vary, and
+the stage exists to catch order-of-magnitude structural regressions, not
+10% noise. The per-phase profile is printed either way, so a failing run
+says WHERE the regression lives.
+
+Regenerate the floors after an intentional perf change:
 
     python tools/perf_smoke.py --write-floor
 """
@@ -26,6 +34,18 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLOOR_PATH = os.path.join(ROOT, "tools", "perf_floor.json")
 
+# Bootstrap shapes, used by --write-floor ONLY when no readable floor
+# file exists yet. Otherwise the checked-in tools/perf_floor.json is the
+# single source of truth for row shapes: --write-floor re-measures the
+# rows it finds there (minus the measured fields), so editing a row's
+# P/warmup/max_regression — or adding a row — in the JSON survives
+# regeneration.
+FLOOR_ROWS = [
+    {"P": 1000, "ticks": 20, "warmup": 20, "max_regression": 2.0},
+    {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
+     "active_set": True, "active_frac": 0.01},
+]
+
 
 def run_bench(floor: dict) -> dict:
     out = os.path.join(tempfile.gettempdir(),
@@ -39,6 +59,10 @@ def run_bench(floor: dict) -> dict:
         "--profile",
         "--out", out,
     ]
+    if floor.get("active_set"):
+        cmd.append("--active-set")
+    if floor.get("active_frac") is not None:
+        cmd += ["--active-frac", str(floor["active_frac"])]
     env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
     subprocess.run(cmd, check=True, cwd=ROOT, env=env,
                    timeout=floor.get("timeout_s", 600))
@@ -53,6 +77,44 @@ def run_bench(floor: dict) -> dict:
     return next(r for r in rows if r["P"] == floor["P"])
 
 
+def _row_name(floor: dict) -> str:
+    if floor.get("active_set"):
+        return (f"P={floor['P']} active-set "
+                f"(active-frac {floor.get('active_frac')})")
+    return f"P={floor['P']} dense"
+
+
+def check_row(floor: dict) -> bool:
+    row = run_bench(floor)
+    ms = row["ms_per_tick"]
+    limit = floor["ms_per_tick_floor"] * floor.get("max_regression", 2.0)
+    phases = row.get("extra", {}).get("profile_phases", {})
+    print(f"perf-smoke: {_row_name(floor)} ms/tick={ms} "
+          f"(floor {floor['ms_per_tick_floor']}, limit {round(limit, 2)})")
+    for phase, s in sorted(phases.items()):
+        print(f"  {phase:>10}: {s['ms_per_round']:8.3f} ms/round "
+              f"(p99 {s['p99_ms']} ms)")
+    stats = row.get("extra", {}).get("active_set_stats")
+    if stats is not None:
+        print(f"  scheduler: {stats['sched_ticks']} compacted ticks, "
+              f"{stats['fallback_ticks']} fallbacks, avg active frac "
+              f"{stats['avg_active_frac']}")
+    if ms > limit:
+        print(f"perf-smoke FAILED [{_row_name(floor)}]: regressed "
+              f"{round(ms / floor['ms_per_tick_floor'], 2)}x past the "
+              f"{floor.get('max_regression', 2.0)}x budget", file=sys.stderr)
+        return False
+    return True
+
+
+def load_floors() -> list[dict]:
+    with open(FLOOR_PATH) as f:
+        data = json.load(f)
+    if "rows" in data:
+        return data["rows"]
+    return [data]  # pre-PR 4 single-row floor file
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--write-floor", action="store_true",
@@ -61,31 +123,26 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.write_floor:
-        floor = {"P": 1000, "ticks": 20, "warmup": 20, "max_regression": 2.0}
-        row = run_bench(floor)
-        floor["ms_per_tick_floor"] = row["ms_per_tick"]
-        floor["recorded_profile"] = row.get("extra", {}).get("profile_phases")
+        try:
+            floors = [{k: v for k, v in f.items()
+                       if k not in ("ms_per_tick_floor", "recorded_profile")}
+                      for f in load_floors()]
+        except (OSError, ValueError):
+            floors = [dict(f) for f in FLOOR_ROWS]
+        for floor in floors:
+            row = run_bench(floor)
+            floor["ms_per_tick_floor"] = row["ms_per_tick"]
+            floor["recorded_profile"] = row.get("extra", {}).get(
+                "profile_phases")
+            print(f"floor measured: {_row_name(floor)} -> "
+                  f"{row['ms_per_tick']} ms/tick")
         with open(FLOOR_PATH, "w") as f:
-            json.dump(floor, f, indent=1)
-        print(f"floor written: {row['ms_per_tick']} ms/tick at "
-              f"P={floor['P']} -> {FLOOR_PATH}")
+            json.dump({"rows": floors}, f, indent=1)
+        print(f"floors written -> {FLOOR_PATH}")
         return 0
 
-    with open(FLOOR_PATH) as f:
-        floor = json.load(f)
-    row = run_bench(floor)
-    ms = row["ms_per_tick"]
-    limit = floor["ms_per_tick_floor"] * floor.get("max_regression", 2.0)
-    phases = row.get("extra", {}).get("profile_phases", {})
-    print(f"perf-smoke: P={floor['P']} ms/tick={ms} "
-          f"(floor {floor['ms_per_tick_floor']}, limit {round(limit, 2)})")
-    for phase, s in sorted(phases.items()):
-        print(f"  {phase:>10}: {s['ms_per_round']:8.3f} ms/round "
-              f"(p99 {s['p99_ms']} ms)")
-    if ms > limit:
-        print(f"perf-smoke FAILED: host bridge regressed "
-              f"{round(ms / floor['ms_per_tick_floor'], 2)}x past the "
-              f"{floor.get('max_regression', 2.0)}x budget", file=sys.stderr)
+    ok = all([check_row(floor) for floor in load_floors()])
+    if not ok:
         return 1
     print("perf-smoke OK")
     return 0
