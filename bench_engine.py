@@ -23,8 +23,11 @@ writes BENCH_engine_kernel.json instead.
 --profile lands the engine's per-tick phase breakdown (inbox / stage /
 dispatch / fetch / decode / apply, cluster-aggregated) into each row's
 ``extra.profile_phases``; every row also carries a commit-latency axis
-(``extra.commit_latency_ticks``: p50/p99 proposal→commit in device
-ticks). --pipeline drives the cluster through engine.tick_pipelined
+(``extra.commit_latency_ticks``: p50/p99 proposal→commit in device ticks,
+read from the engines' own ``raft_commit_latency_ticks`` histogram — the
+product metric, not a bench-private timer). --xprof DIR captures a
+jax.profiler trace of the timed loop. --pipeline drives the cluster
+through engine.tick_pipelined
 (host work overlaps device compute; +1 tick wire latency PER HOP, so
 commit p50 roughly doubles — recorded by the latency axis). --proposals
 sets the offered client load (distinct groups offered one payload per
@@ -80,6 +83,7 @@ else:
 from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import RaftEngine
 from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.metrics import REGISTRY
 
 N = 3
 PROPOSALS_PER_TICK = 256  # distinct groups offered one payload each tick
@@ -87,11 +91,11 @@ PAYLOAD = b"x" * 64
 
 
 class _BenchFsm:
-    """Constant-work apply target. Without an FSM the engine resolves a
-    proposal future at MINT time (nothing to apply), which would make the
-    commit-latency axis report mint latency (always 1 tick); with one, the
-    future resolves when the block actually commits and applies — the
-    product path."""
+    """Constant-work apply target so the engines run the full product
+    commit path (chain commit -> FSM apply -> future resolution at commit,
+    not at mint). The commit-latency axis itself now comes from the
+    engine's own ``raft_commit_latency_ticks`` histogram — the bench reads
+    the product metric instead of timing futures privately."""
 
     __slots__ = ()
 
@@ -99,11 +103,19 @@ class _BenchFsm:
         return b""
 
 
+def _retrieve(fut):
+    """Done-callback retrieving a discarded proposal future's exception so
+    failed proposals (NotLeader during churn) don't spray 'exception was
+    never retrieved' into the bench output at GC."""
+    fut.cancelled() or fut.exception()
+
+
 async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                     pipeline: bool = False, profile: bool = False,
                     proposals_per_tick: int = PROPOSALS_PER_TICK,
                     active_set: bool = False,
-                    active_frac: float | None = None) -> dict:
+                    active_frac: float | None = None,
+                    xprof: str | None = None) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
     # leader is 200k messages/tick of pure liveness noise). Election
@@ -135,23 +147,11 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     proposed = committed = 0
 
     executed = [0] * N  # device ticks actually run per engine
-    # Commit-latency axis: (future, submit tick) pairs polled each round;
-    # latency is proposal→commit in DEVICE ticks (the protocol's clock).
-    pending_lat: list[tuple] = []
-    latencies: list[int] = []
-
-    def poll_latencies():
-        if not pending_lat:
-            return
-        now = executed[0]
-        still = []
-        for fut, t0_ in pending_lat:
-            if fut.done():
-                if not fut.cancelled() and fut.exception() is None:
-                    latencies.append(now - t0_)
-            else:
-                still.append((fut, t0_))
-        pending_lat[:] = still
+    # Commit-latency axis: the engines' own raft_commit_latency_ticks
+    # histogram (proposal→commit in DEVICE ticks, observed leader-side at
+    # commit advancement) — the product metric, aggregated across the
+    # cluster's three node-labelled series at report time.
+    lat_hist = REGISTRY.histogram("raft_commit_latency_ticks")
 
     def one_tick(live: bool):
         nonlocal proposed, committed
@@ -194,11 +194,9 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
             for g in set(int(g) for g in groups):
                 for e in engines:
                     if e.is_leader(g):
-                        fut = e.propose(g, PAYLOAD)
-                        pending_lat.append((fut, executed[0]))
+                        e.propose(g, PAYLOAD).add_done_callback(_retrieve)
                         proposed += 1
                         break
-        poll_latencies()
 
     # Warm up UNDER the offered load: steady state includes the client
     # lane, and for --active-set the load sets which power-of-two bucket
@@ -211,22 +209,32 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
 
     proposed = committed = 0
     executed = [0] * N
-    # The discarded warmup futures may still get NotLeader set later (the
-    # drivers hold references) — retrieve it so the drop doesn't spray
-    # "exception was never retrieved" into the bench output at GC.
-    for fut, _ in pending_lat:
-        fut.add_done_callback(lambda f: f.cancelled() or f.exception())
-    pending_lat.clear()
-    latencies.clear()
+    # Measure the timed loop only: drop the warmup's latency observations
+    # (the registry is process-global, so this also clears any previous
+    # size's series in a multi-size run) AND the engines' open entries for
+    # warmup-minted blocks still in flight — those commit inside the timed
+    # window and would otherwise pad n with warmup samples.
+    lat_hist.values.clear()
+    for e in engines:
+        e._lat_open.clear()
     for e in engines:
         e.active_sched_ticks = e.active_sched_rows = 0
         e.active_fallback_ticks = 0
     if profile:
         for e in engines:
             e.profiler.reset()  # profile the timed loop only
+    # Optional device trace capture (jax.profiler xplane) around the timed
+    # loop — on a TPU grant this lands an xplane artifact next to the bench
+    # rows (VERDICT device-bench list).
+    import contextlib
+
+    import jax
+
+    trace_ctx = jax.profiler.trace(xprof) if xprof else contextlib.nullcontext()
     t0 = time.perf_counter()
-    for _ in range(ticks):
-        one_tick(live=True)
+    with trace_ctx:
+        for _ in range(ticks):
+            one_tick(live=True)
     dt = time.perf_counter() - t0
     sched_snap = [(e.active_sched_ticks, e.active_sched_rows,
                    e.active_fallback_ticks) for e in engines]
@@ -250,14 +258,14 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         for phase, agg in prof_snap.items():
             agg["ms_per_round"] = round(agg["total_ms"] / ticks, 3)
 
-    # Let in-flight commits drain so the commit count is meaningful.
+    # Let in-flight commits drain so the commit count is meaningful (their
+    # latencies land in the engine histogram as they commit).
     for _ in range(20):
         one_tick(live=False)
     for e in engines:
         if e.pipeline_window:
             res = e.tick_drain()
             committed += len(res.committed)
-    poll_latencies()
 
     row = {
         "P": P,
@@ -294,13 +302,14 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
             "avg_active_frac": round(
                 sum(s[1] for s in sched_snap) / max(1, s_ticks) / P, 4),
         }
-    if latencies:
-        lat = np.asarray(latencies)
+    if lat_hist.count():
+        # Cluster aggregate across the three engines' node-labelled series;
+        # quantiles are bucket-interpolated (power-of-two buckets), which
+        # is the same resolution any Prometheus scraper of the product
+        # metric would quote.
         extra["commit_latency_ticks"] = {
-            "n": int(lat.size),
-            "p50": float(np.percentile(lat, 50)),
-            "p99": float(np.percentile(lat, 99)),
-            "max": int(lat.max()),
+            **lat_hist.summary(),
+            "source": "raft_commit_latency_ticks histogram",
         }
     if prof_snap is not None:
         extra["profile_phases"] = dict(sorted(prof_snap.items()))
@@ -414,6 +423,10 @@ async def main():
                          "round(frac*P) distinct groups get one proposal "
                          "per tick (overrides --proposals; the dense-vs-"
                          "active-set comparison axis)")
+    ap.add_argument("--xprof", default=None, metavar="DIR",
+                    help="capture a jax.profiler trace (xplane) of the "
+                         "timed loop into DIR — pairs a device profile "
+                         "with the bench row on a TPU grant")
     ap.add_argument("--kernel", action="store_true",
                     help="time the bare packed step only (no cluster, no wire)")
     ap.add_argument("--out", default=None,
@@ -437,7 +450,8 @@ async def main():
                                 pipeline=args.pipeline, profile=args.profile,
                                 proposals_per_tick=args.proposals,
                                 active_set=args.active_set,
-                                active_frac=args.active_frac)
+                                active_frac=args.active_frac,
+                                xprof=args.xprof)
         results.append(r)
         print(json.dumps(r))
 

@@ -21,6 +21,7 @@ ADDed/REMOVEd through conf blocks mid-chaos) — the library form of the old
 from __future__ import annotations
 
 import json
+from collections import deque
 
 from josefine_tpu.chaos import invariants
 from josefine_tpu.chaos.faults import FaultPlane, NetFaults
@@ -30,6 +31,10 @@ from josefine_tpu.raft.membership import ADD, REMOVE, ConfChange
 from josefine_tpu.utils.kv import MemKV
 
 DEFAULT_PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+# Per-node flight-journal archive cap (events): a few engine rings deep —
+# restart churn keeps the newest history instead of growing without bound.
+_ARCHIVE_CAP = 16384
 
 
 class SnapFsm:
@@ -67,13 +72,48 @@ def expand_outbound(outbound):
 class _PlaneDrivenCluster:
     """Driver scaffolding shared by the plane-mediated harnesses: virtual-
     clock accessors, delayed-message maturation, fault-plane routing of
-    engine outboxes, and ack harvesting. Subclasses own engine lifecycle
-    (``self.engines`` slots may be None for removed members) and the
-    fault-drawing policy."""
+    engine outboxes, ack harvesting, and flight-journal collection.
+    Subclasses own engine lifecycle (``self.engines`` slots may be None for
+    removed members) and the fault-drawing policy."""
 
     @property
     def tick_no(self) -> int:
         return self.plane.tick
+
+    def _archive_flight(self, i: int) -> None:
+        """Carry a to-be-replaced engine's flight journal into the per-node
+        archive (with a boot boundary marker), so crash/restart churn does
+        not erase the structured history the journal exists to provide.
+        Purely tick-indexed — same-seed runs archive identically."""
+        prev = getattr(self, "engines", None)
+        arch = getattr(self, "flight_archive", None)
+        if arch is None or prev is None or i >= len(prev) or prev[i] is None:
+            return
+        arch[i].extend(prev[i].flight.events())
+        arch[i].append({"seq": -1, "tick": self.tick_no, "kind": "boot",
+                        "group": -1, "term": -1, "leader": -1})
+
+    def flight_journals(self) -> dict[str, list[dict]]:
+        """Per-node flight journals: archived (pre-restart) events plus the
+        live engine's ring, oldest first."""
+        arch = getattr(self, "flight_archive", None) or {}
+        out: dict[str, list[dict]] = {}
+        for i, e in enumerate(self.engines):
+            evs = list(arch[i]) if arch else []
+            if e is not None:
+                evs.extend(e.flight.events())
+            out[str(i)] = evs
+        return out
+
+    def flight_journals_jsonl(self) -> dict[str, str]:
+        """JSONL form of :meth:`flight_journals` (sorted keys, compact) —
+        the byte-identical-across-same-seed-runs artifact."""
+        return {
+            node: "".join(json.dumps(e, sort_keys=True,
+                                     separators=(",", ":")) + "\n"
+                          for e in evs)
+            for node, evs in self.flight_journals().items()
+        }
 
     @property
     def down(self) -> set[int]:
@@ -161,6 +201,12 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.kvs = [MemKV() for _ in range(n_nodes)]
         # One FSM per (node, group): apply order is only defined per group.
         self.fsms = [[SnapFsm() for _ in range(groups)] for _ in range(n_nodes)]
+        # Per-node flight-journal archive: restart churn rebuilds engines,
+        # and each rebuild banks the dead engine's journal here. Bounded
+        # (a few rings deep) so a crash-loop soak's memory and artifact
+        # size do not grow linearly with restart count.
+        self.flight_archive = [deque(maxlen=_ARCHIVE_CAP)
+                               for _ in range(n_nodes)]
         self.engines = [self._make(i) for i in range(n_nodes)]
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.ledger = invariants.ElectionSafetyLedger()
@@ -171,6 +217,7 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.ack_tick: dict[bytes, int] = {}
 
     def _make(self, i: int) -> RaftEngine:
+        self._archive_flight(i)
         self.fsms[i] = [SnapFsm() for _ in range(self.G)]
         e = RaftEngine(
             self.kvs[i], self.ids, self.ids[i], groups=self.G,
@@ -334,6 +381,8 @@ class MembershipChaosCluster(_PlaneDrivenCluster):
         self.ids = [1, 2, 3, 4]
         self.kvs = [MemKV() for _ in range(self.MAX)]
         self.fsms = [[SnapFsm() for _ in range(groups)] for _ in range(self.MAX)]
+        self.flight_archive = [deque(maxlen=_ARCHIVE_CAP)
+                               for _ in range(self.MAX)]
         self.engines: list[RaftEngine | None] = [
             self._make(i, [1, 2, 3]) for i in range(3)] + [None]
         self.delayed: list[tuple[int, int, object]] = []
@@ -348,6 +397,7 @@ class MembershipChaosCluster(_PlaneDrivenCluster):
         self.removes_committed = 0
 
     def _make(self, i: int, member_ids) -> RaftEngine:
+        self._archive_flight(i)
         self.fsms[i] = [SnapFsm() for _ in range(self.G)]
         return RaftEngine(
             self.kvs[i], list(member_ids), self.ids[i], groups=self.G,
@@ -433,7 +483,10 @@ class MembershipChaosCluster(_PlaneDrivenCluster):
             self.adds_committed += 1
         elif (not member and self.engines[3] is not None
                 and not self.plane.is_down(3)):
-            self.engines[3] = None  # committed removal: stop the process
+            # Committed removal: stop the process — banking the removed
+            # incarnation's journal first (the archive's whole contract).
+            self._archive_flight(3)
+            self.engines[3] = None
             self.removes_committed += 1
 
         if self.conf_fut is not None and not self.conf_fut.done():
@@ -464,6 +517,7 @@ class MembershipChaosCluster(_PlaneDrivenCluster):
             self.engines[3] = self._make(3, [1, 2, 3, 4])
             self.adds_committed += 1
         elif not member and self.engines[3] is not None:
+            self._archive_flight(3)
             self.engines[3] = None
             self.removes_committed += 1
 

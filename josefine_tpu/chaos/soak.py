@@ -12,6 +12,8 @@ soak finding needs a deterministic reproducer.
 from __future__ import annotations
 
 import asyncio
+import json
+import os
 
 from josefine_tpu.chaos.faults import FaultPlane, NetFaults
 from josefine_tpu.chaos.harness import DEFAULT_PARAMS, ChaosCluster
@@ -37,7 +39,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          auto_faults: bool = False,
                          horizon: int | None = None,
                          active_set: bool = False,
-                         hb_ticks: int | None = None) -> dict:
+                         hb_ticks: int | None = None,
+                         artifact_path: str | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -48,7 +51,13 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     spends nearly all its ticks in the dense fallback. Raising it opens
     quiescent gaps between heartbeats and makes the soak exercise the
     compacted gather/step/scatter/decay path the flag asks for (the
-    summary's active_set_stats shows which path actually ran)."""
+    summary's active_set_stats shows which path actually ran).
+
+    On an invariant violation the run auto-dumps a JSON repro artifact —
+    the per-node flight-recorder journals, the metrics-registry dump, the
+    fault-event log, and the violation — to ``artifact_path`` (default
+    ``chaos_artifact_<schedule>_<seed>.json`` in the working directory);
+    the result carries the path as ``artifact``."""
     sched = resolve_schedule(schedule, n_nodes)
     plane = FaultPlane(seed, n_nodes, net=net)
     params = DEFAULT_PARAMS if hb_ticks is None else step_params(
@@ -77,6 +86,30 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     except InvariantViolation as e:
         violation = str(e)
 
+    journals = cluster.flight_journals_jsonl()
+    artifact = None
+    if violation is not None:
+        # Auto-dump the repro artifact: what the consensus state DID
+        # (per-node journals), what the counters say (registry dump), and
+        # what the nemesis injected (event log) — the structured history a
+        # tripped invariant is otherwise missing.
+        artifact = artifact_path or os.path.abspath(
+            f"chaos_artifact_{sched.name}_{seed}.json")
+        try:
+            with open(artifact, "w") as fh:
+                json.dump({
+                    "schedule": sched.name,
+                    "seed": seed,
+                    "tick": cluster.tick_no,
+                    "violation": violation,
+                    "journals": journals,
+                    "registry": REGISTRY.dump(),
+                    "event_log": plane.event_log_jsonl(),
+                    "schedule_json": sched.to_json(),
+                }, fh, indent=1)
+        except OSError:
+            artifact = None
+
     acked_total = sum(len(v) for v in cluster.acked.values())
     return {
         "schedule": sched.name,
@@ -102,7 +135,12 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         } if active_set else None,
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
+        "artifact": artifact,
         "event_log": plane.event_log_jsonl(),
+        # Per-node flight journals (JSONL): byte-identical across same-seed
+        # runs — the flight-recorder half of the determinism contract.
+        "journals": journals,
+        "registry_dump": REGISTRY.dump(),
         "schedule_json": sched.to_json(),
         "state_digest": cluster.state_digest(),
     }

@@ -96,6 +96,12 @@ class RaftConfig:
     # already cheap and the scheduler is pure overhead. Incompatible with
     # engine.partitions > 1 (the sharded engine keeps the dense schedule).
     active_set: bool = False
+    # Consensus flight-recorder ring capacity (events): the engine journals
+    # role/term/leader transitions, snapshot installs, group lifecycle and
+    # scheduler mode flips into a bounded, wall-clock-free ring served at
+    # the /events endpoint. Steady-state ticks emit nothing, so the cost is
+    # O(transitions); the ring bounds memory for week-long soaks.
+    flight_ring: int = 4096
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120
@@ -166,6 +172,8 @@ class RaftConfig:
             raise ValueError("election_timeout_max_ms < election_timeout_min_ms")
         if self.window_ticks < 1:
             raise ValueError("raft.window_ticks must be >= 1")
+        if self.flight_ring < 1:
+            raise ValueError("raft.flight_ring must be >= 1")
         for n in self.nodes:
             if n.id == self.id:
                 raise ValueError(f"raft.nodes must not contain self (id {n.id})")

@@ -120,6 +120,10 @@ class Node:
                 config.broker.ip, config.broker.metrics_port,
                 state_fn=lambda: self.raft.engine.debug_state(),
                 node=config.raft.id,
+                # /events: this node's consensus flight-recorder journal
+                # (node-scoped by construction — each endpoint serves its
+                # own engine's ring).
+                events_fn=lambda: self.raft.engine.flight.events(),
             )
 
     def _rewire_partitions(self) -> None:

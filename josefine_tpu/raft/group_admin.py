@@ -138,6 +138,8 @@ class GroupAdmin:
             pb for pb in (b.take(b.group != g) for b in self._pending_batches)
             if len(pb)]
         self._recycled_this_tick.add(g)
+        self.flight.emit(self._flight_tick(), "group_recycled", group=g,
+                         inc=int(self._h_ginc[g]))
 
     def configure_groups(self, claims: dict[int, frozenset[int] | set[int]]) -> None:
         """Replace ALL data-group claims at once (startup re-wiring from the
@@ -252,10 +254,16 @@ class GroupAdmin:
             _m_paroled.set(len(self._parole), node=self.self_id)
             log.warning("g=%d entering vote parole until head >= %#x",
                         g, old_head)
+        self.flight.emit(self._flight_tick(), "group_reset", group=g,
+                         term=int(self._h_term[g]), parole=int(bool(
+                             parole and old_head > GENESIS and n_voters > 1)),
+                         old_head=old_head)
         ch.reset()
         self.kv.delete(b"g%d:snap" % g)
         self._snap_cache.pop(g, None)
         self._drop_group_transfers(g)
+        # Open commit-latency entries describe blocks the reset discarded.
+        self._lat_open.pop(g, None)
         if self._nxt_fixups:
             # Deferred send-pointer re-roots recorded for this row predate
             # the reset — the reset zeroes the row's nxt below, and a later
@@ -304,6 +312,8 @@ class GroupAdmin:
         )
 
     def _lift_parole(self, g: int) -> None:
+        if g in self._parole:
+            self.flight.emit(self._flight_tick(), "parole_lifted", group=g)
         self._parole.pop(g, None)
         self.kv.delete(b"parole:%d" % g)
         _m_paroled.set(len(self._parole), node=self.self_id)

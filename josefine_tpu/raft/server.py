@@ -91,6 +91,7 @@ class JosefineRaft:
             max_append_entries=config.max_append_entries,
             active_set=config.active_set and mesh is None,
             mesh=mesh,
+            flight_ring=getattr(config, "flight_ring", 4096),
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

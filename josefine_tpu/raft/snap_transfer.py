@@ -570,6 +570,8 @@ class SnapshotTransfer:
                                    slot=m.slot)
                         for m in self.members.by_id.values())
         _m_installs.inc(node=self.self_id)
+        self.flight.emit(self._flight_tick(), "snapshot_install", group=g,
+                         term=snap_term, snap_id=int(msg.x), src=msg.src)
 
     def _probe_msg(self, g: int, dst: int, term: int, snap_id: int) -> rpc.WireMsg:
         """Position probe (ok=1, empty payload): asks the follower where an

@@ -1,0 +1,125 @@
+"""Consensus flight recorder: a bounded, deterministic journal of engine
+transitions.
+
+Counters say *how much*; the flight recorder says *what happened, in what
+order*. Each entry is a structured event ``{seq, tick, kind, group, term,
+leader, detail}`` appended by :class:`~josefine_tpu.raft.engine.RaftEngine`
+at host-visible consensus transitions:
+
+* ``election_won`` / ``election_lost`` — device role transitions observed
+  by the tick-finish mirror diff (won = the mint-authority grant; lost = a
+  candidacy that collapsed back to follower);
+* ``leader_change`` / ``term_bump`` — the same diff on the leader/term
+  mirrors (every node records the change, not just the winner);
+* ``snapshot_install`` — a leader snapshot adopted over the local chain;
+* ``group_reset`` / ``group_recycled`` / ``parole_lifted`` — group
+  lifecycle (reset carries the vote-parole watermark when one was set);
+* ``active_mode_flip`` — the active-set scheduler crossing between the
+  compacted path and the dense fallback;
+* ``pipeline_defer`` — a host-side message (snapshot chunk/ack) deferred
+  because a pipelined dispatch was in flight;
+* ``backlog_drop`` — the per-src intake backlog cap discarding a stale
+  batch.
+
+Design constraints, in order:
+
+1. **Deterministic.** Events are indexed by the engine's device tick and a
+   per-recorder sequence number; nothing wall-clock-derived is ever
+   recorded, so two same-seed chaos runs yield byte-identical journals
+   (``dump_jsonl`` — sorted keys, compact separators; pinned by
+   tests/test_flight.py).
+2. **Near-free.** Emission sites are transitions the engine's tick-finish
+   already detects by diffing the host mirrors (the active-set scheduler
+   maintains them anyway); steady-state ticks emit nothing.
+3. **Bounded.** A ring (default 4096 events) — a week-long soak journals
+   the same memory as a 30-tick test. ``seq`` keeps counting past
+   evictions, so a reader can tell how much history scrolled off.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+
+__all__ = ["FlightRecorder", "filter_events"]
+
+
+def filter_events(events, group: int | None = None, kind: str | None = None,
+                  limit: int | None = None) -> list:
+    """Shared journal filter (the recorder's ``events()`` and the
+    MetricsServer ``/events`` query params are the same semantics, defined
+    once): optional group/kind match, then keep the newest ``limit``
+    (``limit=0`` returns nothing, not everything)."""
+    if group is not None:
+        events = (e for e in events if e.get("group") == group)
+    if kind is not None:
+        events = (e for e in events if e.get("kind") == kind)
+    out = list(events)
+    if limit is not None:
+        out = out[-int(limit):] if int(limit) > 0 else []
+    return out
+
+
+def _js(v):
+    """JSON-safe, determinism-safe coercion for detail values (numpy
+    scalars flatten to Python ints/floats; everything else must already be
+    a plain str/int/float/bool)."""
+    if isinstance(v, (str, bool)) or v is None:
+        return v
+    if isinstance(v, float):
+        return float(v)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+class FlightRecorder:
+    """Bounded ring of structured consensus events (see module docstring)."""
+
+    __slots__ = ("_ring", "seq", "capacity")
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = int(capacity)
+        self._ring: deque[dict] = deque(maxlen=self.capacity)
+        self.seq = 0  # events ever emitted (monotone past ring eviction)
+
+    def emit(self, tick: int, kind: str, group: int = -1, term: int = -1,
+             leader: int = -1, **detail) -> None:
+        ev = {
+            "seq": self.seq,
+            "tick": int(tick),
+            "kind": kind,
+            "group": int(group),
+            "term": int(term),
+            "leader": int(leader),
+        }
+        if detail:
+            ev["detail"] = {k: _js(v) for k, v in sorted(detail.items())}
+        self.seq += 1
+        self._ring.append(ev)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def events(self, limit: int | None = None, group: int | None = None,
+               kind: str | None = None) -> list[dict]:
+        """The journal (oldest first), optionally filtered; ``limit`` keeps
+        the newest N after filtering. Returns copies — callers may mutate."""
+        return [dict(e) for e in
+                filter_events(self._ring, group=group, kind=kind, limit=limit)]
+
+    def tail(self, n: int = 32) -> list[dict]:
+        return self.events(limit=n)
+
+    def dump_jsonl(self) -> str:
+        """One compact JSON object per line, sorted keys — byte-identical
+        across same-seed runs (the chaos determinism contract)."""
+        return "\n".join(
+            json.dumps(e, sort_keys=True, separators=(",", ":"))
+            for e in self._ring
+        ) + ("\n" if self._ring else "")
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.seq = 0

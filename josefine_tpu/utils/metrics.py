@@ -1,4 +1,4 @@
-"""Metrics: counters/gauges + a Prometheus-style exposition endpoint.
+"""Metrics: counters/gauges/histograms + a Prometheus-style exposition endpoint.
 
 The reference has no metrics at all — observability is tracing logs plus a
 debug JSON file the leader rewrites synchronously every 100 ms tick
@@ -6,13 +6,34 @@ debug JSON file the leader rewrites synchronously every 100 ms tick
 registry the hot paths bump (plain int adds; no locks — all writers run on
 the asyncio event loop), read out on demand over a tiny HTTP endpoint
 (``/metrics`` Prometheus text, ``/state`` the debug-state JSON the
-reference's tick file carried, ``/healthz``).
+reference's tick file carried, ``/events`` the consensus flight-recorder
+journal, ``/healthz``).
+
+Three metric types:
+
+* :class:`Counter` — monotone, optionally labelled;
+* :class:`Gauge` — point-in-time; ``set()`` replaces, or ``set_fn`` wires a
+  sampled-at-scrape callback **per label set** (callback series go through
+  the same node-scope filter as stored series — a multi-node process must
+  not leak one node's callback value onto every endpoint);
+* :class:`Histogram` — power-of-two buckets with Prometheus
+  ``_bucket``/``_sum``/``_count`` exposition and a host-side
+  :meth:`~Histogram.quantile` (linear interpolation inside the bucket), so
+  the engine itself can quote p50/p99 commit latency without a scraper.
+
+Scrape-time collection: components whose interesting numbers live on live
+objects (the engine's scheduler stats, the phase profiler) register a
+*collect hook* (:meth:`Registry.add_collect_hook`) that refreshes gauges
+just before ``dump()``/``render_prometheus()`` read them. Hooks are held
+via a weakref to their owner, so a chaos soak that rebuilds engines
+hundreds of times never accumulates dead publishers.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import weakref
 from typing import Callable
 
 from josefine_tpu.utils.tracing import get_logger
@@ -58,31 +79,220 @@ class BoundCounter:
 
 class Gauge(Counter):
     """Point-in-time value; ``set()`` replaces, ``inc()`` adjusts. May also
-    wrap a callback via ``set_fn`` for sampled-at-scrape values."""
+    wrap callbacks via ``set_fn`` for sampled-at-scrape values — one
+    callback per label set, so callback series can be node-scoped like any
+    stored series (``set_fn(fn)`` with no labels keeps the legacy shared,
+    every-endpoint behavior)."""
 
     _TYPE = "gauge"
 
     def __init__(self, name: str, help_: str, registry: "Registry | None" = None):
         super().__init__(name, help_, registry)
-        self._fn: Callable[[], float] | None = None
+        self._fns: dict[tuple, Callable[[], float]] = {}
 
     def set(self, v: float, **labels) -> None:
         self.values[tuple(sorted(labels.items()))] = v
 
-    def set_fn(self, fn: Callable[[], float]) -> None:
-        self._fn = fn
+    def set_fn(self, fn: Callable[[], float], **labels) -> None:
+        """Register a sampled-at-scrape callback for this label set. A
+        node-labelled callback (``set_fn(fn, node=i)``) is visible only on
+        node i's endpoint — the fix for the callback-gauges-bypass-the-
+        node-filter hole."""
+        self._fns[tuple(sorted(labels.items()))] = fn
 
     def get(self, **labels) -> float:
-        if self._fn is not None and not labels:
-            return self._fn()
+        fn = self._fns.get(tuple(sorted(labels.items())))
+        if fn is not None:
+            return fn()
         return super().get(**labels)
+
+    def _series(self) -> list[tuple[tuple, float]]:
+        """Stored + callback series, callbacks winning on key collision."""
+        out = {key: val for key, val in self.values.items()}
+        for key, fn in self._fns.items():
+            try:
+                out[key] = fn()
+            except Exception:
+                log.exception("gauge %s callback failed", self.name)
+        return sorted(out.items())
+
+
+class _HistSeries:
+    """One label set's bucket counts + sum/count."""
+
+    __slots__ = ("buckets", "inf", "total", "count")
+
+    def __init__(self, levels: int):
+        self.buckets = [0] * levels  # cumulative-at-render; stored per-bucket
+        self.inf = 0
+        self.total = 0.0
+        self.count = 0
+
+    def observe(self, v: float, levels: int) -> None:
+        self.total += v
+        self.count += 1
+        if v <= 1:
+            self.buckets[0] += 1
+            return
+        # Power-of-two upper bounds 1, 2, 4, ... 2^(levels-1): bucket index
+        # is ceil(log2(v)) for integral v, computed via bit_length.
+        idx = (int(v) - 1).bit_length() if v == int(v) else None
+        if idx is None:
+            idx = 0
+            while (1 << idx) < v and idx < levels:
+                idx += 1
+        if idx < levels:
+            self.buckets[idx] += 1
+        else:
+            self.inf += 1
+
+
+class Histogram:
+    """Power-of-two-bucket histogram (upper bounds 1, 2, 4, …, 2^(levels-1),
+    +Inf), labelled like a Counter. Values are expected non-negative and
+    usually integral (the engine records device-tick latencies).
+
+    Exposition follows the Prometheus histogram convention:
+    ``name_bucket{le="2"}`` cumulative counts, ``name_sum``, ``name_count``.
+    """
+
+    _TYPE = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 registry: "Registry | None" = None, levels: int = 16):
+        self.name = name
+        self.help = help_
+        self.levels = levels
+        self.values: dict[tuple, _HistSeries] = {}
+        (registry or REGISTRY)._add(self)
+
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        s = self.values.get(key)
+        if s is None:
+            s = self.values[key] = _HistSeries(self.levels)
+        s.observe(v, self.levels)
+
+    def bind(self, **labels) -> "BoundHistogram":
+        return BoundHistogram(self, tuple(sorted(labels.items())))
+
+    def count(self, **labels) -> int:
+        """Observation count. With no labels: summed over every series."""
+        if labels:
+            s = self.values.get(tuple(sorted(labels.items())))
+            return s.count if s else 0
+        return sum(s.count for s in self.values.values())
+
+    def _merged(self, labels: dict) -> _HistSeries | None:
+        """One series, or (no labels) the bucket-wise sum of all series."""
+        if labels:
+            return self.values.get(tuple(sorted(labels.items())))
+        if not self.values:
+            return None
+        m = _HistSeries(self.levels)
+        for s in self.values.values():
+            m.inf += s.inf
+            m.total += s.total
+            m.count += s.count
+            for i, c in enumerate(s.buckets):
+                m.buckets[i] += c
+        return m
+
+    def quantile(self, q: float, **labels) -> float:
+        """Approximate quantile from the buckets (linear interpolation
+        between the bucket's lower and upper bound — histogram_quantile
+        semantics). No labels aggregates every series, which is how the
+        bench quotes a cluster-wide p50/p99 across the three engines'
+        node-labelled series. Returns 0.0 on an empty histogram; +Inf-
+        bucket hits return the largest finite bound."""
+        s = self._merged(labels)
+        if s is None or s.count == 0:
+            return 0.0
+        rank = q * s.count
+        cum = 0.0
+        lower = 0.0
+        for i, c in enumerate(s.buckets):
+            upper = float(1 << i)
+            if c and cum + c >= rank:
+                return lower + (upper - lower) * (rank - cum) / c
+            cum += c
+            lower = upper
+        return float(1 << (self.levels - 1))
+
+    def summary(self, **labels) -> dict:
+        """{n, p50, p99, sum} for one series (or the aggregate)."""
+        s = self._merged(labels)
+        n = s.count if s else 0
+        return {
+            "n": n,
+            "p50": round(self.quantile(0.5, **labels), 3),
+            "p99": round(self.quantile(0.99, **labels), 3),
+            "sum": round(s.total, 3) if s else 0.0,
+        }
+
+    def _render(self, lines: list[str], node) -> None:
+        emitted = False
+        for key, s in sorted(self.values.items()):
+            if not Registry._visible(key, node):
+                continue
+            emitted = True
+            base = ",".join(f'{k}="{v}"' for k, v in key)
+            sep = "," if base else ""
+            cum = 0
+            for i, c in enumerate(s.buckets):
+                cum += c
+                lines.append(
+                    f'{self.name}_bucket{{{base}{sep}le="{1 << i}"}} {cum}')
+            lines.append(
+                f'{self.name}_bucket{{{base}{sep}le="+Inf"}} {cum + s.inf}')
+            if base:
+                lines.append(f"{self.name}_sum{{{base}}} {s.total}")
+                lines.append(f"{self.name}_count{{{base}}} {s.count}")
+            else:
+                lines.append(f"{self.name}_sum {s.total}")
+                lines.append(f"{self.name}_count {s.count}")
+        if not emitted:
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} 0')
+            lines.append(f"{self.name}_sum 0")
+            lines.append(f"{self.name}_count 0")
+
+    def _dump(self, node) -> dict:
+        out = {}
+        for key, s in sorted(self.values.items()):
+            if not Registry._visible(key, node):
+                continue
+            out[",".join(f"{k}={v}" for k, v in key)] = {
+                "count": s.count,
+                "sum": s.total,
+                "buckets": {str(1 << i): c for i, c in enumerate(s.buckets)
+                            if c},
+                "inf": s.inf,
+            }
+        return out
+
+
+class BoundHistogram:
+    __slots__ = ("_h", "_k")
+
+    def __init__(self, hist: Histogram, key: tuple):
+        self._h = hist
+        self._k = key
+
+    def observe(self, v: float) -> None:
+        h = self._h
+        s = h.values.get(self._k)
+        if s is None:
+            s = h.values[self._k] = _HistSeries(h.levels)
+        s.observe(v, h.levels)
 
 
 class Registry:
     def __init__(self):
         self._metrics: dict[str, Counter] = {}
+        # (owner weakref, fn) collect hooks, run before every dump/render.
+        self._hooks: list[tuple[weakref.ref, Callable]] = []
 
-    def _add(self, m: Counter) -> None:
+    def _add(self, m) -> None:
         if m.name in self._metrics:
             raise ValueError(f"duplicate metric {m.name}")
         self._metrics[m.name] = m
@@ -102,6 +312,40 @@ class Registry:
             raise ValueError(f"{name} is not a gauge")
         return m
 
+    def histogram(self, name: str, help_: str = "", levels: int = 16) -> Histogram:
+        m = self._metrics.get(name)
+        if m is None:
+            m = Histogram(name, help_, self, levels=levels)
+        if not isinstance(m, Histogram):
+            raise ValueError(f"{name} is not a histogram")
+        return m
+
+    # -------------------------------------------------------- collect hooks
+
+    def add_collect_hook(self, owner, fn: Callable) -> None:
+        """Register ``fn(owner)`` to run just before every scrape while
+        ``owner`` is alive. The registry holds only a weakref to the owner,
+        so components that are rebuilt (chaos-soak engines) retire their
+        publishers automatically; the sweep on add keeps the list bounded
+        even in a scrape-free soak."""
+        self._hooks = [(r, f) for r, f in self._hooks if r() is not None]
+        self._hooks.append((weakref.ref(owner), fn))
+
+    def _run_hooks(self) -> None:
+        live = []
+        for ref, fn in self._hooks:
+            owner = ref()
+            if owner is None:
+                continue
+            try:
+                fn(owner)
+            except Exception:
+                log.exception("metrics collect hook failed")
+            live.append((ref, fn))
+        self._hooks = live
+
+    # ----------------------------------------------------------- exposition
+
     @staticmethod
     def _visible(key: tuple, node) -> bool:
         """Series visibility under a node scope: unlabelled series and
@@ -115,16 +359,20 @@ class Registry:
         return True
 
     def dump(self, node=None) -> dict:
+        self._run_hooks()
         out = {}
         for name, m in sorted(self._metrics.items()):
-            if isinstance(m, Gauge) and m._fn is not None:
-                out[name] = m.get()
-            elif len(m.values) == 1 and () in m.values:
-                out[name] = m.values[()]
+            if isinstance(m, Histogram):
+                out[name] = m._dump(node)
+                continue
+            series = (m._series() if isinstance(m, Gauge)
+                      else sorted(m.values.items()))
+            if len(series) == 1 and series[0][0] == ():
+                out[name] = series[0][1]
             else:
                 out[name] = {
                     ",".join(f"{k}={v}" for k, v in key): val
-                    for key, val in sorted(m.values.items())
+                    for key, val in series
                     if self._visible(key, node)
                 }
         return out
@@ -135,16 +383,19 @@ class Registry:
         module-level), so a process hosting several Nodes — the multi-node
         example does — must filter each endpoint to its own node label or
         every /metrics answer reports every node's series."""
+        self._run_hooks()
         lines = []
         for name, m in sorted(self._metrics.items()):
             if m.help:
                 lines.append(f"# HELP {name} {m.help}")
             lines.append(f"# TYPE {name} {m._TYPE}")
-            if isinstance(m, Gauge) and m._fn is not None:
-                lines.append(f"{name} {m.get()}")
+            if isinstance(m, Histogram):
+                m._render(lines, node)
                 continue
+            series = (m._series() if isinstance(m, Gauge)
+                      else sorted(m.values.items()))
             emitted = False
-            for key, val in sorted(m.values.items()):
+            for key, val in series:
                 if not self._visible(key, node):
                     continue
                 emitted = True
@@ -158,7 +409,12 @@ class Registry:
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
-        self._metrics.clear()
+        """Zero every metric IN PLACE — metric objects stay registered.
+        Clearing the registration map instead (the old behavior) orphaned
+        every module-level metric handle created at import: their later
+        ``inc()``s mutated objects no endpoint could see, forever."""
+        for m in self._metrics.values():
+            m.values.clear()
 
 
 REGISTRY = Registry()
@@ -169,16 +425,20 @@ class MetricsServer:
 
     Routes: ``/metrics`` (Prometheus text), ``/state`` (JSON from the
     supplied callback — the engine's per-group leader/term/commit view,
-    replacing the reference's per-tick debug file), ``/healthz``.
+    replacing the reference's per-tick debug file), ``/events`` (the
+    consensus flight-recorder journal from ``events_fn``; supports
+    ``?limit=N``, ``?kind=K``, ``?group=G`` filters), ``/healthz``.
     """
 
     def __init__(self, host: str, port: int,
                  state_fn: Callable[[], dict] | None = None,
                  registry: Registry | None = None,
-                 node: int | None = None):
+                 node: int | None = None,
+                 events_fn: Callable[[], list] | None = None):
         self.host = host
         self.port = port
         self.state_fn = state_fn
+        self.events_fn = events_fn
         self.registry = registry or REGISTRY
         # Scope the exposition to this node's series (multi-node-per-process
         # deployments share the module-global registry).
@@ -197,11 +457,38 @@ class MetricsServer:
             self._server.close()
             await self._server.wait_closed()
 
+    def _events_body(self, query: str) -> bytes:
+        from josefine_tpu.utils.flight import filter_events
+
+        events = list(self.events_fn()) if self.events_fn else []
+        params = {}
+        for part in query.split("&"):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                params[k] = v
+        def _int(v):
+            # Malformed numeric params (e.g. group=--5) ignore the filter
+            # instead of unwinding through _serve with no response bytes.
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return None
+
+        limit = _int(params.get("limit"))
+        events = filter_events(
+            events,
+            kind=params.get("kind") or None,
+            group=_int(params.get("group")),
+            limit=limit if limit is not None and limit >= 0 else None,
+        )
+        return json.dumps({"node": self.node, "events": events}).encode()
+
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             req = await asyncio.wait_for(reader.readline(), 5)
             parts = req.decode("latin1").split()
             path = parts[1] if len(parts) >= 2 else "/"
+            path, _, query = path.partition("?")
             while True:  # drain headers
                 line = await asyncio.wait_for(reader.readline(), 5)
                 if line in (b"\r\n", b"\n", b""):
@@ -213,6 +500,10 @@ class MetricsServer:
             elif path == "/state":
                 state = self.state_fn() if self.state_fn else {}
                 body = json.dumps(state).encode()
+                ctype = "application/json"
+                status = "200 OK"
+            elif path == "/events":
+                body = self._events_body(query)
                 ctype = "application/json"
                 status = "200 OK"
             elif path == "/healthz":

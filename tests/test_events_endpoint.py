@@ -1,0 +1,185 @@
+"""The /events exposition route + node-scoped exposition over real HTTP.
+
+Covers the MetricsServer side of the flight recorder: the journal is
+served as JSON with filter/limit query params, each endpoint serves only
+its own node's journal and histogram series, and the full Node wiring
+exposes /events alongside /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from josefine_tpu.utils.flight import FlightRecorder
+from josefine_tpu.utils.metrics import MetricsServer, Registry
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return head.decode("latin1").split("\r\n")[0], body
+
+
+def test_events_endpoint_serves_filtered_journal():
+    async def main():
+        fr = FlightRecorder()
+        fr.emit(3, "election_won", group=0, term=1, leader=1)
+        fr.emit(5, "term_bump", group=1, term=2)
+        fr.emit(9, "election_won", group=1, term=2, leader=2)
+        srv = MetricsServer("127.0.0.1", 0, registry=Registry(), node=1,
+                            events_fn=fr.events)
+        port = await srv.start()
+        try:
+            status, body = await _get(port, "/events")
+            assert status.endswith("200 OK")
+            payload = json.loads(body)
+            assert payload["node"] == 1
+            assert [e["kind"] for e in payload["events"]] == [
+                "election_won", "term_bump", "election_won"]
+
+            _, body = await _get(port, "/events?kind=election_won")
+            assert [e["tick"] for e in json.loads(body)["events"]] == [3, 9]
+
+            _, body = await _get(port, "/events?group=1")
+            assert [e["tick"] for e in json.loads(body)["events"]] == [5, 9]
+
+            _, body = await _get(port, "/events?limit=1")
+            assert [e["tick"] for e in json.loads(body)["events"]] == [9]
+
+            _, body = await _get(port, "/events?kind=election_won&limit=1")
+            assert [e["tick"] for e in json.loads(body)["events"]] == [9]
+
+            # limit=0 means "no events", not "everything" (-0 slice trap).
+            _, body = await _get(port, "/events?limit=0")
+            assert json.loads(body)["events"] == []
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_events_endpoint_without_fn_is_empty():
+    async def main():
+        srv = MetricsServer("127.0.0.1", 0, registry=Registry(), node=7)
+        port = await srv.start()
+        try:
+            status, body = await _get(port, "/events")
+            assert status.endswith("200 OK")
+            assert json.loads(body) == {"node": 7, "events": []}
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_events_are_node_scoped_by_construction():
+    """Two nodes in one process: each endpoint serves its own engine's
+    journal (the events_fn is per-server, unlike the shared registry)."""
+
+    async def main():
+        reg = Registry()
+        fr1, fr2 = FlightRecorder(), FlightRecorder()
+        fr1.emit(1, "election_won", group=0, leader=1)
+        fr2.emit(2, "leadership_lost", group=0, leader=1)
+        srv1 = MetricsServer("127.0.0.1", 0, registry=reg, node=1,
+                             events_fn=fr1.events)
+        srv2 = MetricsServer("127.0.0.1", 0, registry=reg, node=2,
+                             events_fn=fr2.events)
+        p1, p2 = await srv1.start(), await srv2.start()
+        try:
+            _, b1 = await _get(p1, "/events")
+            _, b2 = await _get(p2, "/events")
+            assert [e["kind"] for e in json.loads(b1)["events"]] == [
+                "election_won"]
+            assert [e["kind"] for e in json.loads(b2)["events"]] == [
+                "leadership_lost"]
+        finally:
+            await srv1.stop()
+            await srv2.stop()
+
+    asyncio.run(main())
+
+
+def test_histogram_exposition_is_node_scoped_over_http():
+    async def main():
+        reg = Registry()
+        h = reg.histogram("rpc_ticks", "latency")
+        h.observe(3, node=1)
+        h.observe(300, node=2)
+        srv1 = MetricsServer("127.0.0.1", 0, registry=reg, node=1)
+        srv2 = MetricsServer("127.0.0.1", 0, registry=reg, node=2)
+        p1, p2 = await srv1.start(), await srv2.start()
+        try:
+            _, b1 = await _get(p1, "/metrics")
+            _, b2 = await _get(p2, "/metrics")
+            assert b'rpc_ticks_bucket{node="1",le="4"} 1' in b1
+            assert b'node="2"' not in b1
+            assert b'rpc_ticks_count{node="2"} 1' in b2
+            assert b'node="1"' not in b2
+            # Unscoped server reports both series.
+            srv = MetricsServer("127.0.0.1", 0, registry=reg)
+            p = await srv.start()
+            try:
+                _, ball = await _get(p, "/metrics")
+                assert b'node="1"' in ball and b'node="2"' in ball
+            finally:
+                await srv.stop()
+        finally:
+            await srv1.stop()
+            await srv2.stop()
+
+    asyncio.run(main())
+
+
+def test_node_exposes_events_endpoint(tmp_path):
+    """Full node: /events answers with the engine's real journal (the
+    metrics_port wiring passes the engine's flight recorder through)."""
+    from josefine_tpu.config import JosefineConfig
+
+    async def main():
+        cfg = JosefineConfig()
+        cfg.raft.id = 1
+        cfg.raft.port = 7871
+        cfg.raft.tick_ms = 20
+        cfg.broker.id = 1
+        cfg.broker.port = 7872
+        cfg.broker.metrics_port = 7873
+        cfg.broker.state_file = str(tmp_path / "state")
+        cfg.broker.data_directory = str(tmp_path / "data")
+
+        from josefine_tpu.node import Node
+        node = Node(cfg, in_memory=True)
+        await node.start()
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if node.raft.engine.is_leader(0):
+                    break
+            # The election just won must be in the journal...
+            status, body = await _get(7873, "/events?kind=election_won")
+            assert status.endswith("200 OK")
+            events = json.loads(body)["events"]
+            assert events and events[0]["group"] == 0
+            # ...and the histogram + telemetry gauges on /metrics.
+            status, body = await _get(7873, "/metrics")
+            text = body.decode()
+            assert "raft_commit_latency_ticks_bucket" in text
+            assert 'raft_flight_events_total{node="1"}' in text
+            assert 'raft_inbox_backlog{node="1"}' in text
+        finally:
+            await node.stop()
+
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

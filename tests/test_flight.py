@@ -1,0 +1,204 @@
+"""Consensus flight recorder: emits, determinism, and the violation artifact.
+
+The recorder is a TPU-build addition (the reference's only history is a
+debug file rewritten in place every tick — SURVEY.md quirk 7), so these
+tests define the contract:
+
+* the ring is bounded and the JSONL dump is byte-stable;
+* the engine journals its real transitions (election, term bump, group
+  lifecycle, scheduler mode flips);
+* two same-seed chaos runs produce BYTE-IDENTICAL per-node journals
+  (the flight-recorder half of the chaos determinism contract);
+* an invariant violation auto-dumps journals + registry to a JSON artifact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+
+from josefine_tpu.chaos.nemesis import Schedule, Step
+from josefine_tpu.chaos.soak import run_soak
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.flight import FlightRecorder
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class _Fsm:
+    def transition(self, data: bytes) -> bytes:
+        return b"ok"
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_ring_is_bounded_and_seq_is_monotone():
+    fr = FlightRecorder(capacity=8)
+    for i in range(20):
+        fr.emit(i, "k", group=i)
+    assert len(fr) == 8
+    assert fr.seq == 20
+    evs = fr.events()
+    assert [e["group"] for e in evs] == list(range(12, 20))
+    assert [e["seq"] for e in evs] == list(range(12, 20))
+
+
+def test_filters_and_tail():
+    fr = FlightRecorder()
+    fr.emit(1, "a", group=0)
+    fr.emit(2, "b", group=1)
+    fr.emit(3, "a", group=1)
+    assert [e["tick"] for e in fr.events(kind="a")] == [1, 3]
+    assert [e["tick"] for e in fr.events(group=1)] == [2, 3]
+    assert [e["tick"] for e in fr.events(group=1, kind="a")] == [3]
+    assert [e["tick"] for e in fr.tail(2)] == [2, 3]
+    assert fr.events(limit=0) == []  # -0 slice trap
+    # events() returns copies — mutating a result must not pollute the ring.
+    fr.events()[0]["kind"] = "mutated"
+    assert fr.events()[0]["kind"] == "a"
+
+
+def test_jsonl_dump_is_byte_stable():
+    a, b = FlightRecorder(), FlightRecorder()
+    for fr in (a, b):
+        fr.emit(5, "election_won", group=2, term=3, leader=1, extra=7)
+        fr.emit(6, "term_bump", group=2, term=4, prev_term=3)
+    assert a.dump_jsonl() == b.dump_jsonl()
+    lines = a.dump_jsonl().splitlines()
+    assert len(lines) == 2
+    ev = json.loads(lines[0])
+    assert ev["kind"] == "election_won" and ev["detail"] == {"extra": 7}
+
+
+# ---------------------------------------------------------- engine emits
+
+
+def test_engine_journals_election_and_term():
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=2, params=PARAMS,
+                       fsms={0: _Fsm(), 1: _Fsm()})
+        for _ in range(15):
+            e.tick()
+        kinds = [ev["kind"] for ev in e.flight.events()]
+        # Single-member groups elect themselves: one election_won and one
+        # term_bump per group.
+        assert kinds.count("election_won") == 2
+        assert kinds.count("term_bump") == 2
+        won = e.flight.events(kind="election_won")
+        assert {ev["group"] for ev in won} == {0, 1}
+        assert all(ev["leader"] == 0 for ev in won)  # slot, not node id
+        assert all(ev["term"] >= 1 for ev in won)
+        # Tick-indexed, monotone, no wall clock anywhere.
+        ticks = [ev["tick"] for ev in e.flight.events()]
+        assert ticks == sorted(ticks)
+
+    asyncio.run(main())
+
+
+def test_engine_journals_group_lifecycle():
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=3, params=PARAMS)
+        for _ in range(12):
+            e.tick()
+        e.recycle_group(2)
+        kinds = [ev["kind"] for ev in e.flight.events(group=2)]
+        assert "group_reset" in kinds and "group_recycled" in kinds
+        reset = e.flight.events(group=2, kind="group_reset")[0]
+        assert reset["detail"]["parole"] == 0  # recycling never paroles
+
+    asyncio.run(main())
+
+
+def test_engine_journals_active_mode_flip():
+    async def main():
+        # Cold start is an election storm (every row wakes -> dense
+        # fallback); after leaders settle under hb_ticks=4 the scheduler
+        # flips to the compacted path — the flip must be journaled.
+        e = RaftEngine(MemKV(), [1], 1, groups=8,
+                       params=step_params(timeout_min=3, timeout_max=8,
+                                          hb_ticks=4),
+                       active_set=True)
+        for _ in range(30):
+            e.tick()
+        flips = e.flight.events(kind="active_mode_flip")
+        assert flips, [ev["kind"] for ev in e.flight.events()]
+        assert flips[-1]["detail"]["to_mode"] in ("compacted",
+                                                  "dense_fallback")
+
+    asyncio.run(main())
+
+
+# ------------------------------------------- chaos determinism + artifact
+
+SHORT = Schedule(
+    "flight-short",
+    [
+        Step(at=20, op="isolate", args={"target": "leader", "for": 15}),
+        Step(at=45, op="crash", args={"node": 1, "for": 12}),
+    ],
+    horizon=60,
+    heal_ticks=60,
+)
+
+
+def test_same_seed_runs_journal_identically():
+    a = run_soak(99, SHORT)
+    b = run_soak(99, SHORT)
+    assert a["invariants"] == "ok", a["violation"]
+    # Byte-identical per-node journals — the acceptance bar. The crash at
+    # tick 45 forces a restart, so the archive/carry-over path is on it.
+    assert a["journals"] == b["journals"]
+    assert set(a["journals"]) == {"0", "1", "2"}
+    total = sum(len(j.splitlines()) for j in a["journals"].values())
+    assert total > 0  # the run actually journaled transitions
+    # Journals are valid JSONL with the event schema.
+    for jl in a["journals"].values():
+        for line in jl.splitlines():
+            ev = json.loads(line)
+            assert {"seq", "tick", "kind", "group"} <= set(ev)
+
+
+def test_invariant_violation_dumps_artifact(tmp_path, monkeypatch):
+    from josefine_tpu.chaos import harness, invariants
+
+    calls = {"n": 0}
+    real = invariants.check_log_matching
+
+    def tripping(logs):
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise invariants.InvariantViolation("injected for artifact test")
+        return real(logs)
+
+    monkeypatch.setattr(harness.invariants, "check_log_matching", tripping)
+    art = tmp_path / "artifact.json"
+    res = run_soak(7, SHORT, artifact_path=str(art))
+    assert res["invariants"] == "VIOLATED"
+    assert res["artifact"] == str(art)
+    assert art.exists()
+    data = json.loads(art.read_text())
+    assert data["violation"] == "injected for artifact test"
+    assert set(data["journals"]) == {"0", "1", "2"}
+    # The registry dump rode along (counters + the latency histogram).
+    assert "raft_ticks_total" in data["registry"]
+    assert "raft_commit_latency_ticks" in data["registry"]
+    assert data["event_log"]  # the nemesis side of the story
+
+
+def test_no_artifact_on_clean_run(tmp_path):
+    art = tmp_path / "never.json"
+    res = run_soak(99, SHORT, artifact_path=str(art))
+    assert res["invariants"] == "ok"
+    assert res["artifact"] is None
+    assert not art.exists()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

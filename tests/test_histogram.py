@@ -1,0 +1,249 @@
+"""Histogram metric type + the two registry fixes + the engine latency axis.
+
+TPU-build additions (the reference has no metrics subsystem), so the tests
+define the contract:
+
+* power-of-two buckets, Prometheus ``_bucket``/``_sum``/``_count``
+  exposition, interpolated quantiles, label/node scoping;
+* ``Registry.reset()`` preserves metric objects (regression: module-import
+  metric handles were orphaned forever — their ``inc()``s invisible);
+* ``Gauge.set_fn`` callbacks are per-label-set and go through the node
+  filter (regression: every endpoint reported one node's callback value);
+* the engine's product-path ``raft_commit_latency_ticks`` histogram.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.metrics import REGISTRY, Gauge, Histogram, Registry
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class _Fsm:
+    def transition(self, data: bytes) -> bytes:
+        return b"ok"
+
+
+# ------------------------------------------------------------- histogram
+
+
+def test_histogram_buckets_sum_count():
+    reg = Registry()
+    h = Histogram("lat_ticks", "latency", reg)
+    for v in (1, 1, 2, 3, 3, 3, 9):
+        h.observe(v)
+    s = h.values[()]
+    assert s.count == 7
+    assert s.total == 22
+    # Bucket upper bounds 1, 2, 4, 8, 16, ...: 1s -> le1, 2 -> le2,
+    # 3s -> le4, 9 -> le16.
+    assert s.buckets[0] == 2 and s.buckets[1] == 1
+    assert s.buckets[2] == 3 and s.buckets[4] == 1
+    text = reg.render_prometheus()
+    assert "# TYPE lat_ticks histogram" in text
+    assert 'lat_ticks_bucket{le="1"} 2' in text
+    assert 'lat_ticks_bucket{le="2"} 3' in text       # cumulative
+    assert 'lat_ticks_bucket{le="4"} 6' in text
+    assert 'lat_ticks_bucket{le="+Inf"} 7' in text
+    assert "lat_ticks_sum 22" in text
+    assert "lat_ticks_count 7" in text
+
+
+def test_histogram_overflow_goes_to_inf():
+    reg = Registry()
+    h = Histogram("x", "", reg, levels=4)  # finite bounds 1, 2, 4, 8
+    h.observe(9)
+    h.observe(1 << 40)
+    s = h.values[()]
+    assert s.inf == 2 and sum(s.buckets) == 0
+    assert 'x_bucket{le="+Inf"} 2' in reg.render_prometheus()
+
+
+def test_histogram_quantiles_interpolate():
+    reg = Registry()
+    h = Histogram("q", "", reg)
+    for _ in range(100):
+        h.observe(3)  # all in bucket (2, 4]
+    p50 = h.quantile(0.5)
+    assert 2.0 < p50 <= 4.0
+    assert h.quantile(0.99) <= 4.0
+    assert h.quantile(0.5, missing="label") == 0.0  # unknown series
+    assert Histogram("empty", "", reg).quantile(0.5) == 0.0
+
+
+def test_histogram_label_scoping_and_aggregate():
+    reg = Registry()
+    h = Histogram("l", "", reg)
+    for _ in range(10):
+        h.observe(2, node=1)
+    for _ in range(10):
+        h.observe(32, node=2)
+    # Node-scoped exposition: each node sees only its own series.
+    t1 = reg.render_prometheus(node=1)
+    assert 'l_bucket{node="1",le="2"} 10' in t1
+    assert 'node="2"' not in t1
+    t2 = reg.render_prometheus(node=2)
+    assert 'node="1"' not in t2 and 'l_count{node="2"} 10' in t2
+    # Per-series vs aggregate quantiles.
+    assert h.quantile(0.9, node=1) <= 2.0
+    assert h.quantile(0.9, node=2) > 16.0
+    agg = h.quantile(0.5)  # no labels: bucket-wise sum of all series
+    assert 1.0 < agg <= 32.0
+    assert h.count() == 20 and h.count(node=1) == 10
+    assert h.summary(node=1)["n"] == 10
+
+
+def test_histogram_bind_and_registry_get_or_create():
+    reg = Registry()
+    h = reg.histogram("b", "help")
+    assert reg.histogram("b") is h
+    b = h.bind(node=3)
+    b.observe(5)
+    b.observe(6)
+    assert h.count(node=3) == 2
+    with pytest.raises(ValueError):
+        reg.counter("c"), reg.histogram("c")
+
+
+def test_histogram_dump():
+    reg = Registry()
+    h = Histogram("d", "", reg)
+    h.observe(3, node=1)
+    d = reg.dump()["d"]
+    assert d["node=1"]["count"] == 1
+    assert d["node=1"]["buckets"] == {"4": 1}
+
+
+# --------------------------------------------------- registry reset fix
+
+
+def test_reset_preserves_module_level_metric_handles():
+    """Regression: reset() used to clear the registration map, orphaning
+    every metric object created at module import — their later inc()s
+    mutated objects no endpoint could ever see again."""
+    reg = Registry()
+    c = reg.counter("orphan_total", "t")
+    c.inc(5)
+    reg.reset()
+    assert c.get() == 0                       # zeroed...
+    assert reg.counter("orphan_total") is c   # ...but still registered
+    c.inc(3)                                  # the old handle still counts
+    assert "orphan_total 3" in reg.render_prometheus()
+    assert reg.dump()["orphan_total"] == 3
+
+
+def test_reset_zeroes_gauges_and_histograms_in_place():
+    reg = Registry()
+    g = reg.gauge("g")
+    g.set(7, node=1)
+    h = reg.histogram("h")
+    h.observe(3)
+    reg.reset()
+    assert g.get(node=1) == 0
+    assert h.count() == 0
+    g.set(2, node=1)
+    h.observe(1)
+    text = reg.render_prometheus()
+    assert 'g{node="1"} 2' in text and "h_count 1" in text
+
+
+# ------------------------------------------------- set_fn scoping fix
+
+
+def test_callback_gauges_respect_node_scope():
+    """Regression: callback gauges bypassed the node filter — in a
+    multi-node process every /metrics endpoint reported one node's
+    callback value."""
+    reg = Registry()
+    g = Gauge("cb", "callback", reg)
+    g.set_fn(lambda: 11, node=1)
+    g.set_fn(lambda: 22, node=2)
+    t1 = reg.render_prometheus(node=1)
+    assert 'cb{node="1"} 11' in t1
+    assert 'node="2"' not in t1
+    t2 = reg.render_prometheus(node=2)
+    assert 'cb{node="2"} 22' in t2 and 'node="1"' not in t2
+    # Unscoped endpoint sees both; dump() filters the same way.
+    tall = reg.render_prometheus()
+    assert 'cb{node="1"} 11' in tall and 'cb{node="2"} 22' in tall
+    assert reg.dump(node=1)["cb"] == {"node=1": 11}
+    assert g.get(node=2) == 22
+
+
+def test_unlabelled_callback_gauge_stays_shared():
+    reg = Registry()
+    g = Gauge("shared_cb", "", reg)
+    g.set_fn(lambda: 42)
+    assert "shared_cb 42" in reg.render_prometheus(node=1)
+    assert "shared_cb 42" in reg.render_prometheus(node=2)
+    assert g.get() == 42
+
+
+def test_callback_beats_stored_value_on_same_key():
+    reg = Registry()
+    g = Gauge("mix", "", reg)
+    g.set(1, node=1)
+    g.set_fn(lambda: 9, node=1)
+    assert 'mix{node="1"} 9' in reg.render_prometheus(node=1)
+
+
+# --------------------------------------------- engine latency histogram
+
+
+def test_engine_records_commit_latency():
+    async def main():
+        hist = REGISTRY.histogram("raft_commit_latency_ticks")
+        e = RaftEngine(MemKV(), [41], 41, groups=1, params=PARAMS,
+                       fsms={0: _Fsm()})
+        before = hist.count(node=41)
+        futs = []
+        for i in range(15):
+            e.tick()
+            if e.is_leader(0):
+                futs.append(e.propose(0, b"p%d" % i))
+            await asyncio.sleep(0)
+        committed = sum(1 for f in futs if f.done() and not f.exception())
+        assert committed > 5
+        n = hist.count(node=41) - before
+        assert n == committed  # one observation per committed proposal
+        lat = e.commit_latency()
+        assert lat["n"] >= committed
+        # Single-member group: commit lands on the tick after submit.
+        assert 0 < lat["p99"] <= 2.0
+
+    asyncio.run(main())
+
+
+def test_engine_latency_not_observed_for_uncommitted(tmp_path):
+    """A reset purges the group's open latency entries — discarded blocks
+    must never be observed as committed."""
+
+    async def main():
+        hist = REGISTRY.histogram("raft_commit_latency_ticks")
+        e = RaftEngine(MemKV(), [43], 43, groups=2, params=PARAMS,
+                       fsms={0: _Fsm()})
+        for _ in range(10):
+            e.tick()
+        before = hist.count(node=43)
+        # Open an entry by hand, then recycle the row out from under it.
+        e._lat_open[1] = __import__("collections").deque([(123, 0)])
+        e.recycle_group(1)
+        assert 1 not in e._lat_open
+        for _ in range(5):
+            e.tick()
+        assert hist.count(node=43) == before
+
+    asyncio.run(main())
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

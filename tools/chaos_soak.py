@@ -57,6 +57,14 @@ def main() -> int:
                          "schedule is the only fault source")
     ap.add_argument("--events", default=None,
                     help="write the fault-event log (JSONL) here")
+    ap.add_argument("--journals", default=None,
+                    help="write the per-node flight-recorder journals "
+                         "(JSON of node -> JSONL) here")
+    ap.add_argument("--artifact", default=None,
+                    help="path for the auto-dumped repro artifact on an "
+                         "invariant violation (journals + registry dump + "
+                         "event log; default chaos_artifact_<sched>_<seed>"
+                         ".json in the working directory)")
     ap.add_argument("--dump-schedule", default=None,
                     help="write the resolved schedule DSL (JSON) here")
     ap.add_argument("--platform", default="cpu",
@@ -98,11 +106,14 @@ def main() -> int:
         window=args.window, horizon=args.horizon,
         net=NetFaults.quiet() if args.quiet_net else None,
         auto_faults=args.auto_faults, active_set=args.active_set,
-        hb_ticks=args.hb_ticks)
+        hb_ticks=args.hb_ticks, artifact_path=args.artifact)
 
     if args.events:
         with open(args.events, "w") as fh:
             fh.write(result["event_log"])
+    if args.journals:
+        with open(args.journals, "w") as fh:
+            json.dump(result["journals"], fh, indent=1)
     if args.dump_schedule:
         with open(args.dump_schedule, "w") as fh:
             fh.write(result["schedule_json"])
@@ -110,9 +121,18 @@ def main() -> int:
     summary = {k: result[k] for k in
                ("schedule", "seed", "nodes", "groups", "window",
                 "active_set", "ticks", "proposed", "acked", "fault_events",
-                "chaos_counters", "invariants", "violation")}
+                "chaos_counters", "invariants", "violation", "artifact")}
     if result.get("active_set_stats"):
         summary["active_set_stats"] = result["active_set_stats"]
+    # Observability epilogue: the full registry dump (counters, gauges,
+    # histograms — includes the commit-latency axis) and the tail of each
+    # node's flight journal, so a soak's summary line says what the
+    # consensus state DID, not just how much of it happened.
+    summary["registry_dump"] = result["registry_dump"]
+    summary["journal_tail"] = {
+        node: [json.loads(line) for line in jl.splitlines()[-8:]]
+        for node, jl in result["journals"].items()
+    }
     print(json.dumps(summary))
     return 0 if result["invariants"] == "ok" else 1
 

@@ -59,6 +59,15 @@ chaos_smoke_active_set() {
         --horizon 200 --active-set --groups 8 --hb-ticks 4
 }
 
+obs_smoke() {
+    # Observability end-to-end: boot an engine to an election + commits,
+    # start a MetricsServer, and assert over real HTTP that /metrics
+    # exposes the commit-latency histogram + scheduler gauges and /events
+    # carries the recorded election (tools/obs_smoke.py).
+    echo "== observability smoke =="
+    python tools/obs_smoke.py
+}
+
 perf_smoke() {
     # Host-bridge perf floor: bench_engine.py --profile at P=1k for a few
     # ticks on CPU; fail if ms/tick regresses >2x vs tools/perf_floor.json
@@ -75,6 +84,7 @@ if [[ "${1:-}" == "quick" ]]; then
     python -m pytest tests/test_chained_raft.py tests/test_engine.py \
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
+    obs_smoke
     perf_smoke
 else
     # Chunked to fit runner time limits; order mirrors the dependency
@@ -97,7 +107,8 @@ else
         tests/test_group_recycling.py tests/test_kafka_codec.py \
         tests/test_kafka_golden.py tests/test_kafka_fuzz.py \
         tests/test_log.py tests/test_durability.py \
-        tests/test_idempotent_produce.py tests/test_metrics.py -q
+        tests/test_idempotent_produce.py tests/test_metrics.py \
+        tests/test_histogram.py tests/test_events_endpoint.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
     # The active-set differential suite in its own chunk: the twin-cluster
@@ -105,9 +116,10 @@ else
     python -m pytest tests/test_active_set.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
-        tests/test_reset_safety.py -q
+        tests/test_flight.py tests/test_reset_safety.py -q
     chaos_smoke
     chaos_smoke_active_set
+    obs_smoke
     perf_smoke
 fi
 echo "CI OK"

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Record the commit-latency axis into BENCH_headline.json (VERDICT item 8).
+
+The headline artifact has always been throughput-only (accepted AE/s); this
+runs the host-bridge bench at the headline group count and lands p50/p99
+proposal→commit DEVICE ticks — sourced from the engines' own
+``raft_commit_latency_ticks`` histogram, the product metric — into the
+headline's ``extra.commit_latency_ticks``. Device ticks are the protocol's
+clock, so the axis is comparable across backends; the row records which
+device measured it.
+
+Usage:
+    python tools/headline_latency.py [--p 100000] [--ticks 20] [--warmup 30]
+        [--platform cpu] [--pipeline]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEADLINE = os.path.join(ROOT, "BENCH_headline.json")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--p", type=int, default=100000,
+                    help="group count (default: the 100k headline shape)")
+    ap.add_argument("--ticks", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=30)
+    ap.add_argument("--platform", default="cpu")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="also record the pipelined-mode latency row "
+                         "(+1 tick per hop)")
+    args = ap.parse_args()
+
+    out = os.path.join(tempfile.gettempdir(),
+                       "josefine_headline_lat_%d.json" % os.getpid())
+    cmd = [
+        sys.executable, os.path.join(ROOT, "bench_engine.py"),
+        "--platform", args.platform,
+        "--sizes", str(args.p),
+        "--ticks", str(args.ticks),
+        "--warmup", str(args.warmup),
+        "--out", out,
+    ]
+    if args.pipeline:
+        cmd.append("--pipeline")
+    env = dict(os.environ, JOSEFINE_BENCH_PLATFORM=args.platform)
+    subprocess.run(cmd, check=True, cwd=ROOT, env=env)
+    try:
+        with open(out) as f:
+            bench = json.load(f)
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    row = next(r for r in bench["results"] if r["P"] == args.p)
+    lat = row.get("extra", {}).get("commit_latency_ticks")
+    if not lat:
+        print("no commit-latency data in the bench row (no commits?)",
+              file=sys.stderr)
+        return 1
+
+    with open(HEADLINE) as f:
+        headline = json.load(f)
+    headline.setdefault("extra", {})["commit_latency_ticks"] = {
+        **lat,
+        "P": args.p,
+        "nodes": row["nodes"],
+        "window": row["window"],
+        "pipeline": row["pipeline"],
+        "proposals_per_tick": row["proposals_per_tick"],
+        "device": bench["device"],
+        "note": ("proposal->commit in device ticks from the engine's "
+                 "raft_commit_latency_ticks histogram (host-bridge bench; "
+                 "device ticks are backend-invariant, wall ms/tick is not)"),
+    }
+    with open(HEADLINE, "w") as f:
+        json.dump(headline, f)
+    print(json.dumps({"recorded": headline["extra"]["commit_latency_ticks"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -57,6 +57,18 @@ def run_seed(seed: int, keep_dir: pathlib.Path) -> bool:
         print(f"seed {seed}: FAIL {type(e).__name__}: {e}", flush=True)
         import traceback
         traceback.print_exc()
+        # Observability artifact alongside the preserved state dirs: the
+        # full metrics-registry dump (chaos_* fault counters, raft_*
+        # counters/gauges, the commit-latency histogram) at failure time.
+        try:
+            import json
+
+            from josefine_tpu.utils.metrics import REGISTRY
+
+            (tmp / "registry_dump.json").write_text(
+                json.dumps(REGISTRY.dump(), indent=1))
+        except Exception:
+            traceback.print_exc()
     finally:
         root.removeHandler(fh)
         fh.close()

@@ -37,6 +37,16 @@ def main():
         print(f"FAIL: {e}")
         import traceback
         traceback.print_exc()
+        # Metrics-registry dump next to the preserved state for forensics.
+        try:
+            import json
+
+            from josefine_tpu.utils.metrics import REGISTRY
+
+            (tmp / "registry_dump.json").write_text(
+                json.dumps(REGISTRY.dump(), indent=1))
+        except Exception:
+            traceback.print_exc()
     print(f"state: {tmp}, log: /tmp/reset_debug.log")
 
 
