@@ -41,6 +41,14 @@ the offered load an activity fraction — exactly round(F*P) distinct
 groups get one payload per tick (the dense-vs-active-set comparison
 axis; both knobs land in the row and the merge key, so dense and
 active-set rows of the same size coexist in BENCH_engine.json).
+
+--device-route joins the three engines to a RouteFabric: payload-free
+consensus rows (votes, heartbeats, responses — the steady-state
+majority) deliver device-resident, and the host decodes/encodes only
+payload-bearing traffic. Adds the ``route`` phase to the profile and
+``extra.device_route_stats`` (routed vs host-decoded message split) to
+the row; the flag joins the merge key so routed and host rows of one
+size coexist.
 """
 
 from __future__ import annotations
@@ -115,6 +123,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                     proposals_per_tick: int = PROPOSALS_PER_TICK,
                     active_set: bool = False,
                     active_frac: float | None = None,
+                    device_route: bool = False,
                     xprof: str | None = None) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
@@ -138,6 +147,13 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                    active_set=active_set)
         for i in range(N)
     ]
+    fabric = None
+    if device_route:
+        from josefine_tpu.raft.route import RouteFabric
+
+        fabric = RouteFabric()
+        for e in engines:
+            fabric.register(e)
     init_s = time.perf_counter() - t0
     if profile:
         for e in engines:
@@ -145,6 +161,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
 
     rng = np.random.default_rng(0)
     proposed = committed = 0
+    host_entries = 0  # per-entry host-decoded wire traffic (batch = many)
 
     executed = [0] * N  # device ticks actually run per engine
     # Commit-latency axis: the engines' own raft_commit_latency_ticks
@@ -184,8 +201,12 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                 res = e.tick_finish(h)
                 outbound.extend(res.outbound)
                 committed += len(res.committed)
+        nonlocal host_entries
         for m in outbound:
+            host_entries += len(m) if hasattr(m, "__len__") else 1
             engines[m.dst].receive(m)
+        if fabric is not None:
+            fabric.flush()  # the delivery barrier: routed rows land with host ones
         if live:
             if active_frac is not None:
                 groups = rng.permutation(P)[:proposals_per_tick]
@@ -208,7 +229,10 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     leaders = sum(int((e._h_role == 2).sum()) for e in engines)
 
     proposed = committed = 0
+    host_entries = 0
     executed = [0] * N
+    for e in engines:
+        e.routed_msgs = 0  # timed-loop routed count only
     # Measure the timed loop only: drop the warmup's latency observations
     # (the registry is process-global, so this also clears any previous
     # size's series in a multi-size run) AND the engines' open entries for
@@ -236,6 +260,8 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         for _ in range(ticks):
             one_tick(live=True)
     dt = time.perf_counter() - t0
+    routed_snap = sum(e.routed_msgs for e in engines)
+    host_snap = host_entries
     sched_snap = [(e.active_sched_ticks, e.active_sched_rows,
                    e.active_fallback_ticks) for e in engines]
     # Windows each dispatch ACTUALLY executed during the timed loop
@@ -272,6 +298,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         "nodes": N,
         "active_set": active_set,
         "active_frac": active_frac,
+        "device_route": device_route,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
         "ticks": dev_ticks,
@@ -288,6 +315,22 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         "proposals_per_sec": round(proposed / dt, 1),
     }
     extra = {}
+    if pipeline and jax.default_backend() == "cpu":
+        # PR 2's honesty note, machine-readable: XLA:CPU blocks dispatch
+        # under outstanding programs, so the pipelined overlap buys
+        # nothing here — do not quote pipelined CPU rows as wins.
+        extra["pipeline_cpu_caveat"] = (
+            "pipelined mode measured SLOWER than split-phase on XLA:CPU "
+            "(dispatch does not overlap); re-measure on an accelerator")
+    if device_route:
+        # Timed-loop delivery split: device-routed rows vs host-decoded
+        # entries (batches counted per entry, symmetric with _m_out).
+        total = routed_snap + host_snap
+        extra["device_route_stats"] = {
+            "routed_msgs": routed_snap,
+            "host_msgs": host_snap,
+            "routed_frac": round(routed_snap / total, 4) if total else 0.0,
+        }
     if active_set:
         # Measured scheduler behavior over the timed loop (cluster totals):
         # how often compaction actually ran, the realized active fraction
@@ -423,6 +466,10 @@ async def main():
                          "round(frac*P) distinct groups get one proposal "
                          "per tick (overrides --proposals; the dense-vs-"
                          "active-set comparison axis)")
+    ap.add_argument("--device-route", action="store_true",
+                    help="join the engines to a RouteFabric: payload-free "
+                         "consensus rows deliver device-resident; the host "
+                         "decodes only payload-bearing traffic")
     ap.add_argument("--xprof", default=None, metavar="DIR",
                     help="capture a jax.profiler trace (xplane) of the "
                          "timed loop into DIR — pairs a device profile "
@@ -451,6 +498,7 @@ async def main():
                                 proposals_per_tick=args.proposals,
                                 active_set=args.active_set,
                                 active_frac=args.active_frac,
+                                device_route=args.device_route,
                                 xprof=args.xprof)
         results.append(r)
         print(json.dumps(r))
@@ -493,12 +541,14 @@ async def main():
         # replaces them instead of leaving a stale twin row beside the
         # fresh one.
         # active_frac must sort against legacy rows' None — normalize to a
-        # float sentinel so mixed keys stay orderable.
+        # float sentinel so mixed keys stay orderable; device_route
+        # normalizes the same way (missing on legacy rows -> False).
         frac = r.get("active_frac")
         return (r["P"], r.get("window") or 1, bool(r.get("pipeline")),
                 r.get("proposals_per_tick", 256),
                 bool(r.get("active_set")),
-                -1.0 if frac is None else float(frac))
+                -1.0 if frac is None else float(frac),
+                bool(r.get("device_route")))
 
     merged = {_key(r): r for r in results}
     try:

@@ -235,6 +235,24 @@ class FaultPlane:
 
     # ------------------------------------------------------- message routing
 
+    def link_routable(self, src: int, dst: int) -> bool:
+        """Device-routing gate for the RouteFabric (harness.py): a link may
+        deliver device-resident ONLY while the plane has no say over its
+        messages — no block/partition between the endpoints, both up, the
+        receiver not pacer-skewed (its consume cadence would batch routed
+        ticks), and NO probabilistic noise armed at all (drop/dup/delay
+        fates are drawn per host-routed message; traffic that bypasses
+        :meth:`route` must not silently dodge them). Anything else forces
+        the traffic back through the host residual path, where the plane
+        applies its fates — the partition semantics the nemesis schedules
+        are stated against."""
+        n = self.net
+        if n.drop_p or n.dup_p or n.delay_p or n.reorder_p:
+            return False
+        return ((src, dst) not in self.blocked
+                and src not in self.crashed and dst not in self.crashed
+                and self.skew.get(dst, 1) <= 1)
+
     def route(self, src: int, dst: int, msg) -> list[tuple[int, object]]:
         """Decide one message's fate. Returns ``[(deliver_tick, msg), ...]``
         — empty for a drop, two entries for a duplicate; a ``deliver_tick``

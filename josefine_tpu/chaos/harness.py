@@ -119,6 +119,13 @@ class _PlaneDrivenCluster:
     def down(self) -> set[int]:
         return set(self.plane.crashed)
 
+    # Host-path delivery counter (per cluster, unlike the process-global
+    # metrics registry): every message actually handed to an engine's
+    # receive() — the complement of the fabric's routed count, so a soak
+    # summary's routed/host split stays correct across multiple runs in
+    # one process.
+    host_delivered = 0
+
     def _deliver_matured(self) -> None:
         """Deliver delayed messages whose virtual delivery tick arrived;
         traffic to a down or removed node is lost (as on a real network)."""
@@ -128,6 +135,7 @@ class _PlaneDrivenCluster:
                 e = self.engines[dst]
                 if e is not None and not self.plane.is_down(dst):
                     e.receive(m)
+                    self.host_delivered += 1
             else:
                 still.append((when, dst, m))
         self.delayed = still
@@ -141,6 +149,7 @@ class _PlaneDrivenCluster:
             for when, msg in self.plane.route(src, m.dst, m):
                 if when <= self.tick_no:
                     self.engines[msg.dst].receive(msg)
+                    self.host_delivered += 1
                 else:
                     self.delayed.append((when, msg.dst, msg))
 
@@ -179,7 +188,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                  plane: FaultPlane | None = None, net: NetFaults | None = None,
                  auto_crash: bool = True, auto_links: bool = True,
                  propose_rate: float = 0.15, max_proposals: int = 40,
-                 active_set: bool = False):
+                 active_set: bool = False, device_route: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -207,6 +216,18 @@ class ChaosCluster(_PlaneDrivenCluster):
         # size do not grow linearly with restart count.
         self.flight_archive = [deque(maxlen=_ARCHIVE_CAP)
                                for _ in range(n_nodes)]
+        # Device-resident delivery under chaos: the fabric's link gate IS
+        # the fault plane — a partitioned/crashed/noisy link refuses to
+        # route, so its traffic rides the host path where the plane applies
+        # its fates. With the default probabilistic noise the gate never
+        # opens (per-message fates must not be dodged); the pairing that
+        # exercises routing is a directive-only schedule + NetFaults.quiet
+        # (chaos_soak --device-route --quiet-net).
+        self.fabric = None
+        if device_route:
+            from josefine_tpu.raft.route import RouteFabric
+
+            self.fabric = RouteFabric(link_filter=self.plane.link_routable)
         self.engines = [self._make(i) for i in range(n_nodes)]
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.ledger = invariants.ElectionSafetyLedger()
@@ -229,6 +250,11 @@ class ChaosCluster(_PlaneDrivenCluster):
         )
         if self.k_out is not None:
             e._k_out = self.k_out
+        if self.fabric is not None:
+            # (Re-)register the slot: a restarted engine joins the fabric
+            # fresh — staged routed traffic for the dead incarnation is
+            # dropped, like the pending queues inside the dead process.
+            self.fabric.register(e)
         return e
 
     # ------------------------------------------------------ nemesis queries
@@ -293,6 +319,11 @@ class ChaosCluster(_PlaneDrivenCluster):
             e = self.engines[i]
             res = e.tick(window=e.suggest_window(self.window))
             self._route_outbound(i, res.outbound)
+            if self.fabric is not None:
+                # This harness delivers immediately per engine, so the
+                # fabric's barrier sits at the same point — routed and
+                # host-path traffic stay same-tick consumable.
+                self.fabric.flush()
 
         self.check_election_safety()
         if self.tick_no % 10 == 0:
@@ -328,11 +359,18 @@ class ChaosCluster(_PlaneDrivenCluster):
             self.plane.advance(1)
             for _, dst, m in self.delayed:
                 self.engines[dst].receive(m)
+                self.host_delivered += 1
             self.delayed = []
             for e in self.engines:
                 res = e.tick(window=e.suggest_window(self.window))
                 for m in res.outbound:
                     self.engines[m.dst].receive(m)
+                    # Per-ENTRY, like the chaotic phase (there messages
+                    # arrive pre-expanded): a columnar MsgBatch is many.
+                    self.host_delivered += (len(m) if hasattr(m, "__len__")
+                                            else 1)
+                if self.fabric is not None:
+                    self.fabric.flush()
             self.check_election_safety()
 
     def assert_converged_and_linearizable(self):

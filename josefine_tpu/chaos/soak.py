@@ -40,6 +40,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          horizon: int | None = None,
                          active_set: bool = False,
                          hb_ticks: int | None = None,
+                         device_route: bool = False,
                          artifact_path: str | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
@@ -53,6 +54,14 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     compacted gather/step/scatter/decay path the flag asks for (the
     summary's active_set_stats shows which path actually ran).
 
+    ``device_route`` joins the engines to a RouteFabric gated on the
+    fault plane: clean links deliver payload-free rows device-resident;
+    partitioned/crashed/skewed links — and ALL links while probabilistic
+    noise is armed — fall back to the host path, where the plane applies
+    its fates. Pair it with ``net=NetFaults.quiet()`` so a directive
+    schedule (partitions) is the only fault source and routing actually
+    runs (the summary's device_route_stats shows the split).
+
     On an invariant violation the run auto-dumps a JSON repro artifact —
     the per-node flight-recorder journals, the metrics-registry dump, the
     fault-event log, and the violation — to ``artifact_path`` (default
@@ -65,7 +74,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
-                           active_set=active_set)
+                           active_set=active_set, device_route=device_route)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -118,6 +127,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         "groups": groups,
         "window": window,
         "active_set": active_set,
+        "device_route": device_route,
         "ticks": cluster.tick_no,
         "proposed": cluster.proposed,
         "acked": acked_total,
@@ -133,6 +143,15 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
             "fallback_ticks": sum(e.active_fallback_ticks
                                   for e in cluster.engines),
         } if active_set else None,
+        # Delivery split under chaos: routed device-resident vs host-path
+        # residual (partitions/noise force the latter — a run whose routed
+        # count is zero routed nothing, e.g. default probabilistic noise).
+        # Both counts are per-CLUSTER (the metrics registry is
+        # process-global and would accumulate across soaks in one process).
+        "device_route_stats": {
+            "routed_msgs": sum(e.routed_msgs for e in cluster.engines),
+            "host_msgs": cluster.host_delivered,
+        } if device_route else None,
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "artifact": artifact,

@@ -19,9 +19,14 @@ owns everything variable-length and durable around it:
   (reference ``src/raft/tcp.rs``).
 * :mod:`josefine_tpu.raft.client` — in-process propose() handle
   (reference ``src/raft/client.rs``).
+* :mod:`josefine_tpu.raft.route` — device-resident intra-chip delivery
+  between co-located engines (no reference analog: messages there always
+  serialize through the event loop; see ARCHITECTURE.md "Device-resident
+  delivery").
 """
 
 from josefine_tpu.raft.chain import Block, Chain
 from josefine_tpu.raft.fsm import Fsm, Driver
+from josefine_tpu.raft.route import RouteFabric
 
-__all__ = ["Block", "Chain", "Fsm", "Driver"]
+__all__ = ["Block", "Chain", "Fsm", "Driver", "RouteFabric"]

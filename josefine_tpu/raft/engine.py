@@ -41,7 +41,6 @@ lives on this class:
 from __future__ import annotations
 
 import asyncio
-import functools
 import time
 from collections import deque
 
@@ -72,9 +71,12 @@ from josefine_tpu.raft.membership import ConfChange, MemberTable, is_conf
 from josefine_tpu.raft.packed_step import (
     _MIRROR13_ROWS,
     _active_window_fn,
+    _active_window_routed_fn,
     _decay_only_fn,
     _decay_scatter_fn,
     _gather_active,
+    _gather_routed,
+    _merge_routed,
     _node_view,
     _packed_over_groups,
     _py_active_window,
@@ -84,7 +86,9 @@ from josefine_tpu.raft.packed_step import (
     _py_packed_window,
     _py_sparse_window,
     _sparse_window_fn,
+    _sparse_window_routed_fn,
     _window_step_fn,
+    _window_step_routed_fn,
     active_bucket,
     host_wake_mask,
 )
@@ -109,6 +113,12 @@ _m_led = REGISTRY.gauge("raft_groups_led", "Groups this node currently leads")
 _m_backlog_dropped = REGISTRY.counter(
     "raft_batch_backlog_dropped_total",
     "Consensus batch entries dropped by the per-src intake backlog cap")
+_m_routed = REGISTRY.counter(
+    "raft_msgs_routed_total",
+    "Consensus messages delivered device-resident via the RouteFabric "
+    "(never host-decoded). raft_msgs_out_total covers only host-path "
+    "sends; raft_msgs_in_total counts everything accepted into the inbox "
+    "— routed entries included, credited at the fabric flush")
 # Proposal→commit latency in DEVICE ticks (the protocol's clock), observed
 # leader-side when commit advancement covers a block this node minted —
 # the product-path promotion of bench_engine's old future-polling timing
@@ -165,6 +175,9 @@ _CONSENSUS_KINDS = np.asarray(sorted(_CONSENSUS_KIND_SET), np.int32)
 
 class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
     """Device-backed consensus engine for one node across P groups."""
+
+    # Process-wide one-shot flag for the pipelined-on-CPU caveat warning.
+    _pipeline_cpu_warned = False
 
     def __init__(
         self,
@@ -532,6 +545,21 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # context manager, so the disabled hot path costs two C calls per
         # phase; enable_profiling() swaps in a recording instance.
         self.profiler = NULL_PROFILER
+        # Device-resident delivery (raft/route.py): a RouteFabric attaches
+        # itself here via register(); None = every message rides the host
+        # decode/encode path. Per-tick routed state lives between a
+        # consume (tick_begin) and the dispatch it merges into:
+        # _routed_plane is the device (9, P, N) inbox plane, _routed_kinds
+        # its host (P, N) kind mirror (occupancy / wake / stamps).
+        # _route_dirty tells peers this engine deferred inbox claims at
+        # its last begin — routing toward it would invert the
+        # deferred-beats-new slot precedence, so they hold off one tick.
+        self._fabric = None
+        self._route_dirty = False
+        self._routed_plane = None
+        self._routed_kinds: np.ndarray | None = None
+        self.routed_msgs = 0
+        self._c_routed = _m_routed.bind(node=self.self_id)
         # Pipelined-tick state: the in-flight tick handle (tick_pipelined's
         # double buffer), the dispatch-in-flight flag (True from tick_begin
         # until the tick's device fetch materializes), and host-side
@@ -895,6 +923,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         if self._prop_groups:
             wake[np.fromiter(self._prop_groups, np.intp,
                              len(self._prop_groups))] = True
+        if self._routed_kinds is not None:
+            # Device-routed inbox rows: pending IO exactly like a host
+            # message, just resident on device already.
+            wake |= self._routed_kinds.any(axis=1)
         if self._force_active:
             fa = [g for g in self._force_active if 0 <= g < self.P]
             if fa:
@@ -918,7 +950,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         A = len(G)
         if A == 0:
             # All-quiescent tick: decay IS the device step; nothing to
-            # gather, step, or fetch.
+            # gather, step, or fetch. (Routed rows are forced awake by the
+            # scheduler, so a pending routed plane implies A > 0.)
             with prof.phase("dispatch"):
                 if self._backend == "python":
                     new_state = cr.decay_idle(
@@ -930,10 +963,17 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             return new_state, None, 0, 0
         idx = np.full(k, self.P, np.int32)
         idx[:A] = G
+        rp = self._routed_plane
         if self._backend == "python":
             with prof.phase("compact"):
                 state_c, member_c = _py_gather_active(
                     self.state, self.member, idx)
+            if rp is not None:
+                # Scalar twin: the plane is numpy — merge host-side so the
+                # py window stays signature-identical to the jax kernel's
+                # compact contract.
+                vals = _merge_routed(
+                    np, vals, _gather_routed(np, np.asarray(rp), idx))
             with prof.phase("dispatch"):
                 new_rows, flat = _py_active_window(
                     self.params, member_c, self._me_dev, state_c, vals, pf,
@@ -948,9 +988,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 state_c, member_c = _gather_active(
                     self.state, self.member, idx_dev)
             with prof.phase("dispatch"):
-                new_rows, flat = _active_window_fn(window)(
-                    self.params, member_c, self._me_dev, state_c,
-                    jnp.asarray(vals), pf_dev)
+                if rp is not None:
+                    new_rows, flat = _active_window_routed_fn(window)(
+                        self.params, member_c, self._me_dev, state_c,
+                        jnp.asarray(vals), rp, idx_dev, pf_dev)
+                else:
+                    new_rows, flat = _active_window_fn(window)(
+                        self.params, member_c, self._me_dev, state_c,
+                        jnp.asarray(vals), pf_dev)
             with prof.phase("scatter"):
                 new_state = _decay_scatter_fn(window)(
                     self.params, self.state, pf_dev, idx_dev, new_rows)
@@ -1032,6 +1077,18 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # Last tick's AE-cap send-pointer re-roots, as one scatter just
             # before the step reads state.nxt (see _drain_nxt_fixups).
             self._drain_nxt_fixups()
+        if self._fabric is not None:
+            # Consume the device-routed inbox plane promoted at the
+            # driver's last delivery barrier: the kind mirror backs the
+            # wake predicate, the builders' occupancy deferral, and the
+            # per-(group, src) delivery stamp; the plane itself merges
+            # under the host residual inside the routed step variants.
+            with prof.phase("route"):
+                self._routed_plane, self._routed_kinds = \
+                    self._fabric.consume(self.me)
+                if self._routed_kinds is not None:
+                    gi, si = np.nonzero(self._routed_kinds)
+                    self._h_last_seen[gi, si] = self._ticks
         pf = self._peer_fresh(window)
         G = None
         if self._active_set:
@@ -1089,13 +1146,21 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 (idx, vals, staged,
                  deferred, deferred_b) = self._build_inbox_sparse()
             with prof.phase("dispatch"):
-                step = (functools.partial(_py_sparse_window, self._k_out,
-                                          ticks=window)
-                        if self._backend == "python"
-                        else _sparse_window_fn(self._k_out, window))
-                new_state, flat, sv_dev, ov_dev = step(
-                    self.params, self.member, self._me_dev, self.state,
-                    jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
+                rp = self._routed_plane
+                args = (self.params, self.member, self._me_dev, self.state,
+                        jnp.asarray(pf), jnp.asarray(idx), jnp.asarray(vals))
+                if self._backend == "python":
+                    new_state, flat, sv_dev, ov_dev = _py_sparse_window(
+                        self._k_out, *args, ticks=window, routed=rp)
+                elif rp is not None:
+                    # Routed variant: the plane is dense-addressed and
+                    # merges inside the jit — routed rows never join the
+                    # sparse upload.
+                    new_state, flat, sv_dev, ov_dev = _sparse_window_routed_fn(
+                        self._k_out, window)(*args, rp)
+                else:
+                    new_state, flat, sv_dev, ov_dev = _sparse_window_fn(
+                        self._k_out, window)(*args)
             h = {"mode": "sparse", "flat": flat, "sv": sv_dev, "ov": ov_dev,
                  "staged": staged, "k_out": self._k_out, "window": window,
                  # Transfer accounting (benchable without extra fetches:
@@ -1114,12 +1179,23 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     self._scatter_proposal_counts(in10[9], pg, prop_groups)
                 self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
             with prof.phase("dispatch"):
-                step = (functools.partial(_py_packed_window, ticks=window)
-                        if self._backend == "python"
-                        else _window_step_fn(window))
-                new_state, flat = step(
-                    self.params, self.member, self._me_dev, self.state, in10,
-                    jnp.asarray(pf))
+                rp = self._routed_plane
+                if self._backend == "python":
+                    if rp is not None:
+                        # Scalar twin: the plane is numpy — merge host-side
+                        # (same _merge_routed the jit variants trace).
+                        in10 = _merge_routed(np, in10, np.asarray(rp))
+                    new_state, flat = _py_packed_window(
+                        self.params, self.member, self._me_dev, self.state,
+                        in10, jnp.asarray(pf), ticks=window)
+                elif rp is not None:
+                    new_state, flat = _window_step_routed_fn(window)(
+                        self.params, self.member, self._me_dev, self.state,
+                        in10, rp, jnp.asarray(pf))
+                else:
+                    new_state, flat = _window_step_fn(window)(
+                        self.params, self.member, self._me_dev, self.state,
+                        in10, jnp.asarray(pf))
             h = {"mode": "dense", "flat": flat, "staged": staged,
                  "window": window,
                  "upload_bytes": int(in10.nbytes),
@@ -1127,6 +1203,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         self.state = new_state
         self._pending_msgs = deferred
         self._pending_batches = deferred_b
+        # Peers consult this before routing toward us: deferred carry-over
+        # claims slots FIRST at our next begin, and a routed row must never
+        # invert that precedence — so for one tick they use the host path.
+        self._route_dirty = bool(deferred or deferred_b)
+        # The routed plane is consumed by exactly this dispatch.
+        self._routed_plane = None
+        self._routed_kinds = None
         # Snapshot the proposal queues INTO the tick handle: the device was
         # told exactly these counts (inbox row 9), so tick_finish must mint
         # and resolve exactly these payloads. A proposal enqueued between
@@ -1171,6 +1254,19 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         host-side messages (snapshot chunks) quiesce the pipeline for one
         round: tick t finishes fully before t+1 dispatches. Call
         tick_drain() before switching back to tick()."""
+        if (not RaftEngine._pipeline_cpu_warned and self._backend == "jax"
+                and jax.default_backend() == "cpu"):
+            # One-time footgun guard (PR 2 measured it honestly): XLA:CPU
+            # blocks dispatch under outstanding programs, so the pipelined
+            # overlap buys nothing there and the +1-tick-per-hop latency
+            # cost still applies. Re-measure on a real accelerator before
+            # quoting pipelined numbers as wins (bench_engine annotates
+            # its rows with the same caveat).
+            RaftEngine._pipeline_cpu_warned = True
+            log.warning(
+                "tick_pipelined on XLA:CPU: PR 2 measured this mode SLOWER "
+                "than split-phase ticks on the CPU backend (dispatch does "
+                "not overlap); it exists for accelerators where it does")
         prev = self._pipeline_h
         self._pipeline_h = None
         res: TickResult | None = None
@@ -1654,8 +1750,27 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # AE-ack claims to hold, and a same-tick vote grant from the wiped
         # row is exactly the forgotten-ack vote parole exists to prevent.
         skip = self._recycled_this_tick | reset_rows
+        routed_mask = None
+        routed_dsts: set[int] = set()
+        if self._fabric is not None and len(proc):
+            # Device-resident delivery: payload-free rows toward clean
+            # on-fabric peers scatter straight into their staged inbox
+            # planes (the scatter source is the step's device output —
+            # never the host copy) and are masked out of the host decode
+            # below. The residual the decode emits is exactly the
+            # payload-bearing / off-fabric share.
+            with prof.phase("route"):
+                routed_mask = self._fabric.route_from(
+                    self, proc, ov_c, h, skip=skip or None)
+            if routed_mask is not None:
+                n_routed = int(routed_mask.sum())
+                self.routed_msgs += n_routed
+                self._c_routed.inc(n_routed)
+                routed_dsts = set(
+                    np.nonzero(routed_mask.any(axis=0))[0].tolist())
         with prof.phase("decode"):
-            res.outbound = self._decode_outbox(ov_c, proc, skip=skip or None)
+            res.outbound = self._decode_outbox(ov_c, proc, skip=skip or None,
+                                               routed=routed_mask)
         if self._snap_acks:
             # Snapshot-transfer acks queued by receive() (which has no send
             # channel of its own) ride this tick's outbound.
@@ -1669,7 +1784,11 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # node warm. This is what makes heartbeat intervals beyond the
             # election timeout legal (config.py RaftConfig.validate) —
             # the legality must not depend on which loop drives ticks.
-            sent_to = {m.dst for m in res.outbound}
+            # Device-routed frames ARE this tick's traffic to their peers
+            # (they feed peer_fresh via the fabric flush), so those slots
+            # need no ping — and emitting one would make routed runs
+            # diverge on the wire from host-decoded ones.
+            sent_to = {m.dst for m in res.outbound} | routed_dsts
             for slot in self.members.active_slots():
                 if slot != self.me and slot not in sent_to:
                     res.outbound.append(rpc.WireMsg(

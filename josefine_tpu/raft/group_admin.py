@@ -137,6 +137,11 @@ class GroupAdmin:
         self._pending_batches = [
             pb for pb in (b.take(b.group != g) for b in self._pending_batches)
             if len(pb)]
+        if self._fabric is not None:
+            # Device-routed traffic staged/ready for the dead incarnation
+            # is "already admitted" exactly like the queues above — purge
+            # its slots from the routing planes too.
+            self._fabric.purge_group(self.me, g)
         self._recycled_this_tick.add(g)
         self.flight.emit(self._flight_tick(), "group_recycled", group=g,
                          inc=int(self._h_ginc[g]))
@@ -251,6 +256,10 @@ class GroupAdmin:
                              & np.isin(b.kind_col, _PAROLE_DROP_ARR)))
                     for b in self._pending_batches)
                 if len(pb)]
+            if self._fabric is not None:
+                # Already-routed election requests must not reach the
+                # emptied row either (same rule as the queue purge above).
+                self._fabric.purge_group(self.me, g, kinds=_PAROLE_DROP_KINDS)
             _m_paroled.set(len(self._parole), node=self.self_id)
             log.warning("g=%d entering vote parole until head >= %#x",
                         g, old_head)

@@ -48,12 +48,20 @@ class HostIO:
         staged: dict[int, list] = {}
         deferred: list[rpc.WireMsg] = []
         deferred_b: list[rpc.MsgBatch] = []
+        # Routed occupancy: slots already claimed by the device-resident
+        # routing plane this tick (raft/route.py). A colliding host claim
+        # defers exactly like a host-built slot conflict — the routed row
+        # merges under the residual inbox on device, last host writer
+        # never overwrites it.
+        occ = self._routed_kinds
         # Columnar batches first (the product hot path): nine vectorized
         # scatters per peer frame; slot conflicts split the batch and carry
         # the remainder to the next tick.
         for b in self._pending_batches:
             g, src = b.group, b.src
             free = in10[0, g, src] == 0
+            if occ is not None:
+                free &= occ[g, src] == 0
             if not free.all():
                 deferred_b.append(b.take(~free))
                 b = b.take(free)
@@ -81,7 +89,8 @@ class HostIO:
         seen: set[tuple[int, int]] = set()
         for m in msgs:
             key = (m.group, m.src)
-            if key in seen or in10[0, m.group, m.src] != rpc.MSG_NONE:
+            if (key in seen or in10[0, m.group, m.src] != rpc.MSG_NONE
+                    or (occ is not None and occ[m.group, m.src])):
                 deferred.append(m)
                 continue
             seen.add(key)
@@ -162,9 +171,14 @@ class HostIO:
         staged: dict[int, list] = {}
         deferred: list[rpc.WireMsg] = []
         deferred_b: list[rpc.MsgBatch] = []
+        # Routed occupancy (device-resident routing plane): same deferral
+        # rule as the dense builder, keyed by GLOBAL group ids.
+        occ = self._routed_kinds
         for b in self._pending_batches:
             rows = np.searchsorted(G, b.group)
             free = vals[0, rows, b.src] == 0
+            if occ is not None:
+                free &= occ[b.group, b.src] == 0
             if not free.all():
                 deferred_b.append(b.take(~free))
                 b = b.take(free)
@@ -190,7 +204,8 @@ class HostIO:
             for m in msgs:
                 row = int(np.searchsorted(G, m.group))
                 key = (m.group, m.src)
-                if key in seen or vals[0, row, m.src] != rpc.MSG_NONE:
+                if (key in seen or vals[0, row, m.src] != rpc.MSG_NONE
+                        or (occ is not None and occ[m.group, m.src])):
                     deferred.append(m)
                     continue
                 seen.add(key)
@@ -236,7 +251,8 @@ class HostIO:
         plane[rows, 0] = np.fromiter(
             (len(self._proposals[g]) for g in groups), np.int32, len(groups))
 
-    def _decode_outbox(self, ov, groups, skip: set[int] | None = None) -> list:
+    def _decode_outbox(self, ov, groups, skip: set[int] | None = None,
+                       routed: np.ndarray | None = None) -> list:
         """Decode the packed outbox into ONE columnar MsgBatch per peer (plus
         any InstallSnapshot WireMsgs). The batch IS the wire form — per-tick
         consensus traffic to a peer is a single binary frame end to end.
@@ -257,16 +273,27 @@ class HostIO:
         in-flight dispatch under ``tick_pipelined``. Byte-identical output
         is pinned against :meth:`_decode_outbox_reference` by
         tests/test_decode_differential.py.
+
+        ``routed`` is the device-routing mask (same (R, N) shape as the
+        outbox cells): rows the RouteFabric already delivered on-device
+        this tick. They are masked out BEFORE the nonzero pass, so routed
+        traffic is never re-materialized host-side — the residual this
+        decoder emits is exactly the payload-bearing / off-fabric share.
         """
         kind = ov[0]
+        copied = False
         if skip:
             smask = np.isin(np.asarray(groups),
                             np.fromiter(skip, np.int64, len(skip)))
             if smask.any():
                 # Mid-tick-recycled rows: their outbox was computed by the
                 # dead incarnation but would be stamped with the new one.
-                kind = kind.copy()
+                kind, copied = kind.copy(), True
                 kind[smask] = 0
+        if routed is not None and routed.any():
+            if not copied:
+                kind = kind.copy()
+            kind[routed] = 0
         ri, di = np.nonzero(kind)
         if not len(ri):
             return []
@@ -369,19 +396,25 @@ class HostIO:
         return out
 
     def _decode_outbox_reference(self, ov, groups,
-                                 skip: set[int] | None = None) -> list:
+                                 skip: set[int] | None = None,
+                                 routed: np.ndarray | None = None) -> list:
         """Retained scalar reference for :meth:`_decode_outbox` — the per-dst
         loop with per-entry ``ch.range()`` reads. The differential test
         (tests/test_decode_differential.py) pins the columnar path
         byte-identical to this across dense/sparse modes, snapshot-floor
-        spans, max_append_entries capping, and mid-tick-recycled skip rows.
-        Never called on the product hot path."""
+        spans, max_append_entries capping, mid-tick-recycled skip rows, and
+        device-routed cell masks. Never called on the product hot path."""
         kind = ov[0]
+        copied = False
         if skip:
             rows = [i for i, g in enumerate(groups) if int(g) in skip]
             if rows:
-                kind = kind.copy()
+                kind, copied = kind.copy(), True
                 kind[rows] = 0
+        if routed is not None and routed.any():
+            if not copied:
+                kind = kind.copy()
+            kind[routed] = 0
         if not kind.any():
             return []
         ri, di = np.nonzero(kind)

@@ -1,0 +1,353 @@
+"""Device-resident intra-chip message delivery between co-located engines.
+
+Co-located engines (the in-process cluster: one device, one RaftEngine per
+node slot — the bench cluster, the chaos harness, the twin differential
+rigs) exchange almost all of their steady-state consensus traffic as
+payload-free packed rows: votes, pre-votes, heartbeats (AppendEntries with
+an empty span), and append/vote responses. The host bridge used to decode
+every one of those out of the sender's outbox into a columnar MsgBatch and
+re-encode it into the receiver's inbox tensor each tick — PR 2's profiler
+showed that encode/decode pair dominating the host share of the tick, and
+the ROADMAP names the messaging path "the next 10×" (the arxiv 1605.05619
+argument: consensus throughput is bounded by where messages are processed).
+
+:class:`RouteFabric` closes that loop on the device. Per sender tick:
+
+* the sender's ``tick_finish`` computes a **routed mask** over its fetched
+  compact outbox — host-cheap columnar numpy over data it fetched anyway —
+  using the delivery decision table (see ARCHITECTURE.md "Device-resident
+  delivery"): kind payload-free × peer on-fabric × link clean × receiver
+  not carrying deferred inbox claims × row incarnation match × not
+  parole-dropped × not mid-tick-recycled;
+* the routed rows are scattered **on device** from the step's flat output
+  into the receiver's staged ``(9, P, N)`` inbox plane
+  (:func:`packed_step._route_scatter_fn` — the outbox's nine packed rows
+  ARE the inbox's rows 0-8, so no transform is needed, only placement);
+* the mask is handed to ``_decode_outbox`` so routed rows are never
+  re-materialized host-side — the host decodes only the residual:
+  payload-bearing AppendEntries, snapshot transfers, off-fabric peers,
+  faulted links;
+* the driver calls :meth:`flush` at its delivery barrier (wherever it
+  hands host-path messages to ``receive()``), promoting staged planes to
+  consumable ones — so routed and host-path delivery become visible at the
+  SAME ``tick_begin``, which is what makes routing byte-identical to host
+  decoding (pinned by tests/test_device_route.py's twin differential);
+* the receiver's next ``tick_begin`` consumes its ready plane: the routed
+  rows join the wake predicate, the host builders treat routed-occupied
+  slots as claimed (colliding claims defer, exactly like a host-built slot
+  conflict), and the plane merges under the residual inbox inside the
+  routed step variants — never leaving the device.
+
+Slot-conflict byte-identity: a routed slot may only collide with a host
+claim that was *deferred* from an earlier tick (same (group, src) key —
+impossible within one clean tick, since a sender's outbox holds one row
+per (group, dst)). The fabric therefore refuses to route toward a receiver
+whose last ``tick_begin`` deferred anything (``engine._route_dirty``) —
+that tick's traffic rides the host path, where the ordinary carry-over
+rules apply — so the deferred-beats-new precedence of the host-only path
+is never inverted.
+
+The fabric is host-driver infrastructure, not wire transport: engines
+reached over TCP are simply never registered and keep the host path.
+Sharded (mesh) engines are rejected — scatter by arbitrary row ids across
+a sharded P axis is all-to-all traffic, the same reason active_set rejects
+the mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.group_admin import _PAROLE_DROP_ARR
+from josefine_tpu.raft.packed_step import (
+    _MIRROR13_ROWS,
+    _merge_planes_fn,
+    _purge_plane_row_fn,
+    _route_scatter_fn,
+    _route_scatter_new_fn,
+    route_bucket,
+)
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.route")
+
+# Kinds routable without host involvement: always payload-free on the wire.
+# MSG_APPEND joins conditionally (x == y — a pure heartbeat/commit probe);
+# an AE with a real span needs chain payload attached host-side.
+_ROUTED_ALWAYS = np.asarray(sorted((
+    rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP,
+    rpc.MSG_PREVOTE_REQ, rpc.MSG_PREVOTE_RESP,
+    rpc.MSG_APPEND_RESP,
+)), np.int32)
+
+
+class RouteFabric:
+    """Shared device-resident delivery plane for co-located engines
+    (see module docstring). One instance per in-process cluster; engines
+    join via :meth:`register`, drivers call :meth:`flush` at their
+    delivery barrier."""
+
+    def __init__(self, link_filter=None):
+        # slot -> engine. A slot may be re-registered (restart churn):
+        # the dead engine's staged/ready traffic dies with it, like the
+        # pending queues inside the dead process.
+        self.engines: dict[int, object] = {}
+        # Optional (src_slot, dst_slot) -> bool gate. The chaos harness
+        # wires FaultPlane.link_routable here so partitions/crashes/noisy
+        # links force traffic back through the host residual path (where
+        # the plane applies its fates); None = all registered links clean.
+        self.link_filter = link_filter
+        self.P: int | None = None
+        self.N: int | None = None
+        self.backend: str | None = None
+        # Per-receiver staged (accumulating this round) and ready
+        # (consumable at the next tick_begin) planes, plus the host-side
+        # kind mirrors that back occupancy checks, wake scheduling,
+        # last-seen stamps, and selective purges without a device fetch.
+        self._staging: dict[int, object] = {}
+        self._staging_kinds: dict[int, np.ndarray] = {}
+        self._staging_srcs: dict[int, dict[int, int]] = {}
+        self._ready: dict[int, object] = {}
+        self._ready_kinds: dict[int, np.ndarray] = {}
+        self.routed_total = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def register(self, engine) -> None:
+        """Join an engine to the fabric (idempotent per slot; re-register
+        on restart — staged traffic for the dead incarnation is dropped,
+        matching the loss of its in-process pending queues)."""
+        if engine._mesh is not None:
+            raise ValueError(
+                "RouteFabric requires an unsharded engine (mesh=None): "
+                "routing scatters by arbitrary row ids, which is "
+                "all-to-all across a sharded P axis")
+        if self.P is None:
+            self.P, self.N = engine.P, engine.N
+            self.backend = engine._backend
+        elif (engine.P, engine.N, engine._backend) != (self.P, self.N,
+                                                       self.backend):
+            raise ValueError(
+                f"fabric shape mismatch: engine (P={engine.P}, N={engine.N}, "
+                f"backend={engine._backend!r}) vs fabric (P={self.P}, "
+                f"N={self.N}, backend={self.backend!r})")
+        slot = engine.me
+        self.engines[slot] = engine
+        engine._fabric = self
+        self._staging.pop(slot, None)
+        self._staging_kinds.pop(slot, None)
+        self._staging_srcs.pop(slot, None)
+        self._ready.pop(slot, None)
+        self._ready_kinds.pop(slot, None)
+
+    def unregister(self, slot: int) -> None:
+        """Remove a slot (membership removal / process stop): its pending
+        routed traffic is dropped and peers stop routing toward it."""
+        e = self.engines.pop(slot, None)
+        if e is not None and getattr(e, "_fabric", None) is self:
+            e._fabric = None
+        for store in (self._staging, self._staging_kinds, self._staging_srcs,
+                      self._ready, self._ready_kinds):
+            store.pop(slot, None)
+
+    def link_ok(self, src: int, dst: int) -> bool:
+        return self.link_filter is None or bool(self.link_filter(src, dst))
+
+    # ------------------------------------------------------------ sender side
+
+    def route_from(self, engine, proc, ov, h, skip=None):
+        """Compute the sender's routed mask for this tick's compact outbox
+        and scatter the routed rows into each receiver's staged plane.
+        Returns the (R, N) bool mask (None when nothing routed) — the
+        caller hands it to ``_decode_outbox`` so routed rows skip the host
+        decode entirely."""
+        me = engine.me
+        dsts = [d for d, peer in self.engines.items()
+                if d != me and not peer._route_dirty and self.link_ok(me, d)]
+        if not dsts or not len(proc):
+            return None
+        kind = ov[0]
+        gids = np.asarray(proc, np.int64)
+        base = np.isin(kind, _ROUTED_ALWAYS)
+        is_ae = kind == rpc.MSG_APPEND
+        if is_ae.any():
+            i64 = np.int64
+            x = (ov[2].astype(i64) << 32) | ov[3].astype(i64)
+            y = (ov[4].astype(i64) << 32) | ov[5].astype(i64)
+            base |= is_ae & (x == y)  # payload-free heartbeat/commit probe
+        if skip:
+            smask = np.isin(gids, np.fromiter(skip, np.int64, len(skip)))
+            if smask.any():
+                base = base & ~smask[:, None]
+        if not base.any():
+            return None
+        routed = np.zeros_like(base)
+        my_inc = engine._h_ginc[gids]
+        src_ov = None
+        for d in dsts:
+            peer = self.engines[d]
+            # Receiver-side intake rules, applied at route time so a
+            # routed row lands iff the host path would have accepted it:
+            # incarnation match (stale frames for a recycled row), and the
+            # vote-parole drop (an abstaining group refuses election
+            # traffic). Rows failing either fall back to the host path,
+            # where the receiver's intake applies the same rule.
+            col = base[:, d] & (my_inc == peer._h_ginc[gids])
+            if peer._parole:
+                par = np.fromiter(peer._parole, np.int64, len(peer._parole))
+                col &= ~(np.isin(kind[:, d], _PAROLE_DROP_ARR)
+                         & np.isin(gids, par))
+            rs = np.nonzero(col)[0]
+            if not len(rs):
+                continue
+            routed[rs, d] = True
+            if src_ov is None:
+                src_ov = self._src_ov(h)
+            # Source row indexing: the active-compact outbox is indexed by
+            # bucket position (rs); dense and sparse sources are the dense
+            # (9, P, N) device outbox, indexed by group id.
+            srows = rs if h["mode"] == "active" else gids[rs]
+            self._push(engine, d, src_ov, srows, gids[rs],
+                       kind[rs, d], d)
+        if not routed.any():
+            return None
+        self.routed_total += int(routed.sum())
+        return routed
+
+    def _src_ov(self, h):
+        """The device-resident (9, R, N) outbox backing this tick handle —
+        sliced lazily from the flat step output (a device view op, not a
+        fetch) and cached on the handle so multiple receivers share it."""
+        src = h.get("_route_src")
+        if src is not None:
+            return src
+        mode = h["mode"]
+        if mode == "dense":
+            src = h["flat"][10 * self.P:].reshape(9, self.P, self.N)
+        elif mode == "sparse":
+            src = h["ov"]  # dense device-resident outbox (sparse step output)
+        else:  # active: compact (9, k, N) rows aligned with h["G"]
+            k = h["k"]
+            src = h["flat"][_MIRROR13_ROWS * k:].reshape(9, k, self.N)
+        h["_route_src"] = src
+        return src
+
+    def _push(self, sender, slot, src_ov, srows, gs, kinds_col, dst) -> None:
+        """Scatter one sender→receiver routed row set into the receiver's
+        staged plane (device for the jax backend, numpy for the scalar
+        twin) and update the host kind mirror + per-src delivery counts."""
+        km = self._staging_kinds.get(slot)
+        if km is None:
+            km = self._staging_kinds[slot] = np.zeros(
+                (self.P, self.N), np.int8)
+        km[gs, sender.me] = kinds_col.astype(np.int8)
+        plane = self._staging.get(slot)
+        if self.backend == "python":
+            if plane is None:
+                plane = np.zeros((9, self.P, self.N), np.int32)
+            plane[:, gs, sender.me] = np.asarray(src_ov)[:, srows, dst]
+        else:
+            B = route_bucket(len(gs), self.P)
+            srows_b = np.zeros(B, np.int32)
+            srows_b[:len(srows)] = srows
+            gids_b = np.full(B, self.P, np.int32)  # padding: dropped
+            gids_b[:len(gs)] = gs
+            args = (src_ov, jnp.asarray(srows_b), jnp.asarray(gids_b),
+                    jnp.asarray(int(dst), jnp.int32),
+                    jnp.asarray(int(sender.me), jnp.int32))
+            if plane is None:
+                # First push of the round: the zero plane is built inside
+                # the program (a memset, never an upload).
+                plane = _route_scatter_new_fn(B, self.P, self.N)(*args)
+            else:
+                # Subsequent pushes donate the plane — in-place stores,
+                # no (9, P, N) copy per sender.
+                plane = _route_scatter_fn(B)(plane, *args)
+        self._staging[slot] = plane
+        srcs = self._staging_srcs.setdefault(slot, {})
+        srcs[sender.me] = srcs.get(sender.me, 0) + len(gs)
+
+    # ----------------------------------------------------------- driver barrier
+
+    def flush(self) -> None:
+        """Promote staged planes to consumable ones. Drivers call this at
+        their delivery barrier — the exact point they hand host-path
+        messages to ``receive()`` — so routed and host-delivered traffic
+        become visible at the same ``tick_begin``. Also performs the
+        receiver-side intake bookkeeping the host path does in
+        ``receive()``: the per-src transport-liveness stamp and the
+        accepted-message counter."""
+        for slot in list(self._staging):
+            stg = self._staging.pop(slot, None)
+            skm = self._staging_kinds.pop(slot, None)
+            srcs = self._staging_srcs.pop(slot, None) or {}
+            if stg is None or skm is None:
+                continue
+            peer = self.engines.get(slot)
+            if peer is None:
+                continue  # removed/stopped: in-flight traffic is lost
+            rdy = self._ready.get(slot)
+            if rdy is None:
+                self._ready[slot] = stg
+                self._ready_kinds[slot] = skm
+            else:
+                # Two flushes without a consuming begin (skewed/stalled
+                # receiver): first writer keeps the slot, the later claim
+                # is dropped — pure FIFO message loss, Raft-tolerated.
+                rkm = self._ready_kinds[slot]
+                if self.backend == "python":
+                    free = rkm == 0
+                    rdy[:, free] = stg[:, free]
+                else:
+                    self._ready[slot] = _merge_planes_fn(rdy, stg)
+                rkm[rkm == 0] = skm[rkm == 0]
+            for s, cnt in srcs.items():
+                peer._h_src_seen[s] = peer._ticks
+                peer._c_in.inc(cnt)
+
+    # ---------------------------------------------------------- receiver side
+
+    def consume(self, slot: int):
+        """Take the receiver's ready plane for this tick_begin: returns
+        (plane, kinds) — the device plane the routed step variants merge,
+        and the host (P, N) kind mirror backing occupancy/wake/stamping —
+        or (None, None) when nothing was routed."""
+        plane = self._ready.pop(slot, None)
+        kinds = self._ready_kinds.pop(slot, None)
+        return plane, kinds
+
+    def purge_group(self, slot: int, g: int, kinds=None) -> None:
+        """Drop pending routed traffic for group ``g`` toward ``slot`` —
+        the fabric half of the engine's pending-queue purge on group
+        recycle (all kinds) and parole entry (election kinds only)."""
+        sel_kinds = None if kinds is None else np.asarray(sorted(kinds),
+                                                         np.int8)
+        for planes, mirrors in ((self._staging, self._staging_kinds),
+                                (self._ready, self._ready_kinds)):
+            km = mirrors.get(slot)
+            if km is None:
+                continue
+            row = km[g]
+            sel = (row != 0) if sel_kinds is None else np.isin(row, sel_kinds)
+            if not sel.any():
+                continue
+            plane = planes[slot]
+            if self.backend == "python":
+                plane[:, g, sel] = 0
+            else:
+                planes[slot] = _purge_plane_row_fn(
+                    plane, jnp.asarray(g, jnp.int32), jnp.asarray(~sel))
+            row[sel] = 0
+
+    # ------------------------------------------------------------------ stats
+
+    def pending_counts(self) -> dict[int, int]:
+        """Per-receiver staged+ready routed rows (debug/tests)."""
+        out: dict[int, int] = {}
+        for store in (self._staging_kinds, self._ready_kinds):
+            for slot, km in store.items():
+                if km is not None:
+                    out[slot] = out.get(slot, 0) + int((km != 0).sum())
+        return out

@@ -9,6 +9,8 @@ with a shortened nemesis so the test fits the tier-1 budget.
 
 from __future__ import annotations
 
+import pytest
+
 from josefine_tpu.chaos.nemesis import Schedule, Step
 from josefine_tpu.chaos.soak import run_soak
 
@@ -36,6 +38,26 @@ def test_same_seed_reproduces_events_and_state():
     # The run actually did something chaotic and committed writes.
     assert a["fault_events"] > 10
     assert a["acked"] >= 5
+
+
+@pytest.mark.slow
+def test_same_seed_reproduces_with_device_route():
+    """Device-resident routing preserves the reproducibility contract: a
+    routed soak (quiet net, so clean links actually route; the schedule's
+    partition/crash force the host residual path) journals and digests
+    byte-identically across same-seed runs — and actually routed. Slow:
+    two full soaks; ci.sh full runs it, and the routed chaos smoke covers
+    the path in quick."""
+    from josefine_tpu.chaos.faults import NetFaults
+
+    a = run_soak(1234, SHORT, net=NetFaults.quiet(), device_route=True)
+    b = run_soak(1234, SHORT, net=NetFaults.quiet(), device_route=True)
+    assert a["invariants"] == "ok", a["violation"]
+    assert a["event_log"] == b["event_log"]
+    assert a["journals"] == b["journals"]
+    assert a["state_digest"] == b["state_digest"]
+    assert (a["device_route_stats"]["routed_msgs"]
+            == b["device_route_stats"]["routed_msgs"] > 0)
 
 
 def test_different_seed_diverges():

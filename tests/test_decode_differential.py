@@ -16,7 +16,11 @@ send-pointer fixups. Covered scenarios, per the tentpole checklist:
 * snapshot-floor spans (leader truncated past a downed follower's head ->
   MSG_SNAPSHOT in the decode output);
 * ``skip`` rows (mid-tick-recycled groups): a synthetic skip-set variant is
-  compared on every decode that has traffic.
+  compared on every decode that has traffic;
+* ``routed`` cell masks (device-resident delivery, PR 6): a synthetic
+  routed-mask variant — the payload-free cells the RouteFabric would route
+  — is compared on every decode that has any, pinning that both decoders
+  emit the identical host residual.
 """
 
 import asyncio
@@ -28,6 +32,7 @@ import pytest
 from josefine_tpu.models.types import step_params
 from josefine_tpu.raft import rpc
 from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.route import _ROUTED_ALWAYS
 from josefine_tpu.utils.kv import MemKV
 
 PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
@@ -58,6 +63,7 @@ class DiffStats:
         self.with_fixups = 0
         self.with_snapshots = 0
         self.skip_variants = 0
+        self.routed_variants = 0
 
 
 def _wire_bytes(out):
@@ -77,7 +83,7 @@ def install_differential(engine: RaftEngine, stats: DiffStats) -> None:
     columnar = RaftEngine._decode_outbox
     reference = RaftEngine._decode_outbox_reference
 
-    def run_isolated(self, fn, ov, groups, skip):
+    def run_isolated(self, fn, ov, groups, skip, routed=None):
         """Run one decoder with snapshot-transfer state + fixups saved and
         restored (the snapshot sender path is stateful: throttle stamps and
         send pointers advance per emitted chunk)."""
@@ -85,7 +91,7 @@ def install_differential(engine: RaftEngine, stats: DiffStats) -> None:
                  dict(self._snap_ack_tick), dict(self._last_snap_tick))
         nfix = len(self._nxt_fixups)
         try:
-            out = fn(self, ov, groups, skip=skip)
+            out = fn(self, ov, groups, skip=skip, routed=routed)
             fixups = list(self._nxt_fixups[nfix:])
         finally:
             del self._nxt_fixups[nfix:]
@@ -93,10 +99,11 @@ def install_differential(engine: RaftEngine, stats: DiffStats) -> None:
              self._snap_ack_tick, self._last_snap_tick) = saved
         return out, fixups
 
-    def wrapped(self, ov, groups, skip=None):
+    def wrapped(self, ov, groups, skip=None, routed=None):
         stats.calls += 1
-        ref_out, ref_fix = run_isolated(self, reference, ov, groups, skip)
-        if skip is None and len(groups):
+        ref_out, ref_fix = run_isolated(self, reference, ov, groups, skip,
+                                        routed)
+        if skip is None and routed is None and len(groups):
             # Synthetic mid-tick-recycled rows: suppress the first (and,
             # when present, the last) emitted group and require both paths
             # to agree on the reduced output too.
@@ -106,10 +113,27 @@ def install_differential(engine: RaftEngine, stats: DiffStats) -> None:
             assert _wire_bytes(a) == _wire_bytes(b)
             assert sorted(fa) == sorted(fb)
             stats.skip_variants += 1
+            # Synthetic device-routed cells: exactly the payload-free mask
+            # the RouteFabric computes — both decoders must emit the same
+            # host residual with those cells excised.
+            kind = np.asarray(ov[0])
+            i64 = np.int64
+            x = (ov[2].astype(i64) << 32) | ov[3].astype(i64)
+            y = (ov[4].astype(i64) << 32) | ov[5].astype(i64)
+            rmask = np.isin(kind, _ROUTED_ALWAYS) | (
+                (kind == rpc.MSG_APPEND) & (x == y))
+            if rmask.any():
+                a, fa = run_isolated(self, reference, ov, groups, None,
+                                     rmask)
+                b, fb = run_isolated(self, columnar, ov, groups, None,
+                                     rmask)
+                assert _wire_bytes(a) == _wire_bytes(b)
+                assert sorted(fa) == sorted(fb)
+                stats.routed_variants += 1
         # The columnar path runs LAST and un-isolated: its snapshot-state
         # advancement and fixups are the ones the live cluster keeps.
         nfix = len(self._nxt_fixups)
-        out = columnar(self, ov, groups, skip=skip)
+        out = columnar(self, ov, groups, skip=skip, routed=routed)
         new_fix = list(self._nxt_fixups[nfix:])
         assert _wire_bytes(out) == _wire_bytes(ref_out), (
             f"columnar decode diverged from reference (tick {self._ticks})")
@@ -192,6 +216,7 @@ def test_decode_differential_catchup_and_capping(sparse):
         assert stats.with_blocks > 0, "no AE payload spans were decoded"
         assert stats.with_fixups > 0, "capping never produced a nxt fixup"
         assert stats.skip_variants > 0
+        assert stats.routed_variants > 0, "no routed-mask decode compared"
 
     asyncio.run(main())
 

@@ -49,6 +49,12 @@ def main() -> int:
                          "an --active-set soak needs a larger value to spend "
                          "ticks on the compacted path instead of the dense "
                          "fallback — see active_set_stats in the summary)")
+    ap.add_argument("--device-route", action="store_true",
+                    help="engines share a RouteFabric: payload-free rows on "
+                         "clean links deliver device-resident, while "
+                         "partitions/crashes/noise force the host residual "
+                         "path (pair with --quiet-net so a directive "
+                         "schedule leaves clean links to route)")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -106,7 +112,8 @@ def main() -> int:
         window=args.window, horizon=args.horizon,
         net=NetFaults.quiet() if args.quiet_net else None,
         auto_faults=args.auto_faults, active_set=args.active_set,
-        hb_ticks=args.hb_ticks, artifact_path=args.artifact)
+        hb_ticks=args.hb_ticks, device_route=args.device_route,
+        artifact_path=args.artifact)
 
     if args.events:
         with open(args.events, "w") as fh:
@@ -120,10 +127,13 @@ def main() -> int:
 
     summary = {k: result[k] for k in
                ("schedule", "seed", "nodes", "groups", "window",
-                "active_set", "ticks", "proposed", "acked", "fault_events",
-                "chaos_counters", "invariants", "violation", "artifact")}
+                "active_set", "device_route", "ticks", "proposed", "acked",
+                "fault_events", "chaos_counters", "invariants", "violation",
+                "artifact")}
     if result.get("active_set_stats"):
         summary["active_set_stats"] = result["active_set_stats"]
+    if result.get("device_route_stats"):
+        summary["device_route_stats"] = result["device_route_stats"]
     # Observability epilogue: the full registry dump (counters, gauges,
     # histograms — includes the commit-latency axis) and the tail of each
     # node's flight journal, so a soak's summary line says what the

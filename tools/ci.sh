@@ -59,6 +59,20 @@ chaos_smoke_active_set() {
         --horizon 200 --active-set --groups 8 --hb-ticks 4
 }
 
+chaos_smoke_device_route() {
+    # The canonical nemesis pair with device-resident routing on (PR 6):
+    # clean links deliver payload-free rows on-device, and the leader
+    # partition must force that traffic back through the host residual
+    # path (where the plane blocks it) with every invariant green.
+    # --quiet-net matters: probabilistic drop/dup/delay noise closes the
+    # routing gate entirely (per-message fates must not be dodged), so the
+    # default-noise run would never route a single row — the summary's
+    # device_route_stats shows the routed/host split actually exercised.
+    echo "== chaos smoke (device-route) =="
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200 --device-route --quiet-net
+}
+
 obs_smoke() {
     # Observability end-to-end: boot an engine to an election + commits,
     # start a MetricsServer, and assert over real HTTP that /metrics
@@ -84,6 +98,7 @@ if [[ "${1:-}" == "quick" ]]; then
     python -m pytest tests/test_chained_raft.py tests/test_engine.py \
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
+    chaos_smoke_device_route
     obs_smoke
     perf_smoke
 else
@@ -114,11 +129,14 @@ else
     # The active-set differential suite in its own chunk: the twin-cluster
     # bit-exactness matrix is the heaviest single file in the suite.
     python -m pytest tests/test_active_set.py -q
+    # Device-routing twin differential (PR 6) — same heavyweight shape.
+    python -m pytest tests/test_device_route.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_flight.py tests/test_reset_safety.py -q
     chaos_smoke
     chaos_smoke_active_set
+    chaos_smoke_device_route
     obs_smoke
     perf_smoke
 fi

@@ -4,18 +4,22 @@
 Runs ``bench_engine.py --profile`` for each row of the checked-in floor
 file (``tools/perf_floor.json``) for a few ticks on the CPU backend and
 FAILS (exit 1) if any row's ms/tick regresses beyond its allowed ratio.
-Two rows are checked:
+Three rows are checked:
 
 * the dense P=1k floor (PR 2) — catches "someone re-grew the per-entry
   Python path" regressions of the classic bridge;
 * an idle-heavy active-set row (P=10k, --active-frac 0.01) — catches
   regressions of the active-set scheduler path (wake predicate, compact
-  gather/step/scatter, decay kernel), which the dense floor never runs.
+  gather/step/scatter, decay kernel), which the dense floor never runs;
+* a device-routed row (P=10k, --device-route, PR 6) — catches
+  regressions of the RouteFabric path (outbox-mask routing, on-device
+  scatter/merge, the ``route`` phase), which neither other floor runs.
 
 The floor ratio is deliberately loose (2x by default): CI boxes vary, and
 the stage exists to catch order-of-magnitude structural regressions, not
-10% noise. The per-phase profile is printed either way, so a failing run
-says WHERE the regression lives.
+10% noise. The per-phase profile is printed either way, and a failing row
+NAMES the phase that regressed most against the floor's recorded profile
+(``route`` included), so a failure says WHERE the regression lives.
 
 Regenerate the floors after an intentional perf change:
 
@@ -44,6 +48,8 @@ FLOOR_ROWS = [
     {"P": 1000, "ticks": 20, "warmup": 20, "max_regression": 2.0},
     {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
      "active_set": True, "active_frac": 0.01},
+    {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
+     "device_route": True},
 ]
 
 
@@ -63,6 +69,8 @@ def run_bench(floor: dict) -> dict:
         cmd.append("--active-set")
     if floor.get("active_frac") is not None:
         cmd += ["--active-frac", str(floor["active_frac"])]
+    if floor.get("device_route"):
+        cmd.append("--device-route")
     env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
     subprocess.run(cmd, check=True, cwd=ROOT, env=env,
                    timeout=floor.get("timeout_s", 600))
@@ -81,7 +89,27 @@ def _row_name(floor: dict) -> str:
     if floor.get("active_set"):
         return (f"P={floor['P']} active-set "
                 f"(active-frac {floor.get('active_frac')})")
+    if floor.get("device_route"):
+        return f"P={floor['P']} device-routed"
     return f"P={floor['P']} dense"
+
+
+def _worst_phase(floor: dict, phases: dict) -> str | None:
+    """Name the phase that regressed most vs the floor's recorded profile
+    (new phases — e.g. ``route`` on a row that never had it — compare
+    against a tiny epsilon, so a brand-new dominant phase names itself)."""
+    recorded = floor.get("recorded_profile") or {}
+    worst, worst_ratio = None, 0.0
+    for phase, s in phases.items():
+        base = (recorded.get(phase) or {}).get("ms_per_round", 0.0)
+        ratio = s["ms_per_round"] / max(base, 1e-3)
+        if s["ms_per_round"] > 0.5 and ratio > worst_ratio:
+            worst, worst_ratio = phase, ratio
+    if worst is None:
+        return None
+    return (f"{worst} ({phases[worst]['ms_per_round']} ms/round vs "
+            f"{(recorded.get(worst) or {}).get('ms_per_round', 0.0)} "
+            f"recorded, {round(worst_ratio, 1)}x)")
 
 
 def check_row(floor: dict) -> bool:
@@ -100,9 +128,12 @@ def check_row(floor: dict) -> bool:
               f"{stats['fallback_ticks']} fallbacks, avg active frac "
               f"{stats['avg_active_frac']}")
     if ms > limit:
+        blame = _worst_phase(floor, phases)
         print(f"perf-smoke FAILED [{_row_name(floor)}]: regressed "
               f"{round(ms / floor['ms_per_tick_floor'], 2)}x past the "
-              f"{floor.get('max_regression', 2.0)}x budget", file=sys.stderr)
+              f"{floor.get('max_regression', 2.0)}x budget"
+              + (f"; worst phase: {blame}" if blame else ""),
+              file=sys.stderr)
         return False
     return True
 
