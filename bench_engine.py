@@ -124,6 +124,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                     active_set: bool = False,
                     active_frac: float | None = None,
                     device_route: bool = False,
+                    flight_wire: bool = False,
                     xprof: str | None = None) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
     # configuration — at 100k groups a per-tick heartbeat from every
@@ -144,7 +145,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     engines = [
         RaftEngine(MemKV(), [0, 1, 2], i, groups=P, params=params,
                    fsms={g: fsm for g in range(P)},
-                   active_set=active_set)
+                   active_set=active_set, flight_wire=flight_wire)
         for i in range(N)
     ]
     fabric = None
@@ -228,6 +229,31 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         one_tick(live=True)
     leaders = sum(int((e._h_role == 2).sum()) for e in engines)
 
+    flight_off_ms = None
+    if flight_wire:
+        # Baseline window with tracing OFF on the SAME warmed engines (the
+        # flag is pure host gating, so toggling it mid-run is sound): the
+        # steady-state cost of raft.flight_wire is quoted as a measured
+        # delta (extra.flight_wire_overhead), not guessed.
+        for e in engines:
+            e._flight_wire = False
+        if fabric is not None:
+            # The fabric's term mirrors are gated on its own trace flag —
+            # refresh it so the baseline window pays NONE of the tracing
+            # cost (a real flight_wire=False run never maintains them).
+            fabric._refresh_trace()
+        ex0 = list(executed)
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            one_tick(live=True)
+        dt_off = time.perf_counter() - t0
+        base_ticks = min(a - b for a, b in zip(executed, ex0)) or ticks
+        flight_off_ms = 1000 * dt_off / base_ticks
+        for e in engines:
+            e._flight_wire = True
+        if fabric is not None:
+            fabric._refresh_trace()
+
     proposed = committed = 0
     host_entries = 0
     executed = [0] * N
@@ -299,6 +325,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         "active_set": active_set,
         "active_frac": active_frac,
         "device_route": device_route,
+        "flight_wire": flight_wire,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
         "ticks": dev_ticks,
@@ -330,6 +357,16 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
             "routed_msgs": routed_snap,
             "host_msgs": host_snap,
             "routed_frac": round(routed_snap / total, 4) if total else 0.0,
+        }
+    if flight_wire and flight_off_ms is not None:
+        # The wire-trace cost, measured on this box in this run: the timed
+        # loop ran WITH tracing, the baseline window (same engines, same
+        # offered load, tracing toggled off) ran just before it.
+        extra["flight_wire_overhead"] = {
+            "ms_per_tick_off": round(flight_off_ms, 2),
+            "ms_per_tick_on": row["ms_per_tick"],
+            "delta_ms_per_tick": round(row["ms_per_tick"] - flight_off_ms, 2),
+            "journal_events": sum(e.flight.seq for e in engines),
         }
     if active_set:
         # Measured scheduler behavior over the timed loop (cluster totals):
@@ -470,6 +507,12 @@ async def main():
                     help="join the engines to a RouteFabric: payload-free "
                          "consensus rows deliver device-resident; the host "
                          "decodes only payload-bearing traffic")
+    ap.add_argument("--flight-wire", action="store_true",
+                    help="journal wire-level trace events "
+                         "(raft.flight_wire) during the timed loop AND "
+                         "measure a tracing-off baseline window first, so "
+                         "the row quotes the observability cost "
+                         "(extra.flight_wire_overhead)")
     ap.add_argument("--xprof", default=None, metavar="DIR",
                     help="capture a jax.profiler trace (xplane) of the "
                          "timed loop into DIR — pairs a device profile "
@@ -499,6 +542,7 @@ async def main():
                                 active_set=args.active_set,
                                 active_frac=args.active_frac,
                                 device_route=args.device_route,
+                                flight_wire=args.flight_wire,
                                 xprof=args.xprof)
         results.append(r)
         print(json.dumps(r))
@@ -548,7 +592,8 @@ async def main():
                 r.get("proposals_per_tick", 256),
                 bool(r.get("active_set")),
                 -1.0 if frac is None else float(frac),
-                bool(r.get("device_route")))
+                bool(r.get("device_route")),
+                bool(r.get("flight_wire")))
 
     merged = {_key(r): r for r in results}
     try:

@@ -188,7 +188,8 @@ class ChaosCluster(_PlaneDrivenCluster):
                  plane: FaultPlane | None = None, net: NetFaults | None = None,
                  auto_crash: bool = True, auto_links: bool = True,
                  propose_rate: float = 0.15, max_proposals: int = 40,
-                 active_set: bool = False, device_route: bool = False):
+                 active_set: bool = False, device_route: bool = False,
+                 flight_wire: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -204,6 +205,11 @@ class ChaosCluster(_PlaneDrivenCluster):
         # hostile environment for its wake predicate, so nemesis runs can
         # pin the invariants under it, not just fault-free equality.
         self.active_set = active_set
+        # Wire-level trace events (raft.flight_wire): journals grow
+        # msg_sent/msg_delivered so the soak's merged timeline carries the
+        # message path, not just state transitions — the substrate of the
+        # coverage signatures (utils/coverage.py) and trace_report.
+        self.flight_wire = flight_wire
         self.propose_rate = propose_rate
         self.max_proposals = max_proposals
         self.ids = list(range(1, n_nodes + 1))
@@ -247,6 +253,7 @@ class ChaosCluster(_PlaneDrivenCluster):
             snapshot_threshold=6,
             sparse_io=True if self.sparse else None,
             active_set=self.active_set,
+            flight_wire=self.flight_wire,
         )
         if self.k_out is not None:
             e._k_out = self.k_out

@@ -20,6 +20,8 @@ from josefine_tpu.chaos.harness import DEFAULT_PARAMS, ChaosCluster
 from josefine_tpu.chaos.invariants import InvariantViolation
 from josefine_tpu.chaos.nemesis import SCHEDULES, Nemesis, Schedule
 from josefine_tpu.models.types import step_params
+from josefine_tpu.utils.coverage import CoverageMap
+from josefine_tpu.utils.flight import merge_journals, timeline_jsonl
 from josefine_tpu.utils.metrics import REGISTRY
 
 
@@ -41,6 +43,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          active_set: bool = False,
                          hb_ticks: int | None = None,
                          device_route: bool = False,
+                         flight_wire: bool = False,
                          artifact_path: str | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
@@ -62,6 +65,14 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     schedule (partitions) is the only fault source and routing actually
     runs (the summary's device_route_stats shows the split).
 
+    ``flight_wire`` turns on the engines' wire-level trace events
+    (msg_sent/msg_delivered, path-tagged routed vs host), so the per-node
+    journals — and the merged cluster ``timeline`` the result carries —
+    record the message path itself, and the coverage signature gains the
+    path-mix and wire-k-gram classes. Every result embeds
+    ``coverage`` / ``coverage_signature``, the journal-derived fingerprint
+    a nemesis search driver scores runs by (utils/coverage.py).
+
     On an invariant violation the run auto-dumps a JSON repro artifact —
     the per-node flight-recorder journals, the metrics-registry dump, the
     fault-event log, and the violation — to ``artifact_path`` (default
@@ -74,7 +85,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
-                           active_set=active_set, device_route=device_route)
+                           active_set=active_set, device_route=device_route,
+                           flight_wire=flight_wire)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -96,6 +108,13 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         violation = str(e)
 
     journals = cluster.flight_journals_jsonl()
+    # Cluster-scope observability: merge the per-node journals into ONE
+    # deterministically ordered timeline and distill its coverage
+    # signature — the scoring substrate for coverage-guided chaos search.
+    journal_events = cluster.flight_journals()
+    timeline = merge_journals(journal_events)
+    coverage = CoverageMap.from_timeline(timeline, fault_events=plane.events)
+    coverage.publish()  # chaos_coverage_features{class=...} on /metrics
     artifact = None
     if violation is not None:
         # Auto-dump the repro artifact: what the consensus state DID
@@ -112,6 +131,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                     "tick": cluster.tick_no,
                     "violation": violation,
                     "journals": journals,
+                    "timeline": timeline_jsonl(timeline),
+                    "coverage": coverage.to_dict(),
                     "registry": REGISTRY.dump(),
                     "event_log": plane.event_log_jsonl(),
                     "schedule_json": sched.to_json(),
@@ -128,6 +149,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         "window": window,
         "active_set": active_set,
         "device_route": device_route,
+        "flight_wire": flight_wire,
         "ticks": cluster.tick_no,
         "proposed": cluster.proposed,
         "acked": acked_total,
@@ -159,6 +181,12 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # Per-node flight journals (JSONL): byte-identical across same-seed
         # runs — the flight-recorder half of the determinism contract.
         "journals": journals,
+        # The merged cluster timeline (JSONL, (tick, node, seq) ordered) and
+        # its journal-derived coverage fingerprint — byte-identical /
+        # signature-equal across same-seed runs.
+        "timeline": timeline_jsonl(timeline),
+        "coverage": coverage.to_dict(),
+        "coverage_signature": coverage.signature(),
         "registry_dump": REGISTRY.dump(),
         "schedule_json": sched.to_json(),
         "state_digest": cluster.state_digest(),

@@ -102,6 +102,16 @@ class RaftConfig:
     # the /events endpoint. Steady-state ticks emit nothing, so the cost is
     # O(transitions); the ring bounds memory for week-long soaks.
     flight_ring: int = 4096
+    # Wire-level trace events (msg_sent / msg_delivered) in the flight
+    # journal: one event per consensus message at the outbox decision
+    # points (host decode, device-resident route scatter — detail.path says
+    # which) and at inbox consumption, so a proposal can be followed
+    # sender→receiver across node journals (utils/flight.merge_journals,
+    # tools/trace_report.py). Off by default: at P=100k the steady-state
+    # wire volume is ~P events/tick — turn on for chaos soaks and trace
+    # captures, not for the bench hot path (bench_engine --flight-wire
+    # quotes the measured cost in extra.flight_wire_overhead).
+    flight_wire: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120

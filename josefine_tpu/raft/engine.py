@@ -197,6 +197,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         active_set: bool = False,
         mesh=None,
         flight_ring: int = 4096,
+        flight_wire: bool = False,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -574,6 +575,19 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # mirrors, so steady-state ticks append nothing). Tick-indexed and
         # wall-clock-free: same-seed chaos runs journal identically.
         self.flight = FlightRecorder(capacity=flight_ring)
+        # Wire-level trace events (raft.flight_wire, default off): journal
+        # msg_sent at the outbox decision points (host decode / routed
+        # scatter) and msg_delivered at inbox consumption, vectorized off
+        # masks the tick already computes (the decode nonzero pass, the
+        # routed-kind mirror, the builders' occupancy pass) — the off path
+        # is a single bool check per site, the on path adds no extra scans.
+        self._flight_wire = bool(flight_wire)
+        # The tick stamp for delivered events of the dispatch being begun:
+        # the completing tick of that dispatch (self._ticks + window), set
+        # by tick_begin before the builders run — matching tick_finish's
+        # t_now so one tick's deliveries precede its transitions in seq
+        # while sharing the stamp.
+        self._wire_tick = 0
         # Open commit-latency entries, leader-side: group -> deque of
         # (block id, submit device tick) for blocks this node minted whose
         # commit has not yet been observed. Bounded per group; purged on
@@ -1057,6 +1071,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             window = 1
         if window > self._window_hint:
             self._window_hint = window
+        # Delivered-event stamp for this dispatch (see _flight_wire note).
+        self._wire_tick = self._ticks + window
         # Rows recycled since the last tick OUTSIDE of tick() (receive()-
         # time group-0 snapshot installs re-firing partition hooks, startup
         # resets) were reset before this tick's device step ran — this tick
@@ -1084,11 +1100,18 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # per-(group, src) delivery stamp; the plane itself merges
             # under the host residual inside the routed step variants.
             with prof.phase("route"):
-                self._routed_plane, self._routed_kinds = \
+                self._routed_plane, self._routed_kinds, rterms = \
                     self._fabric.consume(self.me)
                 if self._routed_kinds is not None:
                     gi, si = np.nonzero(self._routed_kinds)
                     self._h_last_seen[gi, si] = self._ticks
+                    if self._flight_wire and rterms is not None and len(gi):
+                        # Routed inbox consumption: the kind/term mirrors
+                        # the fabric maintains ARE the delivered rows.
+                        self.flight.emit_many(
+                            self._wire_tick, "msg_delivered", gi,
+                            rterms[gi, si], self._routed_kinds[gi, si],
+                            si, self.me, "routed")
         pf = self._peer_fresh(window)
         G = None
         if self._active_set:
@@ -1177,7 +1200,18 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     prop_groups = list(self._prop_groups)
                     pg = np.asarray(prop_groups, np.intp)
                     self._scatter_proposal_counts(in10[9], pg, prop_groups)
-                self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
+                if self._flight_wire:
+                    # Same occupancy pass, nonzero form: the stamp AND the
+                    # delivered trace come from one scan.
+                    gi, si = np.nonzero(in10[0])
+                    self._h_last_seen[gi, si] = self._ticks
+                    if len(gi):
+                        self.flight.emit_many(
+                            self._wire_tick, "msg_delivered", gi,
+                            in10[1][gi, si], in10[0][gi, si],
+                            si, self.me, "host")
+                else:
+                    self._h_last_seen[in10[0] != rpc.MSG_NONE] = self._ticks
             with prof.phase("dispatch"):
                 rp = self._routed_plane
                 if self._backend == "python":

@@ -235,6 +235,13 @@ class HostIO:
         gi_loc, si_loc = np.nonzero(vals[0])
         if len(gi_loc):
             self._h_last_seen[G[gi_loc], si_loc] = self._ticks
+            if self._flight_wire:
+                # Wire trace (raft.flight_wire): inbox consumption — the
+                # same occupancy pass that stamped the liveness mirror.
+                self.flight.emit_many(
+                    self._wire_tick, "msg_delivered", G[gi_loc],
+                    vals[1][gi_loc, si_loc], vals[0][gi_loc, si_loc],
+                    si_loc, self.me, "host")
         prop_groups = list(self._prop_groups)
         if prop_groups:
             pg = np.asarray(prop_groups, np.int64)
@@ -307,6 +314,16 @@ class HostIO:
         z_all = (ov[6][ri, di].astype(i64) << 32) | ov[7][ri, di].astype(i64)
         g_all = np.asarray(groups)[ri].astype(np.intp)
         inc_all = self._h_ginc[g_all]
+        if self._flight_wire:
+            # Wire trace (raft.flight_wire): every host-decoded entry is a
+            # msg_sent on the host path — the columnar gather above already
+            # materialized exactly the columns the event carries, and
+            # routed rows were masked out before the nonzero pass, so the
+            # routed/host split in the journal matches the real delivery
+            # split. (The retained scalar reference decoder never emits:
+            # it exists for differential tests, not the product path.)
+            self.flight.emit_many(self._flight_tick(), "msg_sent",
+                                  g_all, t_all, k_all, self.me, di, "host")
 
         # AE entries with a non-empty span need chain payloads attached.
         # Group them per chain so each group's spans come from ONE bulk

@@ -111,6 +111,15 @@ class RouteFabric:
         self._staging_srcs: dict[int, dict[int, int]] = {}
         self._ready: dict[int, object] = {}
         self._ready_kinds: dict[int, np.ndarray] = {}
+        # Host (P, N) TERM mirrors beside the kind mirrors, maintained only
+        # while wire tracing is live (any registered engine has
+        # raft.flight_wire on — see _refresh_trace): the receiver's
+        # msg_delivered events need the routed rows' terms without a device
+        # fetch, and an untraced fabric must not pay the extra int32 plane
+        # per receiver at P=100k.
+        self._staging_terms: dict[int, np.ndarray] = {}
+        self._ready_terms: dict[int, np.ndarray] = {}
+        self.trace = False
         self.routed_total = 0
 
     # ------------------------------------------------------------- lifecycle
@@ -141,6 +150,13 @@ class RouteFabric:
         self._staging_srcs.pop(slot, None)
         self._ready.pop(slot, None)
         self._ready_kinds.pop(slot, None)
+        self._staging_terms.pop(slot, None)
+        self._ready_terms.pop(slot, None)
+        self._refresh_trace()
+
+    def _refresh_trace(self) -> None:
+        self.trace = any(getattr(e, "_flight_wire", False)
+                         for e in self.engines.values())
 
     def unregister(self, slot: int) -> None:
         """Remove a slot (membership removal / process stop): its pending
@@ -149,8 +165,10 @@ class RouteFabric:
         if e is not None and getattr(e, "_fabric", None) is self:
             e._fabric = None
         for store in (self._staging, self._staging_kinds, self._staging_srcs,
-                      self._ready, self._ready_kinds):
+                      self._ready, self._ready_kinds,
+                      self._staging_terms, self._ready_terms):
             store.pop(slot, None)
+        self._refresh_trace()
 
     def link_ok(self, src: int, dst: int) -> bool:
         return self.link_filter is None or bool(self.link_filter(src, dst))
@@ -209,8 +227,16 @@ class RouteFabric:
             # bucket position (rs); dense and sparse sources are the dense
             # (9, P, N) device outbox, indexed by group id.
             srows = rs if h["mode"] == "active" else gids[rs]
+            terms_col = ov[1][rs, d]
+            if engine._flight_wire:
+                # Wire trace: routed msg_sent, off the routed rows the
+                # decision table just selected (terms from the host-fetched
+                # compact outbox — no device read).
+                engine.flight.emit_many(
+                    engine._flight_tick(), "msg_sent", gids[rs], terms_col,
+                    kind[rs, d], engine.me, d, "routed")
             self._push(engine, d, src_ov, srows, gids[rs],
-                       kind[rs, d], d)
+                       kind[rs, d], terms_col, d)
         if not routed.any():
             return None
         self.routed_total += int(routed.sum())
@@ -234,7 +260,8 @@ class RouteFabric:
         h["_route_src"] = src
         return src
 
-    def _push(self, sender, slot, src_ov, srows, gs, kinds_col, dst) -> None:
+    def _push(self, sender, slot, src_ov, srows, gs, kinds_col, terms_col,
+              dst) -> None:
         """Scatter one sender→receiver routed row set into the receiver's
         staged plane (device for the jax backend, numpy for the scalar
         twin) and update the host kind mirror + per-src delivery counts."""
@@ -243,6 +270,12 @@ class RouteFabric:
             km = self._staging_kinds[slot] = np.zeros(
                 (self.P, self.N), np.int8)
         km[gs, sender.me] = kinds_col.astype(np.int8)
+        if self.trace:
+            tm = self._staging_terms.get(slot)
+            if tm is None:
+                tm = self._staging_terms[slot] = np.zeros(
+                    (self.P, self.N), np.int32)
+            tm[gs, sender.me] = terms_col.astype(np.int32)
         plane = self._staging.get(slot)
         if self.backend == "python":
             if plane is None:
@@ -282,6 +315,7 @@ class RouteFabric:
         for slot in list(self._staging):
             stg = self._staging.pop(slot, None)
             skm = self._staging_kinds.pop(slot, None)
+            stm = self._staging_terms.pop(slot, None)
             srcs = self._staging_srcs.pop(slot, None) or {}
             if stg is None or skm is None:
                 continue
@@ -292,17 +326,22 @@ class RouteFabric:
             if rdy is None:
                 self._ready[slot] = stg
                 self._ready_kinds[slot] = skm
+                if stm is not None:
+                    self._ready_terms[slot] = stm
             else:
                 # Two flushes without a consuming begin (skewed/stalled
                 # receiver): first writer keeps the slot, the later claim
                 # is dropped — pure FIFO message loss, Raft-tolerated.
                 rkm = self._ready_kinds[slot]
+                free = rkm == 0
                 if self.backend == "python":
-                    free = rkm == 0
                     rdy[:, free] = stg[:, free]
                 else:
                     self._ready[slot] = _merge_planes_fn(rdy, stg)
-                rkm[rkm == 0] = skm[rkm == 0]
+                rtm = self._ready_terms.get(slot)
+                if rtm is not None and stm is not None:
+                    rtm[free] = stm[free]
+                rkm[free] = skm[free]
             for s, cnt in srcs.items():
                 peer._h_src_seen[s] = peer._ticks
                 peer._c_in.inc(cnt)
@@ -311,12 +350,14 @@ class RouteFabric:
 
     def consume(self, slot: int):
         """Take the receiver's ready plane for this tick_begin: returns
-        (plane, kinds) — the device plane the routed step variants merge,
-        and the host (P, N) kind mirror backing occupancy/wake/stamping —
-        or (None, None) when nothing was routed."""
+        (plane, kinds, terms) — the device plane the routed step variants
+        merge, the host (P, N) kind mirror backing occupancy/wake/stamping,
+        and the term mirror when wire tracing is live (None otherwise) —
+        or (None, None, None) when nothing was routed."""
         plane = self._ready.pop(slot, None)
         kinds = self._ready_kinds.pop(slot, None)
-        return plane, kinds
+        terms = self._ready_terms.pop(slot, None)
+        return plane, kinds, terms
 
     def purge_group(self, slot: int, g: int, kinds=None) -> None:
         """Drop pending routed traffic for group ``g`` toward ``slot`` —
@@ -324,8 +365,9 @@ class RouteFabric:
         recycle (all kinds) and parole entry (election kinds only)."""
         sel_kinds = None if kinds is None else np.asarray(sorted(kinds),
                                                          np.int8)
-        for planes, mirrors in ((self._staging, self._staging_kinds),
-                                (self._ready, self._ready_kinds)):
+        for planes, mirrors, terms in (
+                (self._staging, self._staging_kinds, self._staging_terms),
+                (self._ready, self._ready_kinds, self._ready_terms)):
             km = mirrors.get(slot)
             if km is None:
                 continue
@@ -340,6 +382,9 @@ class RouteFabric:
                 planes[slot] = _purge_plane_row_fn(
                     plane, jnp.asarray(g, jnp.int32), jnp.asarray(~sel))
             row[sel] = 0
+            tm = terms.get(slot)
+            if tm is not None:
+                tm[g][sel] = 0
 
     # ------------------------------------------------------------------ stats
 

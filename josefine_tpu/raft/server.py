@@ -92,6 +92,7 @@ class JosefineRaft:
             active_set=config.active_set and mesh is None,
             mesh=mesh,
             flight_ring=getattr(config, "flight_ring", 4096),
+            flight_wire=getattr(config, "flight_wire", False),
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

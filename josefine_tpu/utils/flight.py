@@ -19,7 +19,15 @@ at host-visible consensus transitions:
 * ``pipeline_defer`` — a host-side message (snapshot chunk/ack) deferred
   because a pipelined dispatch was in flight;
 * ``backlog_drop`` — the per-src intake backlog cap discarding a stale
-  batch.
+  batch;
+* ``msg_sent`` / ``msg_delivered`` — wire-level trace events (config-gated,
+  ``raft.flight_wire``, default off): one event per consensus message at
+  the sender's outbox decision point (host decode or the RouteFabric's
+  device-resident scatter, ``detail.path`` tagging which) and at the
+  receiver's inbox consumption, carrying ``{kind, src, dst}`` in detail
+  and (group, term) in the event header — enough to resolve a send to its
+  delivery across node journals (:func:`merge_journals`,
+  tools/trace_report.py).
 
 Design constraints, in order:
 
@@ -41,15 +49,23 @@ from __future__ import annotations
 import json
 from collections import deque
 
-__all__ = ["FlightRecorder", "filter_events"]
+__all__ = ["FlightRecorder", "filter_events", "merge_journals",
+           "timeline_jsonl"]
 
 
 def filter_events(events, group: int | None = None, kind: str | None = None,
-                  limit: int | None = None) -> list:
+                  limit: int | None = None, since: int | None = None) -> list:
     """Shared journal filter (the recorder's ``events()`` and the
     MetricsServer ``/events`` query params are the same semantics, defined
-    once): optional group/kind match, then keep the newest ``limit``
+    once): optional group/kind match plus a ``since`` sequence cursor
+    (events STRICTLY after that seq — a poller resumes from the last seq it
+    saw instead of re-downloading the ring; a cursor that already scrolled
+    off the ring simply yields everything still held, and the seq gap tells
+    the poller how much it missed), then keep the newest ``limit``
     (``limit=0`` returns nothing, not everything)."""
+    if since is not None:
+        since = int(since)
+        events = (e for e in events if e.get("seq", 0) > since)
     if group is not None:
         events = (e for e in events if e.get("group") == group)
     if kind is not None:
@@ -99,15 +115,56 @@ class FlightRecorder:
         self.seq += 1
         self._ring.append(ev)
 
+    def emit_many(self, tick: int, kind: str, groups, terms, msg_kinds,
+                  srcs, dsts, path: str) -> None:
+        """Bulk wire-trace append (``msg_sent`` / ``msg_delivered``): one
+        event per entry of the position-aligned columns — the caller's
+        ALREADY-computed nonzero pass over an outbox/inbox plane, so the
+        emission adds no scan of its own. ``srcs``/``dsts`` may be scalars
+        (one endpoint is always "me"). Detail carries the message
+        ``{dst, kind, path, src}``; the event header carries (group, term)
+        so a send resolves to its delivery by (group, src, dst, kind,
+        term) across node journals."""
+        n = len(groups)
+        if not n:
+            return
+        src_col = srcs if hasattr(srcs, "__len__") else None
+        dst_col = dsts if hasattr(dsts, "__len__") else None
+        src_s = None if src_col is not None else int(srcs)
+        dst_s = None if dst_col is not None else int(dsts)
+        t = int(tick)
+        seq = self.seq
+        ring = self._ring
+        for i in range(n):
+            ring.append({
+                "seq": seq,
+                "tick": t,
+                "kind": kind,
+                "group": int(groups[i]),
+                "term": int(terms[i]),
+                "leader": -1,
+                "detail": {
+                    "dst": dst_s if dst_col is None else int(dst_col[i]),
+                    "kind": int(msg_kinds[i]),
+                    "path": path,
+                    "src": src_s if src_col is None else int(src_col[i]),
+                },
+            })
+            seq += 1
+        self.seq = seq
+
     def __len__(self) -> int:
         return len(self._ring)
 
     def events(self, limit: int | None = None, group: int | None = None,
-               kind: str | None = None) -> list[dict]:
+               kind: str | None = None,
+               since: int | None = None) -> list[dict]:
         """The journal (oldest first), optionally filtered; ``limit`` keeps
-        the newest N after filtering. Returns copies — callers may mutate."""
+        the newest N after filtering, ``since`` drops events at or before
+        that seq (the poller cursor). Returns copies — callers may mutate."""
         return [dict(e) for e in
-                filter_events(self._ring, group=group, kind=kind, limit=limit)]
+                filter_events(self._ring, group=group, kind=kind,
+                              limit=limit, since=since)]
 
     def tail(self, n: int = 32) -> list[dict]:
         return self.events(limit=n)
@@ -123,3 +180,68 @@ class FlightRecorder:
     def clear(self) -> None:
         self._ring.clear()
         self.seq = 0
+
+
+def _node_order(node):
+    """Numeric node names sort numerically (node "10" after node "2");
+    non-numeric names sort lexically after every numeric one."""
+    s = str(node)
+    try:
+        return (0, int(s), s)
+    except ValueError:
+        return (1, 0, s)
+
+
+def merge_journals(journals) -> list[dict]:
+    """Merge per-node flight journals into ONE cluster timeline.
+
+    ``journals`` maps a node name to that node's events — a list of event
+    dicts (``FlightRecorder.events()`` / ``ChaosCluster.flight_journals()``)
+    or a JSONL string (the soak artifact / ``--journals`` form). Each event
+    is copied with two annotations:
+
+    * ``node`` — the journal key it came from (str);
+    * ``epoch`` — how many ``boot`` markers (restart boundaries, the chaos
+      harness archives them with ``seq == -1``) precede it in its own
+      journal, so a crash/restart's tick-counter reset is visible to
+      readers.
+
+    Ordering is the deterministic merge rule: sort by ``(tick, node, seq)``
+    with a STABLE sort, nodes in numeric order. Ticks are each engine's own
+    device-tick clock — in lockstep drivers (the chaos harness) they
+    advance together, so the order is causally consistent: a message's
+    ``msg_sent`` (stamped at the sending tick's finish) always precedes its
+    ``msg_delivered`` (stamped at the consuming dispatch), and both precede
+    the state transitions that dispatch journals. Restart epochs fold back
+    to low ticks (an engine's clock restarts at 0); the ``epoch`` column is
+    how a reader keeps them apart. Two same-seed chaos runs merge to
+    byte-identical timelines (tests/test_chaos_determinism.py).
+    """
+    rows: list[tuple] = []
+    for node in sorted(journals, key=_node_order):
+        evs = journals[node]
+        if isinstance(evs, (str, bytes)):
+            if isinstance(evs, bytes):
+                evs = evs.decode()
+            evs = [json.loads(line) for line in evs.splitlines() if line]
+        epoch = 0
+        for ev in evs:
+            e = dict(ev)
+            e["node"] = str(node)
+            e["epoch"] = epoch
+            rows.append((e.get("tick", 0), _node_order(node),
+                         e.get("seq", 0), e))
+            if e.get("kind") == "boot":
+                epoch += 1
+    rows.sort(key=lambda r: r[:3])
+    return [r[3] for r in rows]
+
+
+def timeline_jsonl(timeline: list[dict]) -> str:
+    """JSONL form of a merged timeline (sorted keys, compact separators) —
+    byte-identical across same-seed runs, same contract as
+    :meth:`FlightRecorder.dump_jsonl`."""
+    return "\n".join(
+        json.dumps(e, sort_keys=True, separators=(",", ":"))
+        for e in timeline
+    ) + ("\n" if timeline else "")

@@ -427,7 +427,9 @@ class MetricsServer:
     supplied callback — the engine's per-group leader/term/commit view,
     replacing the reference's per-tick debug file), ``/events`` (the
     consensus flight-recorder journal from ``events_fn``; supports
-    ``?limit=N``, ``?kind=K``, ``?group=G`` filters), ``/healthz``.
+    ``?limit=N``, ``?kind=K``, ``?group=G`` filters and a ``?since=SEQ``
+    cursor — events strictly after that seq, so pollers resume instead of
+    re-downloading the ring), ``/healthz``.
     """
 
     def __init__(self, host: str, port: int,
@@ -480,6 +482,7 @@ class MetricsServer:
             kind=params.get("kind") or None,
             group=_int(params.get("group")),
             limit=limit if limit is not None and limit >= 0 else None,
+            since=_int(params.get("since")),
         )
         return json.dumps({"node": self.node, "events": events}).encode()
 

@@ -1,0 +1,217 @@
+"""Cluster timelines: merge ordering, wire-trace gating, and trace_report.
+
+The merge rule is the cluster-observability contract: per-node journals
+fold into ONE deterministically tie-broken (tick, node, seq) timeline, and
+the wire-level trace events (raft.flight_wire) let a reader follow a
+message sender→receiver across node journals. tools/trace_report.py builds
+the causal story of an invariant violation on top of exactly this.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.flight import (
+    FlightRecorder,
+    merge_journals,
+    timeline_jsonl,
+)
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+# ------------------------------------------------------------- merge rules
+
+
+def test_merge_orders_by_tick_then_node_then_seq():
+    a, b = FlightRecorder(), FlightRecorder()
+    a.emit(5, "x", group=0)      # (5, "0", 0)
+    a.emit(5, "y", group=0)      # (5, "0", 1)
+    a.emit(9, "z", group=0)      # (9, "0", 2)
+    b.emit(3, "w", group=1)      # (3, "1", 0)
+    b.emit(5, "v", group=1)      # (5, "1", 1)
+    tl = merge_journals({"1": b.events(), "0": a.events()})
+    assert [(e["tick"], e["node"], e["kind"]) for e in tl] == [
+        (3, "1", "w"), (5, "0", "x"), (5, "0", "y"), (5, "1", "v"),
+        (9, "0", "z")]
+    # Every event carries its source node and epoch annotations.
+    assert all(e["epoch"] == 0 for e in tl)
+
+
+def test_merge_node_order_is_numeric_not_lexical():
+    journals = {str(n): [{"seq": 0, "tick": 1, "kind": f"n{n}", "group": 0}]
+                for n in (2, 10, 1)}
+    tl = merge_journals(journals)
+    assert [e["kind"] for e in tl] == ["n1", "n2", "n10"]
+
+
+def test_merge_accepts_jsonl_strings_and_marks_epochs():
+    evs = [
+        {"seq": 0, "tick": 2, "kind": "election_won", "group": 0},
+        {"seq": -1, "tick": 7, "kind": "boot", "group": -1},
+        {"seq": 0, "tick": 1, "kind": "term_bump", "group": 0},
+    ]
+    jsonl = "".join(json.dumps(e) + "\n" for e in evs)
+    tl_from_str = merge_journals({"0": jsonl})
+    tl_from_list = merge_journals({"0": evs})
+    assert tl_from_str == tl_from_list
+    by_kind = {e["kind"]: e for e in tl_from_str}
+    # Pre-boot events are epoch 0, the boot marker closes it, the restarted
+    # engine's (tick-reset) events are epoch 1.
+    assert by_kind["election_won"]["epoch"] == 0
+    assert by_kind["boot"]["epoch"] == 0
+    assert by_kind["term_bump"]["epoch"] == 1
+
+
+def test_timeline_jsonl_is_byte_stable():
+    def build():
+        fr = FlightRecorder()
+        fr.emit(1, "a", group=0, extra=3)
+        fr.emit(2, "b", group=1)
+        return merge_journals({"0": fr.events()})
+
+    assert timeline_jsonl(build()) == timeline_jsonl(build())
+    assert timeline_jsonl([]) == ""
+    line = timeline_jsonl(build()).splitlines()[0]
+    ev = json.loads(line)
+    assert ev["node"] == "0" and ev["epoch"] == 0
+
+
+# --------------------------------------------------- wire tracing (engine)
+
+
+def _two_node_rig(flight_wire: bool):
+    engines = [RaftEngine(MemKV(), [1, 2], i + 1, groups=2, params=PARAMS,
+                          flight_wire=flight_wire) for i in range(2)]
+
+    def spin(n):
+        for _ in range(n):
+            for e in engines:
+                res = e.tick()
+                for m in res.outbound:
+                    engines[m.dst].receive(m)
+
+    return engines, spin
+
+
+def test_flight_wire_off_steady_state_emits_nothing():
+    """The overhead contract's zero side: with raft.flight_wire off, wire
+    traffic (heartbeats flow every tick at hb_ticks=1) journals NOTHING —
+    a quiet steady-state tick leaves the recorder untouched."""
+    engines, spin = _two_node_rig(flight_wire=False)
+    spin(30)  # settle: elections + their transitions
+    seqs = [e.flight.seq for e in engines]
+    spin(10)  # steady state, heartbeats + acks every tick
+    assert [e.flight.seq for e in engines] == seqs
+    assert all(not e.flight.events(kind="msg_sent") for e in engines)
+
+
+def test_flight_wire_on_traces_send_and_delivery():
+    engines, spin = _two_node_rig(flight_wire=True)
+    spin(30)
+    sent = engines[0].flight.events(kind="msg_sent")
+    assert sent, "leader/follower traffic must journal msg_sent"
+    # Every wire event carries the resolvable edge fields.
+    for ev in sent:
+        assert set(ev["detail"]) == {"dst", "kind", "path", "src"}
+        assert ev["detail"]["src"] == 0
+        assert ev["detail"]["path"] in ("host", "routed")
+    # A send from node slot 0 resolves to a delivery on node slot 1 with
+    # the same (group, src, dst, kind, term) key.
+    s = sent[-1]
+    key = (s["group"], s["term"], s["detail"]["kind"], s["detail"]["dst"])
+    deliveries = [
+        d for d in engines[1].flight.events(kind="msg_delivered")
+        if (d["group"], d["term"], d["detail"]["kind"],
+            d["detail"]["dst"]) == key and d["detail"]["src"] == 0
+    ]
+    assert deliveries, "no delivery matched the send"
+    # The merged timeline interleaves both journals deterministically and
+    # keeps each node's seq order.
+    tl = merge_journals({"0": engines[0].flight.events(),
+                         "1": engines[1].flight.events()})
+    for node in ("0", "1"):
+        seqs = [e["seq"] for e in tl if e["node"] == node]
+        assert seqs == sorted(seqs)
+
+
+# -------------------------------------------- violation artifact -> report
+
+
+def test_trace_report_reconstructs_causal_chain(tmp_path, monkeypatch):
+    """Acceptance bar: an injected-violation soak artifact (device routing
+    + wire traces on) yields a trace_report with send→deliver edges
+    resolved across nodes, BOTH delivery paths represented, and
+    deliver→state-change links on the violating group."""
+    from josefine_tpu.chaos import harness, invariants
+    from josefine_tpu.chaos.faults import NetFaults
+    from josefine_tpu.chaos.nemesis import Schedule, Step
+    from josefine_tpu.chaos.soak import run_soak
+
+    sched = Schedule(
+        "trace-short",
+        [Step(at=20, op="isolate", args={"target": "leader", "for": 15})],
+        horizon=60, heal_ticks=60)
+
+    calls = {"n": 0}
+    real = invariants.check_log_matching
+
+    def tripping(logs):
+        calls["n"] += 1
+        if calls["n"] >= 5:
+            raise invariants.InvariantViolation("injected (group 0)")
+        return real(logs)
+
+    monkeypatch.setattr(harness.invariants, "check_log_matching", tripping)
+    art = tmp_path / "artifact.json"
+    res = run_soak(7, sched, net=NetFaults.quiet(), device_route=True,
+                   flight_wire=True, artifact_path=str(art))
+    assert res["invariants"] == "VIOLATED"
+    assert art.exists()
+
+    import os
+    import sys
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import trace_report
+    finally:
+        sys.path.remove(tools_dir)
+
+    journals, meta = trace_report.load_journals(str(art))
+    assert meta["violation"] == "injected (group 0)"
+    report = trace_report.build_report(journals, violation=meta["violation"])
+    # Group inferred from the violation text.
+    assert report["group"] == 0
+    # Cross-node causal chain: resolved send→deliver edges on both paths.
+    resolved = [e for e in report["edges"] if e["sent"] is not None]
+    assert resolved, "no send→deliver edge resolved"
+    cross = [e for e in resolved
+             if e["sent"]["node"] != e["delivered"]["node"]]
+    assert cross, "edges must cross nodes"
+    paths = {e["path"] for e in resolved}
+    assert paths == {"routed", "host"}, paths
+    # Deliver→state-change links: some transition follows a delivery.
+    assert any(sc["after_delivery"] for sc in report["state_changes"])
+    # The partition dropped messages: unresolved sends are reported.
+    assert report["unresolved_sends"]
+    # Text rendering holds the summary lines.
+    text = trace_report.render_text(report)
+    assert "send->deliver edges resolved" in text
+    assert "state changes on the group" in text
+    # The artifact embeds the merged timeline + coverage alongside.
+    data = json.loads(art.read_text())
+    assert data["timeline"].splitlines()
+    assert data["coverage"]["signature"]
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -55,6 +55,18 @@ def main() -> int:
                          "partitions/crashes/noise force the host residual "
                          "path (pair with --quiet-net so a directive "
                          "schedule leaves clean links to route)")
+    ap.add_argument("--flight-wire", action="store_true",
+                    help="journal wire-level trace events (msg_sent/"
+                         "msg_delivered, path-tagged routed vs host) so the "
+                         "merged timeline carries the message path — the "
+                         "input tools/trace_report.py follows across nodes")
+    ap.add_argument("--coverage-out", default=None,
+                    help="write the run's journal-derived coverage map "
+                         "(features, class counts, signature) here as JSON "
+                         "— the scoring artifact for coverage-guided chaos")
+    ap.add_argument("--timeline", default=None,
+                    help="write the merged cluster timeline (JSONL, "
+                         "(tick, node, seq) ordered) here")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -113,7 +125,7 @@ def main() -> int:
         net=NetFaults.quiet() if args.quiet_net else None,
         auto_faults=args.auto_faults, active_set=args.active_set,
         hb_ticks=args.hb_ticks, device_route=args.device_route,
-        artifact_path=args.artifact)
+        flight_wire=args.flight_wire, artifact_path=args.artifact)
 
     if args.events:
         with open(args.events, "w") as fh:
@@ -121,15 +133,25 @@ def main() -> int:
     if args.journals:
         with open(args.journals, "w") as fh:
             json.dump(result["journals"], fh, indent=1)
+    if args.coverage_out:
+        with open(args.coverage_out, "w") as fh:
+            json.dump(result["coverage"], fh, indent=1)
+    if args.timeline:
+        with open(args.timeline, "w") as fh:
+            fh.write(result["timeline"])
     if args.dump_schedule:
         with open(args.dump_schedule, "w") as fh:
             fh.write(result["schedule_json"])
 
     summary = {k: result[k] for k in
                ("schedule", "seed", "nodes", "groups", "window",
-                "active_set", "device_route", "ticks", "proposed", "acked",
-                "fault_events", "chaos_counters", "invariants", "violation",
-                "artifact")}
+                "active_set", "device_route", "flight_wire", "ticks",
+                "proposed", "acked", "fault_events", "chaos_counters",
+                "invariants", "violation", "artifact")}
+    # Coverage epilogue: the signature a search driver would score this
+    # run by, plus the per-class distinct-feature counts behind it.
+    summary["coverage_signature"] = result["coverage_signature"]
+    summary["coverage_classes"] = result["coverage"]["class_counts"]
     if result.get("active_set_stats"):
         summary["active_set_stats"] = result["active_set_stats"]
     if result.get("device_route_stats"):

@@ -38,10 +38,22 @@ chaos_smoke() {
     # One short seeded nemesis schedule end-to-end through the soak CLI,
     # invariants enforced (exit 1 on any violation). Seed 7 + the bundled
     # leader-partition schedule is the canonical repro pair; --horizon
-    # shortens the chaotic phase to fit the smoke budget.
+    # shortens the chaotic phase to fit the smoke budget. The run also
+    # writes its journal-derived coverage map (--coverage-out) and the
+    # signature must be non-empty — the scoring artifact the nemesis
+    # search driver will consume must never silently degrade to nothing.
     echo "== chaos smoke =="
     python tools/chaos_soak.py --seed 7 --schedule leader-partition \
-        --horizon 200
+        --horizon 200 --flight-wire --coverage-out /tmp/ci_chaos_cov.json
+    python - <<'PYEOF'
+import json
+cov = json.load(open("/tmp/ci_chaos_cov.json"))
+assert cov["signature"], "chaos smoke produced an EMPTY coverage signature"
+assert cov["class_counts"].get("kgram", 0) > 0, cov["class_counts"]
+assert cov["class_counts"].get("path_mix", 0) > 0, \
+    "flight-wire smoke journaled no msg_sent events"
+print("coverage ok:", cov["signature"][:16], cov["class_counts"])
+PYEOF
 }
 
 chaos_smoke_active_set() {
@@ -133,7 +145,8 @@ else
     python -m pytest tests/test_device_route.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
-        tests/test_flight.py tests/test_reset_safety.py -q
+        tests/test_flight.py tests/test_flight_merge.py \
+        tests/test_coverage.py tests/test_reset_safety.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route

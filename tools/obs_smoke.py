@@ -9,7 +9,11 @@ HTTP GETs:
   (``raft_commit_latency_ticks_bucket``/``_sum``/``_count``) and the
   scheduler/pipeline gauges, node-scoped;
 * ``/events`` serves the flight-recorder journal and it contains the
-  election the engine just ran;
+  election the engine just ran, and the ``?since=<seq>`` cursor resumes a
+  poller strictly after that seq instead of re-serving the ring;
+* the journal-derived coverage gauges
+  (``chaos_coverage_features{class=...}``, utils/coverage.py) expose
+  node-scoped after a publish;
 * ``/state`` and ``/healthz`` still answer.
 
 Exit 0 on success, 1 on any failed assertion. Runs on the CPU backend.
@@ -95,6 +99,34 @@ async def main() -> int:
         assert len(payload["events"]) == 1
         assert payload["events"][0]["kind"] == "election_won"
 
+        # ?since= cursor: events strictly after the seq; chaining from the
+        # last seen seq yields nothing new on a quiet engine.
+        status, body = await _get(port, "/events")
+        all_events = json.loads(body)["events"]
+        cut = all_events[len(all_events) // 2]["seq"]
+        status, body = await _get(port, f"/events?since={cut}")
+        after = json.loads(body)["events"]
+        assert after and all(e["seq"] > cut for e in after), \
+            "since cursor must return strictly-later events"
+        assert after == [e for e in all_events if e["seq"] > cut]
+        last = all_events[-1]["seq"]
+        status, body = await _get(port, f"/events?since={last}")
+        assert json.loads(body)["events"] == [], "cursor at head: no events"
+
+        # Coverage exposition: distill the journal into a CoverageMap and
+        # assert the per-class gauges land on the node-scoped endpoint.
+        from josefine_tpu.utils.coverage import CoverageMap
+        from josefine_tpu.utils.flight import merge_journals
+
+        cov = CoverageMap.from_timeline(
+            merge_journals({"1": engine.flight.events()}))
+        assert cov.signature(), "engine journal produced no coverage"
+        cov.publish(node=1)
+        status, body = await _get(port, "/metrics")
+        text = body.decode()
+        assert 'chaos_coverage_features{class="ev",node="1"}' in text, \
+            "coverage gauges missing from /metrics"
+
         status, body = await _get(port, "/state")
         assert json.loads(body)["groups_led"] == 2
 
@@ -106,6 +138,7 @@ async def main() -> int:
     lat = engine.commit_latency()
     print(json.dumps({"ok": True, "committed": committed,
                       "journal_events": len(engine.flight),
+                      "coverage_signature": cov.signature(),
                       "commit_latency": lat}))
     return 0
 

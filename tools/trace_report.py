@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Reconstruct the causal story of a chaos finding from flight journals.
+
+A tripped invariant used to leave N disjoint per-node event rings; this
+tool merges them into one cluster timeline (utils/flight.merge_journals),
+resolves wire-level send→deliver edges across nodes (the ``msg_sent`` /
+``msg_delivered`` events ``raft.flight_wire`` journals, path-tagged
+``routed`` vs ``host``), links deliveries to the state transitions they
+triggered, and prints the last K cross-node events touching the violating
+group — the causal chain a human debugs from.
+
+Usage:
+    python tools/trace_report.py chaos_artifact_leader-partition_7.json
+    python tools/trace_report.py artifact.json --group 1 --last 60 \
+        --json report.json
+    python tools/trace_report.py --journals journals.json   # soak --journals
+    python tools/trace_report.py --journals dumpdir/        # <node>.jsonl files
+
+The artifact form is what ``chaos_soak.py`` auto-dumps on an invariant
+violation (it embeds per-node journals, the violation text, and the fault
+event log); ``--journals`` takes either the ``--journals`` JSON a clean
+soak writes (node -> JSONL) or a directory of ``<node>.jsonl`` files.
+Without ``--group`` the violating group is parsed from the artifact's
+violation text, falling back to the group with the latest state change.
+
+Exit code 0 with a report; 2 on unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from josefine_tpu.utils.flight import merge_journals  # noqa: E402
+
+# Transitions worth calling out as the chain's "state change" links.
+STATE_KINDS = frozenset((
+    "election_won", "election_lost", "leadership_lost", "leader_change",
+    "term_bump", "snapshot_install", "group_reset", "group_recycled",
+    "parole_lifted", "active_mode_flip", "boot",
+))
+
+WIRE_KINDS = frozenset(("msg_sent", "msg_delivered"))
+
+# Device message-kind names (models/types.py values), for readable output.
+MSG_NAMES = {1: "VOTE_REQ", 2: "VOTE_RESP", 3: "APPEND", 4: "APPEND_RESP",
+             5: "PREVOTE_REQ", 6: "PREVOTE_RESP"}
+
+
+def load_journals(source: str) -> tuple[dict[str, object], dict]:
+    """Load (journals, meta) from an artifact JSON, a journals JSON
+    (node -> JSONL), or a directory of <node>.jsonl files. ``meta`` carries
+    whatever context rode along (violation, schedule, seed, tick)."""
+    if os.path.isdir(source):
+        journals: dict[str, object] = {}
+        for name in sorted(os.listdir(source)):
+            if name.endswith(".jsonl"):
+                with open(os.path.join(source, name)) as fh:
+                    journals[name[:-len(".jsonl")]] = fh.read()
+        return journals, {}
+    with open(source) as fh:
+        data = json.load(fh)
+    if "journals" in data:
+        meta = {k: data[k] for k in
+                ("violation", "schedule", "seed", "tick") if k in data}
+        return data["journals"], meta
+    # A bare journals map (node -> JSONL or node -> [events]).
+    return data, {}
+
+
+def _infer_group(timeline: list[dict], violation: str | None) -> int | None:
+    """The violating group: parsed from the violation text when present
+    (invariant messages name it as ``group N`` / ``g=N``), else the group
+    of the latest state transition in the timeline."""
+    if violation:
+        m = re.search(r"g(?:roup)?[ =](\d+)", violation)
+        if m:
+            return int(m.group(1))
+    for ev in reversed(timeline):
+        if ev.get("kind") in STATE_KINDS and int(ev.get("group", -1)) >= 0:
+            return int(ev["group"])
+    return None
+
+
+def _edge_key(ev: dict) -> tuple:
+    d = ev.get("detail") or {}
+    return (ev.get("group"), d.get("src"), d.get("dst"), d.get("kind"),
+            ev.get("term"))
+
+
+def _ref(ev: dict) -> dict:
+    return {"node": ev.get("node"), "tick": ev.get("tick"),
+            "seq": ev.get("seq"), "epoch": ev.get("epoch", 0)}
+
+
+def build_report(journals, group: int | None = None, last: int = 40,
+                 violation: str | None = None) -> dict:
+    """The whole analysis as data: the merged timeline's tail for the
+    chosen group, resolved send→deliver edges, the deliveries feeding each
+    state change, and a path/coverage summary. ``journals`` is any mapping
+    merge_journals accepts."""
+    timeline = merge_journals(journals)
+    if group is None:
+        group = _infer_group(timeline, violation)
+    if group is None:
+        raise ValueError("no --group given and none inferable from the "
+                         "violation text or timeline")
+    gevs = [ev for ev in timeline if int(ev.get("group", -2)) == group]
+
+    # Send→deliver resolution over the FULL group slice (not just the
+    # displayed tail): FIFO-match each delivery to the earliest unmatched
+    # send with the same (group, src, dst, msg-kind, term). Sends that
+    # never match are the dropped / still-in-flight messages — under a
+    # partition schedule that set IS the fault's footprint.
+    pending: dict[tuple, list[dict]] = {}
+    edges: list[dict] = []
+    unresolved: list[dict] = []
+    last_delivery_at: dict[str, dict] = {}  # node -> latest delivery event
+    state_changes: list[dict] = []
+    for ev in gevs:
+        kind = ev.get("kind")
+        if kind == "msg_sent":
+            pending.setdefault(_edge_key(ev), []).append(ev)
+        elif kind == "msg_delivered":
+            q = pending.get(_edge_key(ev))
+            sent = q.pop(0) if q else None
+            d = ev.get("detail") or {}
+            edges.append({
+                "group": group,
+                "src": d.get("src"), "dst": d.get("dst"),
+                "msg_kind": MSG_NAMES.get(d.get("kind"), d.get("kind")),
+                "term": ev.get("term"),
+                "path": d.get("path"),
+                "sent": _ref(sent) if sent else None,
+                "delivered": _ref(ev),
+            })
+            last_delivery_at[str(ev.get("node"))] = ev
+        elif kind in STATE_KINDS:
+            trigger = last_delivery_at.get(str(ev.get("node")))
+            state_changes.append({
+                "event": {k: ev.get(k) for k in
+                          ("kind", "group", "term", "leader", "detail")},
+                "at": _ref(ev),
+                # The delivery that fed this node last before the
+                # transition — the deliver→state-change edge.
+                "after_delivery": _ref(trigger) if trigger else None,
+            })
+    for q in pending.values():
+        unresolved.extend(q)
+
+    paths = {}
+    for ev in gevs:
+        if ev.get("kind") in WIRE_KINDS:
+            p = (ev.get("detail") or {}).get("path", "?")
+            k = f'{ev["kind"]}:{p}'
+            paths[k] = paths.get(k, 0) + 1
+    return {
+        "group": group,
+        "violation": violation,
+        "events_total": len(timeline),
+        "group_events_total": len(gevs),
+        "tail": gevs[-last:],
+        "edges": edges,
+        "unresolved_sends": [
+            {**_ref(ev), "dst": (ev.get("detail") or {}).get("dst"),
+             "msg_kind": MSG_NAMES.get((ev.get("detail") or {}).get("kind")),
+             "path": (ev.get("detail") or {}).get("path"),
+             "term": ev.get("term")}
+            for ev in unresolved],
+        "state_changes": state_changes,
+        "path_counts": dict(sorted(paths.items())),
+    }
+
+
+def render_text(report: dict) -> str:
+    """Human form of :func:`build_report`: the tail as one line per event,
+    edges resolved inline, then the summary."""
+    lines = [f"== trace report: group {report['group']} =="]
+    if report.get("violation"):
+        lines.append(f"violation: {report['violation']}")
+    lines.append(f"cluster timeline: {report['events_total']} events, "
+                 f"{report['group_events_total']} touching this group; "
+                 f"showing the last {len(report['tail'])}")
+    delivered_seqs = {(e["delivered"]["node"], e["delivered"]["seq"]): e
+                      for e in report["edges"]}
+    for ev in report["tail"]:
+        d = ev.get("detail") or {}
+        base = (f"[t{ev.get('tick'):>5} n{ev.get('node')} "
+                f"seq{ev.get('seq'):>6}] {ev.get('kind'):<16}")
+        if ev.get("kind") in WIRE_KINDS:
+            name = MSG_NAMES.get(d.get("kind"), d.get("kind"))
+            base += (f" {name} {d.get('src')}->{d.get('dst')} "
+                     f"term={ev.get('term')} path={d.get('path')}")
+            edge = delivered_seqs.get((ev.get("node"), ev.get("seq")))
+            if edge and edge.get("sent"):
+                s = edge["sent"]
+                base += f"  <= sent t{s['tick']} n{s['node']} seq{s['seq']}"
+        else:
+            base += (f" term={ev.get('term')} leader={ev.get('leader')}"
+                     + (f" {d}" if d else ""))
+        lines.append(base)
+    lines.append(f"-- send->deliver edges resolved: {len(report['edges'])} "
+                 f"(paths: {report['path_counts']})")
+    if report["unresolved_sends"]:
+        lines.append(f"-- sends never delivered: "
+                     f"{len(report['unresolved_sends'])} "
+                     "(dropped by faults or still in flight)")
+    lines.append(f"-- state changes on the group: "
+                 f"{len(report['state_changes'])}")
+    for sc in report["state_changes"][-8:]:
+        at, ev = sc["at"], sc["event"]
+        line = (f"   t{at['tick']:>5} n{at['node']}: {ev['kind']} "
+                f"term={ev['term']} leader={ev['leader']}")
+        if sc["after_delivery"]:
+            ad = sc["after_delivery"]
+            line += f"  (after delivery t{ad['tick']} seq{ad['seq']})"
+        lines.append(line)
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("artifact", nargs="?", default=None,
+                    help="soak violation artifact JSON (chaos_soak.py "
+                         "--artifact / auto-dump)")
+    ap.add_argument("--journals", default=None,
+                    help="journals JSON (node -> JSONL) or a directory of "
+                         "<node>.jsonl files, instead of an artifact")
+    ap.add_argument("--group", type=int, default=None,
+                    help="group to follow (default: inferred from the "
+                         "violation text, else the latest state change)")
+    ap.add_argument("--last", type=int, default=40,
+                    help="events of the causal tail to show (default 40)")
+    ap.add_argument("--json", default=None,
+                    help="also write the full report as JSON here")
+    args = ap.parse_args()
+
+    source = args.journals or args.artifact
+    if source is None:
+        print("need an artifact path or --journals", file=sys.stderr)
+        return 2
+    try:
+        journals, meta = load_journals(source)
+    except (OSError, ValueError) as e:
+        print(f"cannot load {source!r}: {e}", file=sys.stderr)
+        return 2
+    if not journals:
+        print(f"no journals in {source!r}", file=sys.stderr)
+        return 2
+    try:
+        report = build_report(journals, group=args.group, last=args.last,
+                              violation=meta.get("violation"))
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    for k in ("schedule", "seed", "tick"):
+        if k in meta:
+            report[k] = meta[k]
+    print(render_text(report))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
