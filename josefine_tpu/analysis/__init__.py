@@ -1,0 +1,21 @@
+"""graftlint — project-specific static analysis (``python -m
+josefine_tpu.analysis`` or ``tools/lint.py``).
+
+Four rule families enforce the disciplines the stack depends on but could
+previously only state in prose: determinism on the journaled planes, jit
+recompile/bucket discipline, host-mirror coherence at out-of-tick mutation
+sites, and non-blocking async request paths.  See
+ARCHITECTURE.md "Static analysis & code discipline" for the rule
+vocabulary, pragma format, and baseline-ratchet semantics.
+"""
+
+from josefine_tpu.analysis.core import (  # noqa: F401
+    Checker,
+    Finding,
+    all_rules,
+    collect_findings,
+    default_checkers,
+    load_baseline,
+    main,
+    write_baseline,
+)

@@ -1,0 +1,8 @@
+"""``python -m josefine_tpu.analysis`` — run graftlint."""
+
+import sys
+
+from josefine_tpu.analysis.core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

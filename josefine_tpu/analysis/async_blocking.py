@@ -1,0 +1,140 @@
+"""graftlint async family — the event loop must never block.
+
+One synchronous sleep or disk wait inside an ``async def`` stalls every
+connection the loop serves: at broker scale (thousands of producers
+long-polling Fetch) a 10 ms blocking call is a cluster-wide latency cliff,
+and inside the raft server loop it stretches device ticks.  The rules scan
+the async surfaces (``raft/server.py``, ``raft/tcp.py``, ``broker/``):
+
+* ``async-blocking-sleep`` — ``time.sleep`` in a coroutine (use
+  ``await asyncio.sleep``).
+* ``async-blocking-io`` — direct file/process/socket blocking calls in a
+  coroutine (``open``, ``os.fsync``, ``sqlite3.connect``,
+  ``subprocess.run``, ``Path.read_text``, ...).  Offload to
+  ``asyncio.to_thread`` / ``run_in_executor`` — the blocking call then
+  lives in a sync callable, which this rule deliberately does not enter.
+* ``async-raw-kv`` — direct ``kv.get/put/delete/...`` calls in a
+  coroutine: the KV is sqlite under a lock (``utils/kv.py``), so raw use
+  on a request path serializes the loop on disk.  Replicated-store access
+  belongs behind the FSM/store layer, whose synchronous apply path is a
+  design decision (commit-time determinism), not an accident.
+
+Nested synchronous ``def``/``lambda`` bodies inside a coroutine are NOT
+flagged: they execute wherever they are called, and the offload idioms
+(``to_thread(lambda: ...)``) depend on exactly that distinction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from josefine_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    collect_import_aliases,
+    dotted_name,
+    enclosing_functions,
+)
+
+_BLOCKING_CALLS = {
+    "open", "io.open",
+    "os.fsync", "os.sync",
+    "sqlite3.connect",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "socket.create_connection", "socket.getaddrinfo",
+    "shutil.copy", "shutil.copytree", "shutil.rmtree", "shutil.move",
+}
+
+_BLOCKING_PATH_METHODS = {"read_text", "write_text", "read_bytes",
+                          "write_bytes", "unlink", "mkdir"}
+
+_KV_METHODS = {"get", "put", "delete", "put_many", "scan", "keys",
+               "commit", "flush", "close"}
+_KV_NAMES = {"kv", "_kv"}
+
+
+class AsyncBlockingChecker(Checker):
+    name = "async-blocking"
+    scope = (
+        "josefine_tpu/raft/server.py",
+        "josefine_tpu/raft/tcp.py",
+        "josefine_tpu/broker/",
+    )
+    rules = {
+        "async-blocking-sleep":
+            "time.sleep inside a coroutine stalls the event loop",
+        "async-blocking-io":
+            "blocking file/process/socket call inside a coroutine",
+        "async-raw-kv":
+            "raw KV (sqlite-under-lock) access inside a coroutine",
+    }
+
+    def check(self, module: Module) -> list[Finding]:
+        aliases = collect_import_aliases(module.tree)
+        ctx = enclosing_functions(module.tree)
+        findings: list[Finding] = []
+
+        def emit(node: ast.AST, rule: str, message: str, hint: str) -> None:
+            findings.append(Finding(
+                file=module.rel, line=node.lineno, rule=rule,
+                message=message, hint=hint, context=ctx.get(node, ""),
+                snippet=module.snippet(node.lineno)))
+
+        def visit(node: ast.AST, in_async: bool) -> None:
+            """One pass over the module: the flag tracks the INNERMOST
+            enclosing function kind — an async def sets it, a sync def or
+            lambda clears it (their bodies run wherever they are called,
+            which is what the to_thread/run_in_executor offload idioms
+            rely on), and a coroutine nested anywhere (including inside a
+            sync factory inside another coroutine) sets it again."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.AsyncFunctionDef):
+                    visit(child, True)
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.Lambda)):
+                    visit(child, False)
+                    continue
+                if in_async and isinstance(child, ast.Call):
+                    self._check_call(child, aliases, emit)
+                visit(child, in_async)
+
+        visit(module.tree, False)
+        return findings
+
+    def _check_call(self, node: ast.Call, aliases, emit) -> None:
+        fn = dotted_name(node.func, aliases)
+        if fn == "time.sleep":
+            emit(node, "async-blocking-sleep",
+                 "time.sleep() blocks the event loop",
+                 "use `await asyncio.sleep(...)`")
+            return
+        if fn in _BLOCKING_CALLS:
+            emit(node, "async-blocking-io",
+                 f"{fn}() blocks the event loop",
+                 "offload with `await asyncio.to_thread(...)` or move the "
+                 "I/O to a sync helper invoked off-loop")
+            return
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            if attr in _BLOCKING_PATH_METHODS:
+                base = dotted_name(node.func.value, aliases) or ""
+                if base.startswith("pathlib.") or base.endswith("Path"):
+                    emit(node, "async-blocking-io",
+                         f"Path.{attr}() blocks the event loop",
+                         "offload with `await asyncio.to_thread(...)`")
+                    return
+            if attr in _KV_METHODS:
+                base = node.func.value
+                base_leaf = None
+                if isinstance(base, ast.Name):
+                    base_leaf = base.id
+                elif isinstance(base, ast.Attribute):
+                    base_leaf = base.attr
+                if base_leaf in _KV_NAMES:
+                    emit(node, "async-raw-kv",
+                         f"raw KV .{attr}() on a coroutine path serializes "
+                         "the loop on sqlite",
+                         "go through the store/FSM layer, or offload with "
+                         "`await asyncio.to_thread(...)`")

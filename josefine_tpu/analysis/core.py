@@ -1,0 +1,529 @@
+"""graftlint core — the framework half of the project lint suite.
+
+The checkers in the sibling modules (determinism, jit_discipline, mirror,
+async_blocking) encode disciplines ARCHITECTURE.md *states* but nothing
+enforced until now: byte-identical same-seed journals, bounded jit
+recompile shapes, host-mirror coherence at out-of-tick mutation sites, and
+non-blocking async request paths.  This module owns everything rule-agnostic:
+
+* :class:`Finding` — one violation, carrying ``file:line``, rule id, a fix
+  hint, and a line-number-insensitive fingerprint (file + rule + enclosing
+  qualname + normalized source line) so baseline entries survive unrelated
+  edits above them;
+* pragma suppression — ``# graftlint: allow(rule-id) — reason`` on the
+  offending line or the line above.  The reason is MANDATORY: a pragma
+  without one suppresses nothing and is itself reported
+  (``pragma-missing-reason``), so every waiver in the tree carries its
+  justification next to the code it excuses;
+* the baseline ratchet — ``tools/lint_baseline.json`` holds findings
+  explicitly judged acceptable (each with a written reason).  New findings
+  fail; baseline entries can only shrink (a stale entry is reported as
+  ratchet progress, never an error).  ``--write-baseline`` regenerates the
+  file from the current tree, preserving reasons by fingerprint — the same
+  contract as ``perf_smoke --write-floor``;
+* the runner/CLI (``tools/lint.py`` / ``python -m josefine_tpu.analysis``):
+  with no arguments each checker scans its configured scope; explicit
+  in-repo paths keep their checkers' scoping (a single-file pre-commit
+  lint matches the full run), while out-of-tree files run every family
+  (how CI proves a seeded violation of each family fails with the right
+  rule id and location).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+
+# repo root = two levels above josefine_tpu/analysis/
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+DEFAULT_BASELINE = os.path.join("tools", "lint_baseline.json")
+
+PRAGMA_MISSING_REASON = "pragma-missing-reason"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*allow\(\s*([A-Za-z0-9_,\-\s]*?)\s*\)\s*(.*)$")
+# Separator between the rule list and the justification: em dash, one or
+# more hyphens, or a colon.  The reason is whatever non-empty text follows.
+_REASON_SEP_RE = re.compile(r"^(?:—|:|-+)\s*")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    file: str          # repo-relative path
+    line: int          # 1-indexed
+    rule: str
+    message: str
+    hint: str = ""
+    context: str = ""  # enclosing function qualname ("" = module level)
+    snippet: str = ""  # stripped source line (fingerprint input)
+
+    def fingerprint(self) -> str:
+        key = "|".join((self.file, self.rule, self.context, self.snippet))
+        return hashlib.sha1(key.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        out = f"{self.file}:{self.line}: {self.rule}: {self.message}"
+        if self.hint:
+            out += f"\n    fix: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+
+@dataclasses.dataclass
+class Module:
+    """A parsed source file handed to checkers."""
+
+    rel: str           # repo-relative path (forward slashes)
+    path: str          # absolute path
+    tree: ast.AST
+    source: str
+    lines: list[str]
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class Checker:
+    """Base class: a named rule family with a default path scope.
+
+    ``scope`` entries are repo-relative prefixes; entries ending in ``/``
+    match whole directories, others match one file.  In explicit-paths mode
+    the runner bypasses scoping so seeded-violation fixtures exercise every
+    family at once.
+    """
+
+    name: str = ""
+    rules: dict[str, str] = {}
+    scope: tuple[str, ...] = ()
+
+    def in_scope(self, rel: str) -> bool:
+        for s in self.scope:
+            if s.endswith("/"):
+                if rel.startswith(s):
+                    return True
+            elif rel == s:
+                return True
+        return False
+
+    def prepare(self, modules: list[Module]) -> None:
+        """Optional cross-module pass (e.g. the jit builder registry)."""
+
+    def check(self, module: Module) -> list[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------- AST utils
+
+
+def collect_import_aliases(tree: ast.AST) -> dict[str, str]:
+    """Map local names to the dotted path they were imported as.
+
+    ``import numpy as np`` -> ``{"np": "numpy"}``;
+    ``from time import monotonic as mono`` -> ``{"mono": "time.monotonic"}``.
+    """
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def dotted_name(node: ast.AST, aliases: dict[str, str] | None = None) -> str | None:
+    """Resolve ``Name``/``Attribute`` chains to a dotted string, mapping the
+    root through import aliases (``np.random`` -> ``numpy.random``)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = node.id
+    if aliases and root in aliases:
+        root = aliases[root]
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def enclosing_functions(tree: ast.AST) -> dict[ast.AST, str]:
+    """Map every node to its enclosing function qualname ('' at module
+    level) in one walk."""
+    out: dict[ast.AST, str] = {}
+
+    def visit(node: ast.AST, stack: tuple[str, ...]):
+        out[node] = ".".join(stack)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                visit(child, stack + (child.name,))
+            else:
+                visit(child, stack)
+
+    visit(tree, ())
+    return out
+
+
+# ----------------------------------------------------------------- pragmas
+
+
+def scan_pragmas(lines: list[str]) -> dict[int, tuple[frozenset[str], str]]:
+    """Return {1-indexed line: (allowed rule ids, reason)} for every
+    ``# graftlint: allow(...)`` comment."""
+    out: dict[int, tuple[frozenset[str], str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _PRAGMA_RE.search(line)
+        if m is None:
+            continue
+        rules = frozenset(
+            r.strip() for r in m.group(1).split(",") if r.strip())
+        rest = m.group(2).strip()
+        sep = _REASON_SEP_RE.match(rest)
+        reason = rest[sep.end():].strip() if sep else ""
+        out[i] = (rules, reason)
+    return out
+
+
+def apply_pragmas(module: Module,
+                  findings: list[Finding]) -> list[Finding]:
+    """Drop findings waived by a justified pragma on the same or previous
+    line; report reasonless pragmas as findings themselves."""
+    pragmas = scan_pragmas(module.lines)
+    kept: list[Finding] = []
+    for f in findings:
+        suppressed = False
+        for ln in (f.line, f.line - 1):
+            p = pragmas.get(ln)
+            if p is not None and f.rule in p[0] and p[1]:
+                suppressed = True
+                break
+        if not suppressed:
+            kept.append(f)
+    for ln, (rules, reason) in sorted(pragmas.items()):
+        if not reason or not rules:
+            kept.append(Finding(
+                file=module.rel, line=ln, rule=PRAGMA_MISSING_REASON,
+                message="graftlint pragma without a justification "
+                        "suppresses nothing",
+                hint="write `# graftlint: allow(rule-id) — <why this is "
+                     "acceptable>`; the reason is mandatory",
+                context="", snippet=module.snippet(ln)))
+    return kept
+
+
+# ------------------------------------------------------------------ runner
+
+
+def default_checkers() -> list[Checker]:
+    # Imported here so `from josefine_tpu.analysis import core` stays cheap
+    # and the sibling modules can import core freely.
+    from josefine_tpu.analysis.async_blocking import AsyncBlockingChecker
+    from josefine_tpu.analysis.determinism import DeterminismChecker
+    from josefine_tpu.analysis.jit_discipline import JitDisciplineChecker
+    from josefine_tpu.analysis.mirror import MirrorCoherenceChecker
+
+    return [DeterminismChecker(), JitDisciplineChecker(),
+            MirrorCoherenceChecker(), AsyncBlockingChecker()]
+
+
+def all_rules(checkers: list[Checker] | None = None) -> dict[str, str]:
+    rules = {PRAGMA_MISSING_REASON:
+             "a graftlint pragma must carry a justification"}
+    for c in checkers or default_checkers():
+        rules.update(c.rules)
+    return rules
+
+
+def _iter_py_files(root: str, prefixes: set[str]) -> list[str]:
+    """All .py files under ``root`` that fall inside any checker scope."""
+    out = []
+    for prefix in sorted(prefixes):
+        full = os.path.join(root, prefix)
+        if prefix.endswith("/"):
+            for dirpath, _dirnames, filenames in os.walk(full):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.exists(full):
+            out.append(full)
+    return sorted(set(out))
+
+
+def load_modules(paths: list[str], root: str = REPO_ROOT) -> list[Module]:
+    mods = []
+    for path in paths:
+        apath = os.path.abspath(path)
+        rel = os.path.relpath(apath, root).replace(os.sep, "/")
+        if rel.startswith("../"):
+            rel = apath.replace(os.sep, "/")  # outside the repo: absolute
+        with open(apath, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            tree = ast.parse(source, filename=apath)
+        except SyntaxError as e:
+            # Syntax errors are the basic lint stage's job (pyflakes /
+            # compileall); report one finding and move on.
+            mods.append(Module(rel, apath, ast.parse(""), source,
+                               source.splitlines()))
+            mods[-1].tree = None  # type: ignore[assignment]
+            mods[-1].syntax_error = e  # type: ignore[attr-defined]
+            continue
+        mods.append(Module(rel, apath, tree, source, source.splitlines()))
+    return mods
+
+
+def collect_findings(paths: list[str] | None = None,
+                     root: str = REPO_ROOT,
+                     checkers: list[Checker] | None = None) -> list[Finding]:
+    """Run every checker; returns pragma-filtered findings sorted by
+    location.  ``paths=None`` scans each checker's configured scope.
+    Explicit paths are linted individually — in-repo files keep their
+    checkers' scoping (so `tools/lint.py josefine_tpu/broker/groups.py`
+    matches what the full run says about that file, instead of false
+    positives from families that were never meant to see broker code),
+    while out-of-tree files (scratch fixtures, CI violation seeds) run
+    every family."""
+    checkers = checkers if checkers is not None else default_checkers()
+    explicit = bool(paths)
+    if explicit:
+        files = []
+        for p in paths or []:
+            if os.path.isdir(p):
+                for dirpath, _dirnames, filenames in os.walk(p):
+                    files.extend(os.path.join(dirpath, fn)
+                                 for fn in sorted(filenames)
+                                 if fn.endswith(".py"))
+            else:
+                files.append(p)
+    else:
+        prefixes: set[str] = set()
+        for c in checkers:
+            prefixes.update(c.scope)
+        files = _iter_py_files(root, prefixes)
+    modules = load_modules(files, root=root)
+
+    findings: list[Finding] = []
+    for mod in modules:
+        if getattr(mod, "syntax_error", None) is not None:
+            e = mod.syntax_error  # type: ignore[attr-defined]
+            findings.append(Finding(
+                file=mod.rel, line=int(e.lineno or 1), rule="syntax-error",
+                message=f"file does not parse: {e.msg}",
+                hint="fix the syntax error; graftlint skipped this file"))
+    modules = [m for m in modules if getattr(m, "syntax_error", None) is None]
+
+    def applies(checker: Checker, mod: Module) -> bool:
+        if checker.in_scope(mod.rel):
+            return True
+        # Out-of-tree files (rel stayed absolute) get every family in
+        # explicit mode; in-tree files keep their scoping.
+        return explicit and mod.rel.startswith("/")
+
+    for checker in checkers:
+        in_scope = [m for m in modules if applies(checker, m)]
+        if not in_scope:
+            continue
+        checker.prepare(in_scope)
+        for mod in in_scope:
+            findings.extend(apply_pragmas(mod, checker.check(mod)))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    # De-dup: two checkers (or pragma passes) may report the identical
+    # finding; identity is the full tuple, not the fingerprint.
+    seen: set[Finding] = set()
+    uniq = []
+    for f in findings:
+        if f not in seen:
+            seen.add(f)
+            uniq.append(f)
+    return uniq
+
+
+# ---------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str) -> dict[str, dict]:
+    """{fingerprint: entry}.  A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {e["fingerprint"]: e for e in data.get("entries", [])}
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   old: dict[str, dict] | None = None) -> list[dict]:
+    """Regenerate the ratchet file from the current findings, preserving
+    reasons for fingerprints that survive.  Returns the entries written."""
+    old = old or {}
+    by_fp: dict[str, dict] = {}
+    for f in findings:
+        fp = f.fingerprint()
+        e = by_fp.get(fp)
+        if e is not None:
+            # Identical violation lines in one function share a
+            # fingerprint: the entry carries a COUNT so a copy-pasted
+            # duplicate still fails the ratchet.
+            e["count"] += 1
+            continue
+        by_fp[fp] = {
+            "fingerprint": fp,
+            "rule": f.rule,
+            "file": f.file,
+            "line": f.line,
+            "context": f.context,
+            "snippet": f.snippet,
+            "count": 1,
+            "reason": old.get(fp, {}).get("reason", ""),
+        }
+    entries = sorted(by_fp.values(),
+                     key=lambda e: (e["file"], e["rule"], e["line"]))
+    payload = {
+        "_comment": (
+            "graftlint ratchet: findings explicitly judged acceptable, each "
+            "with a written reason. New findings fail CI; this file may only "
+            "shrink. Regenerate with `python tools/lint.py --write-baseline` "
+            "(reasons are preserved by fingerprint; fill in any new ones)."),
+        "version": 1,
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return entries
+
+
+def apply_baseline(findings: list[Finding], baseline: dict[str, dict]):
+    """Split findings into (new, baselined) and report ratchet state:
+    returns (new, baselined, stale_entries, reasonless_entries).
+
+    Entries are count-aware: an entry accepts at most ``count`` (default 1)
+    occurrences of its fingerprint, so a copy-pasted duplicate of a
+    baselined violation is NEW, not silently absorbed."""
+    new, baselined = [], []
+    remaining = {fp: int(e.get("count", 1)) for fp, e in baseline.items()}
+    matched: set[str] = set()
+    for f in findings:
+        fp = f.fingerprint()
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            matched.add(fp)
+            baselined.append(f)
+        else:
+            new.append(f)
+    # Count-aware staleness: an entry with unfired headroom (count=2 but
+    # only one occurrence left) must prompt a --write-baseline too —
+    # otherwise the spare slot silently absorbs a reintroduced duplicate.
+    stale = [e for fp, e in baseline.items() if remaining.get(fp, 0) > 0]
+    reasonless = [e for fp, e in baseline.items()
+                  if fp in matched and not e.get("reason")]
+    return new, baselined, stale, reasonless
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="Project static analysis: determinism, jit discipline, "
+                    "mirror coherence, async blocking.")
+    ap.add_argument("paths", nargs="*",
+                    help="explicit files to lint (every rule family runs on "
+                         "each); default: each checker's configured scope")
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO_ROOT, DEFAULT_BASELINE),
+                    help="ratchet file (default tools/lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the ratchet file from the current "
+                         "findings (reasons preserved by fingerprint)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(all_rules().items()):
+            print(f"{rule:28s} {desc}")
+        return 0
+
+    if args.write_baseline and args.paths and os.path.abspath(
+            args.baseline) == os.path.join(REPO_ROOT, DEFAULT_BASELINE):
+        print("graftlint: refusing --write-baseline for explicit paths "
+              "against the tree ratchet (it would drop every other "
+              "entry); pass --baseline <file> for a scoped baseline")
+        return 2
+
+    findings = collect_findings(args.paths or None, root=args.root)
+
+    if args.write_baseline:
+        old = load_baseline(args.baseline)
+        entries = write_baseline(args.baseline, findings, old)
+        missing = [e for e in entries if not e["reason"]]
+        print(f"graftlint: wrote {len(entries)} baseline entr"
+              f"{'y' if len(entries) == 1 else 'ies'} to {args.baseline}")
+        if missing:
+            print(f"graftlint: {len(missing)} entr"
+                  f"{'y needs' if len(missing) == 1 else 'ies need'} a "
+                  "written reason before the lint passes:")
+            for e in missing:
+                print(f"  {e['file']}:{e['line']}: {e['rule']}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, baselined, stale, reasonless = apply_baseline(findings, baseline)
+    if args.paths:
+        # Explicit-paths mode scans a subset of the tree: absent baseline
+        # entries say nothing about the ratchet shrinking.
+        stale = []
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.as_dict() for f in new],
+            "baselined": [f.as_dict() for f in baselined],
+            "stale_baseline": stale,
+            "reasonless_baseline": reasonless,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"graftlint: {len(stale)} baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} no longer fire"
+                  f"{'s' if len(stale) == 1 else ''} — ratchet can shrink "
+                  "(rerun with --write-baseline):")
+            for e in stale:
+                print(f"  {e['file']}: {e['rule']} ({e['fingerprint']})")
+        if reasonless:
+            print(f"graftlint: {len(reasonless)} baseline entr"
+                  f"{'y' if len(reasonless) == 1 else 'ies'} lack a written "
+                  "reason (every accepted finding must be justified):")
+            for e in reasonless:
+                print(f"  {e['file']}:{e.get('line', '?')}: {e['rule']}")
+        summary = (f"graftlint: {len(new)} new finding"
+                   f"{'' if len(new) == 1 else 's'}, "
+                   f"{len(baselined)} baselined")
+        print(summary)
+
+    return 1 if new or reasonless else 0

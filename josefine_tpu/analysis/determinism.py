@@ -1,0 +1,250 @@
+"""graftlint determinism family — keep the journaled planes replayable.
+
+The chaos substrate's whole scoring story (ROADMAP "coverage-guided
+adversarial chaos", arxiv 2601.00273) rests on same-seed runs journaling
+byte-identically: the flight recorder is tick-indexed and wall-clock-free,
+the fault plane draws from one seeded RNG, and coverage signatures hash the
+covered set.  One wall-clock read or unseeded draw on those paths degrades
+every signature silently.  This family scans the journal-feeding modules
+(``raft/``, ``chaos/``, ``utils/flight.py``, ``utils/coverage.py``) plus the
+broker product path that mints proposals (``broker/``) for:
+
+* ``det-wallclock`` — ``time.time``/``time.monotonic``/``time.perf_counter``
+  (and ``_ns`` forms) / ``datetime.now`` reads.  Event-loop time
+  (``loop.time()``) is deliberately NOT flagged: server timeouts are
+  driver-plane, not journal-plane, and the chaos harness already virtualizes
+  them.  Deadline state that must be chaos-drivable belongs behind an
+  injectable clock (see ``broker/groups.py``).
+* ``det-unseeded-rng`` — ``random.Random()`` with no seed, and any call
+  through the process-global ``random.*`` functions (shared, unseedable
+  without cross-module action at a distance).
+* ``det-np-global-rng`` — any use of the legacy global ``np.random`` plane;
+  seeded ``np.random.Generator`` objects come from ``default_rng(seed)``
+  handles, never the module singleton.
+* ``det-urandom`` — ``os.urandom`` (kernel entropy; unreplayable).
+* ``det-set-iter`` — iterating a value of provably-set provenance (set
+  literals/constructors/set-operator results, or a local assigned one)
+  without ``sorted()``.  Sets hash-randomize string iteration order across
+  processes, so any journaled or wire-visible ordering derived from one
+  diverges run-to-run.  Dict iteration is NOT flagged: Python dicts are
+  insertion-ordered, so nondeterminism can only enter at a nondeterministic
+  *insertion*, which is what the other rules catch.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from josefine_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    collect_import_aliases,
+    dotted_name,
+    enclosing_functions,
+)
+
+_WALLCLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+_GLOBAL_RANDOM = {
+    "random.random", "random.randint", "random.randrange", "random.choice",
+    "random.choices", "random.shuffle", "random.sample", "random.uniform",
+    "random.getrandbits", "random.randbytes", "random.seed",
+    "random.gauss", "random.expovariate",
+}
+
+_SET_METHODS = {"intersection", "union", "difference",
+                "symmetric_difference"}
+
+# The explicitly-seeded numpy RNG surface — the blessed replacement for the
+# global plane, so the rule must never flag it.
+_NP_SEEDED_RNG = (
+    "numpy.random.default_rng", "numpy.random.Generator",
+    "numpy.random.SeedSequence", "numpy.random.BitGenerator",
+    "numpy.random.PCG64", "numpy.random.Philox", "numpy.random.MT19937",
+    "numpy.random.SFC64",
+)
+
+
+def _is_set_expr(node: ast.AST, env: dict[str, bool],
+                 aliases: dict[str, str]) -> bool:
+    """Conservative set-provenance predicate: only flags values we can
+    PROVE are sets from local evidence (no cross-function inference)."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return env.get(node.id, False)
+    if isinstance(node, ast.IfExp):
+        return (_is_set_expr(node.body, env, aliases)
+                or _is_set_expr(node.orelse, env, aliases))
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitAnd, ast.BitOr, ast.BitXor, ast.Sub)):
+        # set operators preserve setness when either side is a known set
+        return (_is_set_expr(node.left, env, aliases)
+                or _is_set_expr(node.right, env, aliases))
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func, aliases)
+        if fn in ("set", "frozenset"):
+            return True
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr in _SET_METHODS:
+                return True
+    return False
+
+
+class DeterminismChecker(Checker):
+    name = "determinism"
+    scope = (
+        "josefine_tpu/raft/",
+        "josefine_tpu/chaos/",
+        "josefine_tpu/broker/",
+        "josefine_tpu/utils/flight.py",
+        "josefine_tpu/utils/coverage.py",
+    )
+    rules = {
+        "det-wallclock":
+            "wall-clock read in a journal-feeding module",
+        "det-unseeded-rng":
+            "unseeded random.Random() or process-global random.* call",
+        "det-np-global-rng":
+            "use of the global np.random plane",
+        "det-urandom":
+            "os.urandom draws unreplayable kernel entropy",
+        "det-uuid":
+            "uuid1/uuid4 draw kernel entropy — fine for identity labels, "
+            "never for decisions",
+        "det-set-iter":
+            "iteration over a set without sorted() — order is "
+            "hash-randomized across processes",
+    }
+
+    def check(self, module: Module) -> list[Finding]:
+        aliases = collect_import_aliases(module.tree)
+        ctx = enclosing_functions(module.tree)
+        findings: list[Finding] = []
+
+        def emit(node: ast.AST, rule: str, message: str, hint: str) -> None:
+            findings.append(Finding(
+                file=module.rel, line=node.lineno, rule=rule,
+                message=message, hint=hint, context=ctx.get(node, ""),
+                snippet=module.snippet(node.lineno)))
+
+        # ---- call-shaped rules -------------------------------------------
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func, aliases)
+            if fn is None:
+                continue
+            if fn in _WALLCLOCK:
+                emit(node, "det-wallclock",
+                     f"{fn}() is a wall-clock read on a journaled path",
+                     "derive time from device ticks / the driver's virtual "
+                     "clock, or take an injectable clock callable "
+                     "(clock=time.monotonic) so chaos can freeze it")
+            elif fn == "random.Random" and not node.args and not node.keywords:
+                emit(node, "det-unseeded-rng",
+                     "random.Random() without a seed breaks same-seed "
+                     "reproducibility",
+                     "seed from cluster config (e.g. "
+                     "random.Random(config.seed)) or thread an existing "
+                     "seeded rng through")
+            elif fn in _GLOBAL_RANDOM:
+                emit(node, "det-unseeded-rng",
+                     f"{fn}() uses the process-global RNG (unseeded, shared "
+                     "across modules)",
+                     "draw from a per-component random.Random(seed) instance")
+            elif fn == "os.urandom":
+                emit(node, "det-urandom",
+                     "os.urandom() is kernel entropy — unreplayable",
+                     "derive bytes from the component's seeded RNG "
+                     "(rng.randbytes)")
+            elif fn in ("uuid.uuid4", "uuid.uuid1"):
+                emit(node, "det-uuid",
+                     f"{fn}() is kernel entropy on a scanned path",
+                     "if this names an entity (an identity label that "
+                     "never drives a decision or a journaled value), waive "
+                     "with a pragma saying so; if it drives control flow, "
+                     "derive it from the component's seeded RNG")
+
+        # ---- np.random attribute plane -----------------------------------
+        # Outermost chains only (an Attribute that is itself the .value of
+        # another Attribute is an inner link — reporting it too would
+        # double-count every `np.random.x` hit), and the seeded-Generator
+        # constructors are exempt: they are the fix the rule recommends.
+        inner = {id(a.value) for a in ast.walk(module.tree)
+                 if isinstance(a, ast.Attribute)}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Attribute) and id(node) not in inner:
+                fn = dotted_name(node, aliases)
+                if fn is None or not (fn == "numpy.random"
+                                      or fn.startswith("numpy.random.")):
+                    continue
+                if fn.startswith(_NP_SEEDED_RNG):
+                    continue
+                emit(node, "det-np-global-rng",
+                     f"{fn} is the process-global numpy RNG",
+                     "use np.random.default_rng(seed) held by the "
+                     "component, never the module singleton")
+
+        # ---- set iteration (per-function local provenance) ----------------
+        self._check_set_iteration(module, aliases, ctx, findings)
+        return findings
+
+    def _check_set_iteration(self, module: Module, aliases, ctx,
+                             findings: list[Finding]) -> None:
+        hint = ("wrap the iterable in sorted(...) or iterate a list with a "
+                "deterministic construction order; set order is "
+                "hash-randomized")
+
+        def emit(node: ast.AST) -> None:
+            findings.append(Finding(
+                file=module.rel, line=node.lineno, rule="det-set-iter",
+                message="iteration order over a set is not deterministic "
+                        "across processes",
+                hint=hint, context=ctx.get(node, ""),
+                snippet=module.snippet(node.lineno)))
+
+        def scan_scope(body: list[ast.stmt]) -> None:
+            """One function (or module) scope: track local set provenance,
+            flag unsorted iteration.  Nested defs get their own scope."""
+            env: dict[str, bool] = {}
+
+            def walk(node: ast.AST) -> None:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_scope(node.body)
+                    return
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    env[node.targets[0].id] = _is_set_expr(
+                        node.value, env, aliases)
+                if isinstance(node, ast.For) and _is_set_expr(
+                        node.iter, env, aliases):
+                    emit(node.iter)
+                if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                     ast.DictComp)):
+                    # SetComp over a set is exempt: the result is itself
+                    # unordered, so iteration order cannot leak through it.
+                    for gen in node.generators:
+                        if _is_set_expr(gen.iter, env, aliases):
+                            emit(gen.iter)
+                if isinstance(node, ast.Call):
+                    fn = dotted_name(node.func, aliases)
+                    if fn == "iter" and len(node.args) == 1 and \
+                            _is_set_expr(node.args[0], env, aliases):
+                        # next(iter(s)) picks an arbitrary element — the
+                        # one-element form of the same hazard.
+                        emit(node)
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+
+            for stmt in body:
+                walk(stmt)
+
+        scan_scope(module.tree.body)

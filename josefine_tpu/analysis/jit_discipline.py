@@ -1,0 +1,367 @@
+"""graftlint jit family — bounded recompiles, no tracer leaks, one backend.
+
+The engine's tick dispatch survives at P=100k because every compiled shape
+is drawn from a coarse ladder: power-of-two active-set buckets
+(``packed_step.active_bucket``), powers-of-eight route-scatter buckets
+(``packed_step.route_bucket``), and window lengths clamped to
+``hb_ticks``.  A single call site that feeds a raw count into a jit builder
+compiles a fresh XLA program per distinct value — invisible in tests
+(small P, few ticks) and catastrophic in a soak.  Likewise a ``float()`` on
+a traced value aborts tracing at runtime, and silent ``np.``/``jnp.``
+mixing constant-folds device work onto the host.  This family makes those
+disciplines machine-checked over the jit-reachable modules
+(``packed_step.py``, ``engine.py``, ``route.py``, ``parallel/``).
+
+Traced-function discovery is module-local and conservative: seeds are
+functions decorated with ``@jax.jit`` (or ``partial(jax.jit, ...)``) and
+names passed to ``jax.jit`` / ``jax.vmap`` / ``jax.pmap`` /
+``jax.lax.scan`` / ``shard_map``; traced-ness propagates through
+module-local calls (so shared helpers like ``_flat_outputs`` are held to
+the same rules as the functions that trace them).
+
+Rules:
+
+* ``jit-tracer-leak`` — ``int()``/``float()``/``bool()`` on a non-literal,
+  or ``.item()``/``.tolist()``, inside a traced function: forces a host
+  sync (or a ConcretizationTypeError) at trace time.
+* ``jit-host-np`` — ``np.*`` inside a traced function that does not take an
+  ``xp`` backend parameter (the blessed dual-backend idiom: the python twin
+  passes ``np``, the kernel passes ``jnp``).  Dtype/constant attributes
+  (``np.int32`` etc.) are exempt — they are plain objects, not array ops.
+* ``jit-uncached-builder`` — a parameterized function that constructs
+  ``jax.jit(...)`` without ``functools.lru_cache``: every call builds a new
+  closure identity and XLA compiles it from scratch.
+* ``jit-unbucketed-shape`` — a call to a registered jit builder (an
+  lru_cached function containing ``jax.jit``, discovered across the
+  scanned modules) whose shape-feeding argument is a raw computation
+  (``len(...)``, arithmetic, an un-provenanced local) instead of a value
+  routed through an approved bucket helper (``active_bucket`` /
+  ``route_bucket``), a constant, an attribute (engine dims are fixed at
+  init), or a plain parameter (validated at ITS call site).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from josefine_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    collect_import_aliases,
+    dotted_name,
+    enclosing_functions,
+)
+
+_TRACE_WRAPPERS = {
+    "jax.jit", "jax.vmap", "jax.pmap", "jax.lax.scan",
+    "jax.experimental.shard_map.shard_map", "shard_map", "_shard_map",
+}
+
+_CACHE_DECORATORS = {"functools.lru_cache", "functools.cache",
+                     "lru_cache", "cache"}
+
+_BUCKET_HELPERS = {"active_bucket", "route_bucket"}
+
+# numpy attributes that are plain objects (dtypes/constants), not host ops.
+_NP_BENIGN = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_", "intp",
+    "ndarray", "dtype", "newaxis", "pi", "inf", "nan",
+}
+
+
+def _func_params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> set[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _decorator_names(fn, aliases) -> set[str]:
+    out = set()
+    for dec in fn.decorator_list:
+        d = dotted_name(dec, aliases)
+        if d:
+            out.add(d)
+        if isinstance(dec, ast.Call):
+            d = dotted_name(dec.func, aliases)
+            if d:
+                out.add(d)
+            # @functools.partial(jax.jit, ...) — the partial's first arg
+            if d in ("functools.partial", "partial") and dec.args:
+                inner = dotted_name(dec.args[0], aliases)
+                if inner:
+                    out.add(inner)
+    return out
+
+
+class _ModuleIndex:
+    """Per-module function table, traced set, and local call graph."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.aliases = collect_import_aliases(module.tree)
+        # leaf name -> list of def nodes (collisions kept; conservative)
+        self.defs: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+        self.traced: set[ast.AST] = set()
+        self._seed()
+        self._propagate()
+
+    def _seed(self) -> None:
+        aliases = self.aliases
+        for name, nodes in self.defs.items():
+            for fn in nodes:
+                decs = _decorator_names(fn, aliases)
+                if decs & _TRACE_WRAPPERS:
+                    self.traced.add(fn)
+        for node in ast.walk(self.module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = dotted_name(node.func, aliases)
+            if fn in _TRACE_WRAPPERS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in self.defs:
+                    self.traced.update(self.defs[arg.id])
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in list(self.traced):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and \
+                            isinstance(node.func, ast.Name) and \
+                            node.func.id in self.defs:
+                        for callee in self.defs[node.func.id]:
+                            if callee not in self.traced:
+                                self.traced.add(callee)
+                                changed = True
+
+    def cached_jit_builders(self) -> set[str]:
+        """Names of lru_cached functions whose body constructs jax.jit —
+        the approved shape-parameterized builder pattern."""
+        out = set()
+        for name, nodes in self.defs.items():
+            for fn in nodes:
+                if not (_decorator_names(fn, self.aliases)
+                        & _CACHE_DECORATORS):
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and dotted_name(
+                            node.func, self.aliases) == "jax.jit":
+                        out.add(name)
+                        break
+        return out
+
+
+class JitDisciplineChecker(Checker):
+    name = "jit-discipline"
+    scope = (
+        "josefine_tpu/raft/packed_step.py",
+        "josefine_tpu/raft/engine.py",
+        "josefine_tpu/raft/route.py",
+        "josefine_tpu/parallel/",
+    )
+    rules = {
+        "jit-tracer-leak":
+            "host cast (int/float/bool/.item/.tolist) on a traced value",
+        "jit-host-np":
+            "np.* inside traced code without the xp backend parameter",
+        "jit-uncached-builder":
+            "parameterized jax.jit builder without functools.lru_cache",
+        "jit-unbucketed-shape":
+            "jit-builder call fed a raw count instead of a bucket-helper "
+            "value",
+    }
+
+    def __init__(self):
+        self._builders: set[str] = set()
+        self._indexes: dict[str, _ModuleIndex] = {}
+
+    def prepare(self, modules: list[Module]) -> None:
+        self._builders = set()
+        self._indexes = {}
+        for mod in modules:
+            idx = _ModuleIndex(mod)
+            self._indexes[mod.rel] = idx
+            self._builders |= idx.cached_jit_builders()
+
+    def check(self, module: Module) -> list[Finding]:
+        idx = self._indexes.get(module.rel) or _ModuleIndex(module)
+        ctx = enclosing_functions(module.tree)
+        findings: list[Finding] = []
+
+        def emit(node: ast.AST, rule: str, message: str, hint: str) -> None:
+            findings.append(Finding(
+                file=module.rel, line=node.lineno, rule=rule,
+                message=message, hint=hint, context=ctx.get(node, ""),
+                snippet=module.snippet(node.lineno)))
+
+        for fn in idx.traced:
+            self._check_traced_fn(fn, idx, emit)
+        self._check_builders_cached(module, idx, emit)
+        self._check_builder_call_sites(module, idx, emit)
+        return findings
+
+    # ---- inside traced functions -----------------------------------------
+
+    def _walk_own(self, fn: ast.AST, idx: _ModuleIndex):
+        """Walk a traced function's own body, skipping nested defs (they
+        are visited separately iff themselves traced) and signature
+        annotations (evaluated at def time, not traced)."""
+
+        def gen(node: ast.AST):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                yield child
+                yield from gen(child)
+
+        for stmt in fn.body:
+            yield stmt
+            yield from gen(stmt)
+
+    def _check_traced_fn(self, fn, idx: _ModuleIndex, emit) -> None:
+        params = _func_params(fn)
+        has_xp = "xp" in params
+        own_nodes = list(self._walk_own(fn, idx))
+        # Outermost attribute chains only: `np.linalg.norm` is ONE
+        # violation, not one per dotted level.
+        inner_attrs = {id(n.value) for n in own_nodes
+                       if isinstance(n, ast.Attribute)}
+        for node in own_nodes:
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func, idx.aliases)
+                if name in ("int", "float", "bool") and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant):
+                    emit(node, "jit-tracer-leak",
+                         f"{name}() on a traced value forces a host sync "
+                         "inside jit",
+                         "keep the value on device (jnp ops / .astype) or "
+                         "hoist the cast outside the traced function")
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("item", "tolist") and \
+                        not node.args:
+                    emit(node, "jit-tracer-leak",
+                         f".{node.func.attr}() materializes a traced value "
+                         "on host",
+                         "return the array and convert outside the traced "
+                         "function")
+            if not has_xp and isinstance(node, ast.Attribute) and \
+                    id(node) not in inner_attrs:
+                name = dotted_name(node, idx.aliases)
+                if name and (name == "numpy"
+                             or name.startswith("numpy.")):
+                    leaf = name.split(".", 1)[1] if "." in name else ""
+                    if leaf.split(".")[0] in _NP_BENIGN:
+                        continue
+                    emit(node, "jit-host-np",
+                         f"{name} in traced code runs on host and "
+                         "constant-folds into the compiled program",
+                         "use jnp here, or take an `xp` backend parameter "
+                         "(the dual-backend idiom) if this helper serves "
+                         "both engines")
+
+    # ---- builder caching --------------------------------------------------
+
+    def _check_builders_cached(self, module: Module, idx: _ModuleIndex,
+                               emit) -> None:
+        for name, fns in idx.defs.items():
+            for fn in fns:
+                if not _func_params(fn):
+                    continue
+                if _decorator_names(fn, idx.aliases) & _CACHE_DECORATORS:
+                    continue
+                for node in self._walk_own(fn, idx):
+                    if isinstance(node, ast.Call) and dotted_name(
+                            node.func, idx.aliases) == "jax.jit":
+                        emit(node, "jit-uncached-builder",
+                             f"{name}() builds jax.jit per call — every "
+                             "invocation compiles a fresh XLA program",
+                             "decorate the builder with "
+                             "@functools.lru_cache(maxsize=None) so "
+                             "compiled programs are shared per shape key")
+                        break
+
+    # ---- builder call-site bucket discipline -------------------------------
+
+    def _approved_arg(self, arg: ast.AST, approved_names: set[str]) -> bool:
+        if isinstance(arg, ast.Constant):
+            return True
+        if isinstance(arg, ast.Attribute):
+            return True  # engine dims (self.P/self.N/self._k_out): fixed
+            # at init or grown through the sparse capacity ladder
+        if isinstance(arg, ast.Name):
+            return arg.id in approved_names
+        if isinstance(arg, ast.Call):
+            fn = arg.func
+            leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            return leaf in _BUCKET_HELPERS
+        if isinstance(arg, ast.UnaryOp):
+            return self._approved_arg(arg.operand, approved_names)
+        if isinstance(arg, ast.Starred):
+            return True  # *args forwarding — validated where built
+        return False
+
+    def _check_builder_call_sites(self, module: Module, idx: _ModuleIndex,
+                                  emit) -> None:
+        if not self._builders:
+            return
+
+        def scan_scope(fn_node, body):
+            approved: set[str] = set(
+                _func_params(fn_node)) if fn_node is not None else set()
+            # first pass: local provenance (order-insensitive on purpose —
+            # assignment position vs use position doesn't matter for a
+            # conservative approval set)
+            def collect(node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    if self._approved_arg(node.value, approved):
+                        approved.add(node.targets[0].id)
+                for child in ast.iter_child_nodes(node):
+                    collect(child)
+
+            # run to fixpoint: `a = active_bucket(...)` then `b = a`
+            before = -1
+            while len(approved) != before:
+                before = len(approved)
+                for stmt in body:
+                    collect(stmt)
+
+            def walk(node):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    scan_scope(node, node.body)
+                    return
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name) and \
+                        node.func.id in self._builders:
+                    call_args = list(node.args) + [kw.value
+                                                   for kw in node.keywords]
+                    for arg in call_args:
+                        if not self._approved_arg(arg, approved):
+                            emit(arg, "jit-unbucketed-shape",
+                                 f"{node.func.id}() fed a raw shape "
+                                 "value — every distinct value compiles "
+                                 "a new XLA program",
+                                 "route counts through active_bucket()/"
+                                 "route_bucket() (the approved ladders) "
+                                 "before they reach a jit builder")
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+
+            for stmt in body:
+                walk(stmt)
+
+        scan_scope(None, module.tree.body)

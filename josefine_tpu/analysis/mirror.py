@@ -1,0 +1,189 @@
+"""graftlint mirror family — host mirrors move only where the tick knows.
+
+The active-set scheduler (PR 4) and device router (PR 6) both judge the
+world from host mirrors (``_h_role``/``_h_head``/``_h_elapsed``/...): the
+wake predicate, the decay twin, and the tick_finish diff all assume the
+mirrors equal the device state at tick boundaries.  ``tick_finish``'s
+need-mask deliberately skips quiet rows, so it will NOT heal a mirror an
+out-of-tick mutation leaves stale — a drifted mirror misroutes the
+active-row diff forever (the INVARIANT comment in
+``group_admin._reset_group``).  The discipline, stated in ARCHITECTURE.md
+and enforced here:
+
+* ``mirror-unlisted-write`` — assignments to ``_h_*`` mirrors or
+  ``.state`` (the device-state handle) are only legal inside the reviewed
+  method set below (the tick path, intake stamping, and the four audited
+  out-of-tick mutators).  A new mutation site is a design event: extend the
+  allowlist in the same PR that reviews its coherence story, or refactor
+  the write into an existing audited site.
+* ``mirror-unpaired-mutation`` — an out-of-tick method that moves
+  device-visible mirror rows (role/head/commit/term/timers) or ``.state``
+  must also register the row with the active-set scheduler
+  (``_force_active``) or purge the routing fabric — otherwise a quiescent
+  row steps through the decay closed form over state the mutation just
+  invalidated (exactly the PR 4/6 recycle/snapshot/fixup rule).
+
+Intake-bookkeeping mirrors (``_h_src_seen``/``_h_last_seen``/``_h_ginc``)
+are covered by the write allowlist but exempt from the pairing rule: they
+feed freshness/ISR accounting, not the device-state diff.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from josefine_tpu.analysis.core import (
+    Checker,
+    Finding,
+    Module,
+    enclosing_functions,
+)
+
+# Mirrors whose drift misroutes the scheduler/diff (pairing rule applies).
+_DEVICE_MIRRORS = {
+    "_h_term", "_h_voted", "_h_role", "_h_leader", "_h_head", "_h_commit",
+    "_h_elapsed", "_h_timeout", "_h_hb", "_h_alive", "state",
+}
+
+# (module basename, enclosing function) pairs reviewed for coherence.
+# Adding an entry is a statement that the new site's mirror story has been
+# audited — do it in the PR that introduces the site.
+_WRITE_ALLOWLIST = {
+    # engine tick path + intake
+    ("engine.py", "__init__"),
+    ("engine.py", "receive"),
+    ("engine.py", "_receive_batch"),
+    ("engine.py", "tick_begin"),
+    ("engine.py", "_decay_mirrors"),
+    ("engine.py", "_tick_finish"),
+    # dense-fallback re-entry refetches the timer mirrors from device
+    # (PR 4 post-review: predicate must judge post-step roles)
+    ("engine.py", "_schedule_active"),
+    # audited out-of-tick mutators (each pairs with _force_active/purge)
+    ("group_admin.py", "set_group_incarnation"),
+    ("group_admin.py", "recycle_group"),
+    ("group_admin.py", "_reset_group"),
+    ("snap_transfer.py", "_adopt_snapshot"),
+    ("hostio.py", "_drain_nxt_fixups"),
+    # builder-side intake stamps (tick path, split into mixin helpers)
+    ("hostio.py", "_pack_inbox_rows"),
+    # fabric flush does receive()'s intake bookkeeping for routed rows
+    ("route.py", "flush"),
+}
+
+# Tick-path methods: mirror writes here ARE the coherence protocol, so the
+# pairing rule does not apply.
+_TICK_EXEMPT = {
+    "__init__", "tick_begin", "tick_fetch", "_tick_finish",
+    "_decay_mirrors", "receive", "_receive_batch",
+}
+
+
+def _written_attr(target: ast.AST) -> tuple[str, ast.AST] | None:
+    """If ``target`` writes an attribute (directly or through a
+    subscript), return (attr name, node)."""
+    node = target
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        return node.attr, node
+    return None
+
+
+def _is_mirror_attr(attr: str) -> bool:
+    return attr.startswith("_h_") or attr == "state"
+
+
+class MirrorCoherenceChecker(Checker):
+    name = "mirror-coherence"
+    scope = ("josefine_tpu/raft/", "josefine_tpu/parallel/")
+    rules = {
+        "mirror-unlisted-write":
+            "host-mirror/device-state write outside the audited method set",
+        "mirror-unpaired-mutation":
+            "out-of-tick mirror mutation without _force_active / fabric "
+            "purge pairing",
+    }
+
+    def check(self, module: Module) -> list[Finding]:
+        ctx = enclosing_functions(module.tree)
+        base = module.rel.rsplit("/", 1)[-1]
+        findings: list[Finding] = []
+
+        # ---- rule 1: every mirror write must be in the allowlist ---------
+        writes_by_fn: dict[str, list[tuple[str, ast.AST]]] = {}
+        for node in ast.walk(module.tree):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                w = _written_attr(t)
+                if w is None or not _is_mirror_attr(w[0]):
+                    continue
+                attr, anode = w
+                qual = ctx.get(node, "")
+                leaf = qual.split(".")[-1] if qual else ""
+                writes_by_fn.setdefault(qual, []).append((attr, anode))
+                if (base, leaf) not in _WRITE_ALLOWLIST:
+                    findings.append(Finding(
+                        file=module.rel, line=anode.lineno,
+                        rule="mirror-unlisted-write",
+                        message=f"write to {attr!r} in "
+                                f"{leaf or '<module>'}() is outside the "
+                                "audited mirror-mutation set",
+                        hint="move the write into an audited site, or add "
+                             "(module, method) to the graftlint mirror "
+                             "allowlist in the PR that reviews its "
+                             "coherence (mirrors must match device state "
+                             "at every tick boundary — tick_finish will "
+                             "not heal them)",
+                        context=qual,
+                        snippet=module.snippet(anode.lineno)))
+
+        # ---- rule 2: out-of-tick device-mirror mutations must pair --------
+        # Collect per-function pairing evidence in one walk.
+        pairing: dict[str, bool] = {}
+        for node in ast.walk(module.tree):
+            qual = ctx.get(node, "")
+            if not qual:
+                continue
+            if isinstance(node, ast.Attribute) and \
+                    node.attr == "_force_active":
+                pairing[qual] = True
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    "purge" in node.func.attr:
+                pairing[qual] = True
+
+        for qual, writes in writes_by_fn.items():
+            leaf = qual.split(".")[-1] if qual else ""
+            if leaf in _TICK_EXEMPT:
+                continue
+            device_writes = [(a, n) for a, n in writes
+                             if a in _DEVICE_MIRRORS]
+            if not device_writes:
+                continue
+            # pairing evidence may live in this function or any enclosing
+            # scope recorded under the same qualname prefix
+            if any(pairing.get(q) for q in _qual_prefixes(qual)):
+                continue
+            attr, anode = device_writes[0]
+            findings.append(Finding(
+                file=module.rel, line=anode.lineno,
+                rule="mirror-unpaired-mutation",
+                message=f"{leaf}() mutates device mirror {attr!r} out of "
+                        "tick without waking the row",
+                hint="pair the mutation with self._force_active.add(g) "
+                     "(gated on self._active_set) and/or a fabric purge so "
+                     "the next step runs the full kernel, not the decay "
+                     "closed form, over the new state",
+                context=qual,
+                snippet=module.snippet(anode.lineno)))
+        return findings
+
+
+def _qual_prefixes(qual: str) -> list[str]:
+    parts = qual.split(".")
+    return [".".join(parts[:i + 1]) for i in range(len(parts))]

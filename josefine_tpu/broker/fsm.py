@@ -204,7 +204,9 @@ class JosefineFsm:
                     self.on_partition_released(p)
         if self.on_delete_topic is not None:
             after_topics = {t.name for t in self.store.get_topics()}
-            for name in before_topics - after_topics:
+            # sorted(): the hook fires at commit time on every node — the
+            # order must not depend on set hashing (PYTHONHASHSEED).
+            for name in sorted(before_topics - after_topics):
                 self.on_delete_topic(name)
         if self.on_partition_assigned is not None:
             for p in after_parts.values():

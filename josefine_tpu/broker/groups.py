@@ -29,6 +29,7 @@ import asyncio
 import time
 import uuid
 from dataclasses import dataclass, field
+from typing import Callable
 
 from josefine_tpu.kafka.codec import ErrorCode
 from josefine_tpu.utils.tracing import get_logger
@@ -59,14 +60,16 @@ class Member:
     rebalance_timeout_ms: int
     protocols: list[tuple[str, bytes]]
     assignment: bytes = b""
-    deadline: float = field(default_factory=lambda: time.monotonic() + 30.0)
+    # Session deadline on the coordinator's clock (set by touch(); the
+    # clock itself lives on the coordinator so it can be virtualized).
+    deadline: float = 0.0
     # Set while a JoinGroup response is parked waiting for the rebalance.
     join_future: asyncio.Future | None = None
     # Set while a SyncGroup response waits for the leader's assignments.
     sync_future: asyncio.Future | None = None
 
-    def touch(self) -> None:
-        self.deadline = time.monotonic() + self.session_timeout_ms / 1000
+    def touch(self, now: float) -> None:
+        self.deadline = now + self.session_timeout_ms / 1000
 
 
 @dataclass
@@ -94,11 +97,18 @@ class GroupMeta:
 class GroupCoordinator:
     """One coordinator per broker (FindCoordinator always answers self)."""
 
-    def __init__(self, on_group_created=None):
+    def __init__(self, on_group_created=None,
+                 clock: Callable[[], float] | None = None):
         self._groups: dict[str, GroupMeta] = {}
         # Fire-and-forget hook: replicate group existence (EnsureGroup) so
         # ListGroups is cluster-wide; never awaited on the join path.
         self._on_group_created = on_group_created
+        # Injectable session clock (seconds, monotonic): the chaos harness
+        # drives it with virtual ticks so a frozen clock never expires a
+        # session and a skewed one expires them deterministically.  The
+        # default is the only wall-clock read on the coordinator, and it
+        # stays out of every replicated/journaled value.
+        self._clock = clock if clock is not None else time.monotonic
         self._sweeper: asyncio.Task | None = None
 
     # ------------------------------------------------------------ lifecycle
@@ -146,6 +156,7 @@ class GroupCoordinator:
                 self._on_group_created(group_id)
 
         if not member_id:
+            # graftlint: allow(det-uuid) — member identity; must stay unique across coordinator restarts, which a seeded RNG cannot guarantee
             member_id = f"{client_id or 'member'}-{uuid.uuid4()}"
             member = Member(member_id=member_id, client_id=client_id,
                             client_host=client_host,
@@ -158,7 +169,7 @@ class GroupCoordinator:
             member.protocols = protocols
             member.session_timeout_ms = session_timeout_ms
             member.rebalance_timeout_ms = rebalance_timeout_ms or session_timeout_ms
-        member.touch()
+        member.touch(self._clock())
 
         # A (re)join always forces the group through a rebalance round.
         self._prepare_rebalance(group)
@@ -248,7 +259,7 @@ class GroupCoordinator:
         if err is not None:
             return {"error_code": err, "assignment": b""}
         member = group.members[member_id]
-        member.touch()
+        member.touch(self._clock())
         if group.state == STABLE:  # idempotent re-sync
             return {"error_code": ErrorCode.NONE, "assignment": member.assignment}
         if group.state != COMPLETING_REBALANCE:
@@ -279,7 +290,7 @@ class GroupCoordinator:
         err = self._check_member(group, generation_id, member_id)
         if err is not None:
             return err
-        group.members[member_id].touch()
+        group.members[member_id].touch(self._clock())
         if group.state in (PREPARING_REBALANCE, COMPLETING_REBALANCE):
             return int(ErrorCode.REBALANCE_IN_PROGRESS)
         return int(ErrorCode.NONE)
@@ -354,17 +365,23 @@ class GroupCoordinator:
             group.state = EMPTY
             group.generation += 1
 
+    def _sweep_once(self) -> None:
+        """One expiry pass over every group at the coordinator clock's
+        current reading (split from the loop so tests and virtual-clock
+        drivers can sweep without real time passing)."""
+        now = self._clock()
+        for group in list(self._groups.values()):
+            expired = [mid for mid, m in group.members.items()
+                       if m.deadline < now and m.join_future is None]
+            for mid in expired:
+                log.info("group %s: member %s session expired",
+                         group.group_id, mid)
+                self._evict(group, mid)
+
     async def _sweep_loop(self) -> None:
         while True:
             await asyncio.sleep(SESSION_SWEEP_INTERVAL_S)
-            now = time.monotonic()
-            for group in list(self._groups.values()):
-                expired = [mid for mid, m in group.members.items()
-                           if m.deadline < now and m.join_future is None]
-                for mid in expired:
-                    log.info("group %s: member %s session expired",
-                             group.group_id, mid)
-                    self._evict(group, mid)
+            self._sweep_once()
 
 
 def _select_protocol(members) -> str:
@@ -380,7 +397,10 @@ def _select_protocol(members) -> str:
     for name, _ in members[0].protocols:
         if name in common:
             return name
-    return next(iter(common))
+    # Unreachable when common is non-empty (common ⊆ members[0]'s names),
+    # but keep the fallback total — and deterministic: min(), never an
+    # arbitrary set draw (every member must compute the same pick).
+    return min(common)
 
 
 def _proto_metadata(member: Member, protocol_name: str) -> bytes:

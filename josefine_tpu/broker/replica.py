@@ -78,6 +78,7 @@ class ReplicaRegistry:
             for entry in os.listdir(data):
                 if entry.startswith(prefix) and entry[len(prefix):].isdigit():
                     src = os.path.join(data, entry)
+                    # graftlint: allow(det-uuid) — tombstone rename suffix; uniqueness only, never read back or journaled
                     dst = f"{src}.deleted.{uuid.uuid4().hex}"
                     try:
                         os.rename(src, dst)

@@ -202,6 +202,15 @@ class BrokerConfig:
     # Observability endpoint (/metrics, /state, /healthz); 0 = disabled.
     # TPU-build addition: the reference has no metrics at all (SURVEY.md §5).
     metrics_port: int = 0
+    # Seed for broker-side randomized DECISIONS (partition placement
+    # shuffles): the same (seed, broker id) reproduces the same placement
+    # choices run-to-run, so same-seed cluster runs make identical
+    # decisions through the broker path. Identity LABELS (topic/partition
+    # uuids, member ids) deliberately stay uuid4 — they name entities,
+    # never drive a choice or a journaled value, and collision-freedom
+    # across restarts matters more than replayability (each such site
+    # carries a graftlint allow(det-uuid) pragma saying so).
+    seed: int = 0
     # Crash model (ARCHITECTURE.md "Durability"): "process" (default) makes
     # every ack durable to process crash (sqlite WAL synchronous=NORMAL, no
     # per-append seglog fsync); "power" additionally fsyncs the seglog

@@ -1527,6 +1527,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         t_now = self._ticks + h.get("window", 1)
         self._flight_now = t_now
         prof = self.profiler
+        # graftlint: allow(det-wallclock) — profiling plane only (off by default); timings feed /metrics, never the journal or state
         _t_apply = time.perf_counter_ns() if prof.enabled else 0
         # Host work is only needed where host-visible state moved. In steady
         # state most fetched rows are outbox-only (staggered heartbeats /
@@ -1778,6 +1779,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             res.conf_changes.extend(self._conf_notify)
             self._conf_notify.clear()
         if prof.enabled:
+            # graftlint: allow(det-wallclock) — profiling plane only; see the matching timer start above
             prof.add_ns("apply", time.perf_counter_ns() - _t_apply)
         # Skip rows reset mid-tick too, not just recycled ones: a
         # ReplicaDiverged reset discards the blocks this tick's computed

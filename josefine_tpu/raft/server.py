@@ -158,6 +158,7 @@ class JosefineRaft:
         not mint a duplicate block.
         """
         deadline = asyncio.get_running_loop().time() + timeout
+        # graftlint: allow(det-uuid) — request-dedup identity; a seeded RNG would repeat after restart and falsely dedup fresh proposals
         req_id = uuid.uuid4().hex  # stable across retries of this call
         while True:
             remaining = deadline - asyncio.get_running_loop().time()

@@ -97,3 +97,20 @@ def test_fsm_rejects_garbage():
         fsm.transition(b"")
     with pytest.raises(ValueError):
         fsm.transition(bytes([99]) + b"{}")
+
+
+def test_snapshot_restore_fires_delete_hooks_in_sorted_order():
+    """Regression (graftlint det-set-iter): topics deleted while a node was
+    behind fire on_delete_topic during restore() in SORTED name order —
+    commit-time side-effect hooks must run in the same order on every
+    node, never in set-hash order."""
+    store = Store(MemKV())
+    fsm = JosefineFsm(store)
+    empty = fsm.snapshot()
+    names = ["zeta", "alpha", "mu", "kappa", "beta", "omega", "eta", "tau"]
+    for n in names:
+        store.create_topic(Topic(name=n, id=n))
+    fired: list[str] = []
+    fsm.on_delete_topic = fired.append
+    fsm.restore(empty)
+    assert fired == sorted(names)

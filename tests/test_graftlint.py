@@ -1,0 +1,497 @@
+"""graftlint self-tests: one fixture snippet per rule family (violation
+caught with the right rule id and location), pragma semantics (a justified
+pragma suppresses, a reasonless one is itself a finding), and the baseline
+ratchet (growth fails, shrink passes, reasons are mandatory and preserved
+across --write-baseline).  The final test is the acceptance gate: the real
+tree must lint clean against the checked-in baseline.
+"""
+
+import json
+import textwrap
+
+from josefine_tpu.analysis import collect_findings, main
+from josefine_tpu.analysis.core import apply_baseline, load_baseline, write_baseline
+
+
+def lint_source(tmp_path, source, name="scratch.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return p, collect_findings([str(p)])
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------ determinism
+
+
+def test_det_wallclock_and_rng(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import os
+        import random
+        import time
+
+        def stamp():
+            return time.monotonic()
+
+        _rng = random.Random()
+        _seeded = random.Random(7)
+
+        def draw():
+            random.shuffle([1, 2])
+            return os.urandom(8)
+        """)
+    assert len(by_rule(fs, "det-wallclock")) == 1
+    assert by_rule(fs, "det-wallclock")[0].line == 6
+    # unseeded Random() and the global shuffle flag; Random(7) does not
+    assert [f.line for f in by_rule(fs, "det-unseeded-rng")] == [8, 12]
+    assert [f.line for f in by_rule(fs, "det-urandom")] == [13]
+
+
+def test_det_np_global_rng_and_import_alias(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import numpy as np
+
+        def noisy(shape):
+            return np.random.normal(size=shape)
+
+        def blessed(seed):
+            return np.random.default_rng(seed)  # the recommended fix
+        """)
+    hits = by_rule(fs, "det-np-global-rng")
+    # exactly ONE finding (outermost chain only, no per-dotted-level
+    # duplicates) and the seeded-Generator constructor is exempt
+    assert [f.line for f in hits] == [4]
+
+
+def test_det_uuid_entropy(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import uuid
+
+        def mint():
+            return uuid.uuid4()
+        """)
+    assert [f.line for f in by_rule(fs, "det-uuid")] == [4]
+
+
+def test_det_set_iteration(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        def walk(items):
+            s = set(items)
+            for x in s:          # flagged: set order
+                print(x)
+            for x in sorted(s):  # fine
+                print(x)
+            first = next(iter(s))           # flagged: arbitrary draw
+            keep = {x for x in s if x}      # exempt: set -> set
+            order = [x for x in s]          # flagged: order leaks
+            return first, keep, order
+        """)
+    assert [f.line for f in by_rule(fs, "det-set-iter")] == [3, 7, 9]
+
+
+# --------------------------------------------------------- jit discipline
+
+
+def test_jit_tracer_leak_and_host_np(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def traced(x):
+            n = int(x.sum())
+            y = x.item()
+            return np.ones(3) + n + y
+
+        def helper(xp, x):
+            return xp.stack([x])  # xp idiom: exempt from jit-host-np
+
+        def host(x):
+            return int(x) + np.ones(3)  # untraced: no findings
+
+        @jax.jit
+        def traced2(x):
+            return np.linalg.norm(x)  # ONE finding, not one per level
+        """)
+    leaks = by_rule(fs, "jit-tracer-leak")
+    assert [f.line for f in leaks] == [6, 7]
+    assert [f.line for f in by_rule(fs, "jit-host-np")] == [8, 18]
+
+
+def test_jit_builder_cache_and_bucket_discipline(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        def active_bucket(n, P):
+            b = 64
+            while b < n:
+                b *= 2
+            return min(b, P)
+
+        def make_step(k):  # uncached: one compile per call
+            def fn(x):
+                return jnp.zeros((k,)) + x
+            return jax.jit(fn)
+
+        @functools.lru_cache(maxsize=None)
+        def _step_fn(k):
+            def fn(x):
+                return jnp.zeros((k,)) + x
+            return jax.jit(fn)
+
+        def good(rows, P):
+            k = active_bucket(len(rows), P)
+            return _step_fn(k)
+
+        def bad(rows):
+            return _step_fn(len(rows))
+
+        def bad_kw(rows):
+            return _step_fn(k=len(rows))  # keyword args are checked too
+        """)
+    assert len(by_rule(fs, "jit-uncached-builder")) == 1
+    assert by_rule(fs, "jit-uncached-builder")[0].line == 15
+    shapes = by_rule(fs, "jit-unbucketed-shape")
+    assert [f.line for f in shapes] == [28, 31]
+
+
+def test_jit_builder_registry_is_cross_module(tmp_path):
+    """The builder registry spans the scanned set: a cached builder defined
+    in one module (packed_step's role) is enforced at call sites in
+    another (engine's role)."""
+    (tmp_path / "steps.py").write_text(textwrap.dedent("""\
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        @functools.lru_cache(maxsize=None)
+        def _window_fn(k):
+            def fn(x):
+                return jnp.zeros((k,)) + x
+            return jax.jit(fn)
+        """))
+    caller = tmp_path / "driver.py"
+    caller.write_text(textwrap.dedent("""\
+        from steps import _window_fn
+
+        def drive(rows):
+            return _window_fn(len(rows))
+        """))
+    fs = collect_findings([str(tmp_path / "steps.py"), str(caller)])
+    shapes = by_rule(fs, "jit-unbucketed-shape")
+    assert len(shapes) == 1
+    assert shapes[0].file.endswith("driver.py") and shapes[0].line == 4
+
+
+# ------------------------------------------------------- mirror coherence
+
+
+def test_mirror_write_and_pairing(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        class Eng:
+            def rogue(self, g):
+                self._h_head[g] = 0
+
+            def paired_reset(self, g):
+                self._h_commit[g] = 0
+                if self._active_set:
+                    self._force_active.add(g)
+
+            def bookkeeping(self, src):
+                self._h_src_seen[src] = 1
+        """)
+    unlisted = by_rule(fs, "mirror-unlisted-write")
+    # every write is outside the audited set in a scratch module
+    assert {f.line for f in unlisted} == {3, 6, 11}
+    unpaired = by_rule(fs, "mirror-unpaired-mutation")
+    # rogue() lacks pairing; paired_reset() has _force_active;
+    # bookkeeping() touches an intake mirror (pairing rule exempt)
+    assert [f.context for f in unpaired] == ["rogue"]
+
+
+def test_mirror_allowlist_recognizes_audited_sites(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        class Eng:
+            def tick_begin(self, window=1):
+                self._h_elapsed[0] = 0
+        """, name="engine.py")
+    assert not by_rule(fs, "mirror-unlisted-write")
+    assert not by_rule(fs, "mirror-unpaired-mutation")
+
+
+# --------------------------------------------------------- async blocking
+
+
+def test_async_blocking(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import asyncio
+        import sqlite3
+        import time
+
+        async def handler(self):
+            time.sleep(0.1)
+            db = sqlite3.connect("x")
+            with open("f") as fh:
+                data = fh.read()
+            self.kv.put(b"k", data)
+            await asyncio.to_thread(lambda: open("g").read())  # offloaded
+            return db
+
+        def sync_path():
+            time.sleep(0.1)  # fine outside a coroutine
+            return open("f")
+        """)
+    assert [f.line for f in by_rule(fs, "async-blocking-sleep")] == [6]
+    assert [f.line for f in by_rule(fs, "async-blocking-io")] == [7, 8]
+    assert [f.line for f in by_rule(fs, "async-raw-kv")] == [10]
+
+
+def test_async_coroutine_inside_sync_factory_is_scanned(tmp_path):
+    """A coroutine built by a sync factory that itself lives inside a
+    coroutine is still async code — the handler-factory idiom must not
+    create a blind spot."""
+    _, fs = lint_source(tmp_path, """\
+        import time
+
+        async def outer():
+            def factory():
+                async def inner():
+                    time.sleep(1)  # flagged: inner IS a coroutine
+                return inner
+            return factory()
+
+        def sync_factory():
+            async def proposer():
+                time.sleep(2)  # flagged: classic fire-and-forget helper
+            return proposer
+        """)
+    assert [f.line for f in by_rule(fs, "async-blocking-sleep")] == [6, 12]
+
+
+# ---------------------------------------------------------------- pragmas
+
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import time
+
+        def stamp():
+            # graftlint: allow(det-wallclock) — profiling only, never journaled
+            return time.monotonic()
+        """)
+    assert not by_rule(fs, "det-wallclock")
+    assert not by_rule(fs, "pragma-missing-reason")
+
+
+def test_pragma_without_reason_rejected(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import time
+
+        def stamp():
+            return time.monotonic()  # graftlint: allow(det-wallclock)
+        """)
+    # the reasonless pragma suppresses nothing AND is itself a finding
+    assert [f.line for f in by_rule(fs, "det-wallclock")] == [4]
+    assert [f.line for f in by_rule(fs, "pragma-missing-reason")] == [4]
+
+
+def test_pragma_only_covers_named_rule(tmp_path):
+    _, fs = lint_source(tmp_path, """\
+        import time
+        import random
+
+        def stamp():
+            # graftlint: allow(det-unseeded-rng) — wrong rule named
+            return time.monotonic()
+        """)
+    assert by_rule(fs, "det-wallclock")
+
+
+# --------------------------------------------------------------- baseline
+
+
+def _violation_file(tmp_path, extra=""):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""\
+        import time
+
+        def a():
+            return time.time()
+
+        def b():
+            return time.monotonic()
+        """) + textwrap.dedent(extra))
+    return p
+
+
+def test_baseline_ratchet_growth_fails_shrink_passes(tmp_path, capsys):
+    p = _violation_file(tmp_path)
+    bl = tmp_path / "baseline.json"
+
+    # no baseline: the two findings fail the run
+    assert main([str(p), "--baseline", str(bl)]) == 1
+
+    # write the baseline; entries need reasons before the lint passes
+    assert main([str(p), "--baseline", str(bl), "--write-baseline"]) == 0
+    assert main([str(p), "--baseline", str(bl)]) == 1  # reasonless entries
+    data = json.loads(bl.read_text())
+    assert len(data["entries"]) == 2
+    for e in data["entries"]:
+        e["reason"] = "accepted for the ratchet test"
+    bl.write_text(json.dumps(data))
+    capsys.readouterr()
+    assert main([str(p), "--baseline", str(bl)]) == 0  # all baselined
+    out = capsys.readouterr().out
+    assert "0 new findings, 2 baselined" in out
+
+    # growth: a third violation is NOT in the baseline -> fail, with the
+    # rule id and file:line in the output
+    p2 = _violation_file(tmp_path, """\
+
+        def c():
+            return time.time_ns()
+        """)
+    capsys.readouterr()
+    assert main([str(p2), "--baseline", str(bl)]) == 1
+    out = capsys.readouterr().out
+    assert "det-wallclock" in out
+    assert "mod.py:10" in out
+    assert "1 new finding, 2 baselined" in out
+
+    # shrink: remove one violation -> passes (stale entries are progress)
+    p.write_text("import time\n\ndef a():\n    return time.time()\n")
+    assert main([str(p), "--baseline", str(bl)]) == 0
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    p = _violation_file(tmp_path)
+    findings = collect_findings([str(p)])
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings)
+    # shift every line down by three: fingerprints must still match
+    p.write_text("# one\n# two\n# three\n" + p.read_text())
+    shifted = collect_findings([str(p)])
+    new, baselined, _stale, _ = apply_baseline(shifted, load_baseline(str(bl)))
+    assert not new and len(baselined) == 2
+
+
+def test_baseline_is_count_aware_for_identical_lines(tmp_path):
+    """Two identical violation lines in one function share a fingerprint;
+    the baseline entry carries a count, so a copy-pasted duplicate of a
+    baselined violation still fails the ratchet."""
+    p = tmp_path / "mod.py"
+    p.write_text("import time\n\ndef a():\n    t = time.time()\n"
+                 "    return t\n")
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), collect_findings([str(p)]))
+    entries = json.loads(bl.read_text())["entries"]
+    assert len(entries) == 1 and entries[0]["count"] == 1
+    # duplicate the identical line: same fingerprint, count 2 > allowed 1
+    p.write_text("import time\n\ndef a():\n    t = time.time()\n"
+                 "    t = time.time()\n    return t\n")
+    new, baselined, _s, _r = apply_baseline(
+        collect_findings([str(p)]), load_baseline(str(bl)))
+    assert len(baselined) == 1 and len(new) == 1
+
+
+def test_baseline_stale_detection_is_count_aware(tmp_path):
+    """An entry with unfired headroom (count=2, one occurrence fixed) must
+    report as stale — otherwise the spare slot silently absorbs a
+    reintroduced duplicate later."""
+    p = tmp_path / "mod.py"
+    p.write_text("import time\n\ndef a():\n    t = time.time()\n"
+                 "    t = time.time()\n    return t\n")
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), collect_findings([str(p)]))
+    assert json.loads(bl.read_text())["entries"][0]["count"] == 2
+    # fix ONE of the two identical lines
+    p.write_text("import time\n\ndef a():\n    t = time.time()\n"
+                 "    return t\n")
+    new, baselined, stale, _r = apply_baseline(
+        collect_findings([str(p)]), load_baseline(str(bl)))
+    assert not new and len(baselined) == 1
+    assert len(stale) == 1  # headroom shrank: prompt --write-baseline
+
+
+def test_write_baseline_preserves_reasons(tmp_path):
+    p = _violation_file(tmp_path)
+    bl = tmp_path / "baseline.json"
+    main([str(p), "--baseline", str(bl), "--write-baseline"])
+    data = json.loads(bl.read_text())
+    data["entries"][0]["reason"] = "kept across regeneration"
+    bl.write_text(json.dumps(data))
+    main([str(p), "--baseline", str(bl), "--write-baseline"])
+    data2 = json.loads(bl.read_text())
+    fp0 = data["entries"][0]["fingerprint"]
+    kept = [e for e in data2["entries"] if e["fingerprint"] == fp0]
+    assert kept and kept[0]["reason"] == "kept across regeneration"
+
+
+def test_explicit_in_tree_file_keeps_checker_scoping():
+    """Naming one in-repo file must match what the full run says about it:
+    broker code never sees the mirror family (GroupMeta.state is a Kafka
+    FSM field, not a device mirror), so a pre-commit single-file lint of a
+    clean broker module passes."""
+    import os
+
+    from josefine_tpu.analysis.core import REPO_ROOT
+    path = os.path.join(REPO_ROOT, "josefine_tpu", "broker", "groups.py")
+    fs = collect_findings([path])
+    assert not by_rule(fs, "mirror-unlisted-write")
+    assert not by_rule(fs, "mirror-unpaired-mutation")
+    assert not fs  # the file is clean under its scoped families too
+
+
+# ------------------------------------------------------------- acceptance
+
+
+def test_tree_lints_clean_against_checked_in_baseline(capsys):
+    """The repo itself must pass: no new findings, and every baseline
+    entry carries a written reason."""
+    assert main([]) == 0
+    out = capsys.readouterr().out
+    assert "0 new findings" in out
+
+
+def test_every_rule_family_fires_on_a_seeded_scratch_file(tmp_path, capsys):
+    """The CI-stage acceptance property: one deliberate violation per rule
+    family in a scratch file fails the lint with the correct rule id and
+    file:line."""
+    p = tmp_path / "seeded.py"
+    p.write_text(textwrap.dedent("""\
+        import random
+        import time
+
+        import jax
+        import numpy as np
+
+        _rng = random.Random()
+
+        @jax.jit
+        def traced(x):
+            return np.ones(3) + int(x.sum())
+
+        class Eng:
+            def rogue(self, g):
+                self._h_head[g] = 0
+
+        async def handler():
+            time.sleep(1)
+        """))
+    assert main([str(p)]) == 1
+    out = capsys.readouterr().out
+    for rule, line in [("det-unseeded-rng", 7), ("jit-host-np", 11),
+                       ("jit-tracer-leak", 11),
+                       ("mirror-unlisted-write", 15),
+                       ("async-blocking-sleep", 18)]:
+        assert f"{p}:{line}: {rule}" in out, (rule, out)

@@ -426,3 +426,50 @@ def test_offset_commit_transition_is_deterministic():
     out2 = JosefineFsm(store2).transition(payload)
     assert out1 == out2
     assert store1.get_offset("g", "t", 3).offset == 99
+
+
+# ------------------------------------------------- injectable session clock
+
+
+@pytest.mark.asyncio
+async def test_frozen_clock_never_expires_session():
+    """Regression (graftlint det-wallclock audit): session deadlines run on
+    the coordinator's injectable clock, so a frozen clock — the chaos
+    harness's virtual-tick driver at rest — never expires a member no
+    matter how many sweeps run."""
+    t = [100.0]
+    coord = GroupCoordinator(clock=lambda: t[0])
+    resp = await coord.join_group("g", "", "consumer", [("range", b"x")],
+                                  10, 100, client_id="c1")
+    assert resp["error_code"] == ErrorCode.NONE
+    mid = resp["member_id"]
+    # session_timeout_ms=10 (the minimum): on a wall clock this member
+    # would be gone after any real sweep interval.
+    for _ in range(50):
+        coord._sweep_once()
+    assert mid in coord._groups["g"].members
+
+    # Advancing the virtual clock past the deadline expires it
+    # deterministically on the next sweep.
+    t[0] += 1.0
+    coord._sweep_once()
+    assert mid not in coord._groups["g"].members
+
+
+@pytest.mark.asyncio
+async def test_virtual_clock_touch_extends_session():
+    t = [0.0]
+    coord = GroupCoordinator(clock=lambda: t[0])
+    resp = await coord.join_group("g", "", "consumer", [("range", b"x")],
+                                  1000, 100, client_id="c1")
+    mid = resp["member_id"]
+    await coord.sync_group("g", 1, mid, [{"member_id": mid,
+                                          "assignment": b"a"}])
+    t[0] += 0.9
+    assert coord.heartbeat("g", 1, mid) == ErrorCode.NONE  # touches at 0.9
+    t[0] += 0.9  # 1.8: past the original deadline, inside the touched one
+    coord._sweep_once()
+    assert mid in coord._groups["g"].members
+    t[0] += 1.0  # 2.8: past the touched deadline too
+    coord._sweep_once()
+    assert mid not in coord._groups["g"].members

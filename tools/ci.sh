@@ -26,6 +26,16 @@ else
     python -m compileall -q josefine_tpu tests
 fi
 
+echo "== graftlint =="
+# Project static analysis (josefine_tpu/analysis/): determinism on the
+# journaled planes, jit recompile/bucket discipline, host-mirror coherence,
+# async blocking. Fails on any finding not in tools/lint_baseline.json
+# (printing the rule id, file:line, and a fix hint); the baseline may only
+# shrink, and every entry needs a written reason. After an intentional,
+# justified change: `python tools/lint.py --write-baseline` and fill in the
+# reasons (same contract as perf_smoke --write-floor).
+python tools/lint.py
+
 echo "== native build =="
 python - <<'EOF'
 from josefine_tpu import native
@@ -146,7 +156,8 @@ else
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_flight.py tests/test_flight_merge.py \
-        tests/test_coverage.py tests/test_reset_safety.py -q
+        tests/test_coverage.py tests/test_reset_safety.py \
+        tests/test_graftlint.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route

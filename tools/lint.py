@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""graftlint CLI — project static analysis (see josefine_tpu/analysis/).
+
+Usage:
+    python tools/lint.py                    # lint the configured scopes
+    python tools/lint.py path/to/file.py    # every rule family on a file
+    python tools/lint.py --write-baseline   # regenerate the ratchet file
+    python tools/lint.py --list-rules
+
+Exit status: 0 clean (baseline-accepted findings allowed), 1 on any new
+finding or any baseline entry lacking a written reason.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from josefine_tpu.analysis.core import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
