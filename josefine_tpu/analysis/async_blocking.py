@@ -61,6 +61,10 @@ class AsyncBlockingChecker(Checker):
         "josefine_tpu/raft/server.py",
         "josefine_tpu/raft/tcp.py",
         "josefine_tpu/broker/",
+        # The wire driver is a real-socket asyncio surface; the in-process
+        # driver deliberately stays OUT of this family — its virtual-tick
+        # loop blocks the loop by design (it IS the clock).
+        "josefine_tpu/workload/wire.py",
     )
     rules = {
         "async-blocking-sleep":

@@ -104,6 +104,7 @@ class DeterminismChecker(Checker):
         "josefine_tpu/raft/",
         "josefine_tpu/chaos/",
         "josefine_tpu/broker/",
+        "josefine_tpu/workload/",
         "josefine_tpu/utils/flight.py",
         "josefine_tpu/utils/coverage.py",
     )

@@ -16,6 +16,7 @@ from josefine_tpu.broker.state import (
     OffsetCommit,
     OffsetCommitBatch,
     Partition,
+    PartitionBatch,
     Store,
     GroupReleased,
     PidAlloc,
@@ -32,6 +33,7 @@ _DELETE_TOPIC = 6
 _COMMIT_OFFSETS = 7
 _GROUP_RELEASED = 8
 _ALLOC_PID = 9
+_ENSURE_PARTITIONS = 10
 
 _KINDS = {
     _ENSURE_TOPIC: Topic,
@@ -43,6 +45,7 @@ _KINDS = {
     _COMMIT_OFFSETS: OffsetCommitBatch,
     _GROUP_RELEASED: GroupReleased,
     _ALLOC_PID: PidAlloc,
+    _ENSURE_PARTITIONS: PartitionBatch,
 }
 _TAGS = {v: k for k, v in _KINDS.items()}
 
@@ -57,6 +60,13 @@ class Transition:
     @staticmethod
     def ensure_partition(partition: Partition) -> bytes:
         return bytes([_ENSURE_PARTITION]) + partition.encode()
+
+    @staticmethod
+    def ensure_partitions(partitions: list[Partition]) -> bytes:
+        """Bulk form: every partition of one topic in ONE replicated
+        transition (one consensus round-trip however many partitions)."""
+        return (bytes([_ENSURE_PARTITIONS])
+                + PartitionBatch(entries=list(partitions)).encode())
 
     @staticmethod
     def ensure_broker(broker: Broker) -> bytes:
@@ -118,23 +128,32 @@ class JosefineFsm:
         # in legacy (group-less) mode.
         self.group_pool = group_pool
 
+    def _apply_partition(self, entity: Partition) -> Partition:
+        """One EnsurePartition: idempotent re-ensure keeps the original
+        group claim; a fresh partition gets a deterministic commit-time
+        group allocation from the replicated counter (-1 on pool
+        exhaustion = legacy mode, leader-local log). Shared by the single
+        and bulk transition kinds so their folds can never diverge."""
+        existing = self.store.get_partition(entity.topic, entity.idx)
+        if existing is not None:
+            entity.group = existing.group
+        elif entity.group < 0 and self.group_pool > 1:
+            entity.group = self.store.claim_group(self.group_pool)
+        applied = self.store.create_partition(entity)
+        if self.on_partition_assigned is not None:
+            self.on_partition_assigned(applied)
+        return applied
+
     def transition(self, data: bytes) -> bytes:
         entity = Transition.decode(data)
         if isinstance(entity, Topic):
             applied = self.store.create_topic(entity)
         elif isinstance(entity, Partition):
-            existing = self.store.get_partition(entity.topic, entity.idx)
-            if existing is not None:
-                # Idempotent re-ensure keeps the original group claim.
-                entity.group = existing.group
-            elif entity.group < 0 and self.group_pool > 1:
-                # Deterministic commit-time allocation: every node computes
-                # the same row from the same replicated counter. -1 on pool
-                # exhaustion = legacy mode (leader-local log).
-                entity.group = self.store.claim_group(self.group_pool)
-            applied = self.store.create_partition(entity)
-            if self.on_partition_assigned is not None:
-                self.on_partition_assigned(applied)
+            applied = self._apply_partition(entity)
+        elif isinstance(entity, PartitionBatch):
+            entity.entries = [self._apply_partition(p)
+                              for p in entity.entries]
+            applied = entity
         elif isinstance(entity, Broker):
             applied = self.store.ensure_broker(entity)
         elif isinstance(entity, Group):

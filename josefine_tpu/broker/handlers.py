@@ -54,6 +54,11 @@ _m_requests = REGISTRY.counter("broker_requests_total",
                                "Kafka API requests dispatched, by api key")
 _m_errors = REGISTRY.counter("broker_request_errors_total",
                              "Kafka API handler exceptions, by api key")
+_m_backpressure = REGISTRY.counter(
+    "broker_produce_backpressure_total",
+    "Replicated produces refused with THROTTLING_QUOTA_EXCEEDED because "
+    "the partition's consensus-group proposal queue was over "
+    "broker.max_group_inflight")
 
 CLUSTER_ID = "josefine"  # reference metadata.rs cluster id
 
@@ -242,10 +247,14 @@ class Broker:
                 continue
             parts = []
             for p in store_parts:
+                leader = self._partition_leader(p)
                 parts.append({
-                    "error_code": ErrorCode.NONE,
+                    # Kafka semantics: a leaderless partition answers
+                    # LEADER_NOT_AVAILABLE (retryable) with leader -1.
+                    "error_code": (ErrorCode.NONE if leader >= 0
+                                   else ErrorCode.LEADER_NOT_AVAILABLE),
                     "partition_index": p.idx,
-                    "leader_id": self._partition_leader(p),
+                    "leader_id": leader,
                     "replica_nodes": p.assigned_replicas,
                     "isr_nodes": self._partition_isr(p, isr_map),
                     "offline_replicas": [],
@@ -333,8 +342,10 @@ class Broker:
         topic = Topic(name=name, id=str(uuid.uuid4()),
                       partitions={p.idx: p.assigned_replicas for p in parts})
         await self.client.propose(Transition.ensure_topic(topic))
-        for p in parts:
-            await self.client.propose(Transition.ensure_partition(p))
+        # Bulk partition create: ONE replicated transition however many
+        # partitions (the per-partition loop cost a consensus round-trip
+        # each — a 10k-partition topic took 10k round-trips on group 0).
+        await self.client.propose(Transition.ensure_partitions(parts))
         await self._leader_and_isr_fanout(parts, brokers)
 
     async def _leader_and_isr_fanout(self, parts: list[Partition],
@@ -499,13 +510,18 @@ class Broker:
     def _partition_leader(self, p: Partition) -> int:
         """Live leader of a partition: for group-backed partitions this is
         its consensus group's CURRENT Raft leader (leadership moves with
-        elections — the whole point of the P-axis wiring); for legacy
-        (group-less) partitions, the statically assigned broker."""
+        elections — the whole point of the P-axis wiring), and -1 while
+        the row is LEADERLESS (mid-election, or freshly claimed before its
+        first election — Kafka's leader-not-available answer; clients poll
+        metadata until a leader appears). Answering the stored
+        creation-time assignment instead sent produces to a broker that
+        never led the row — a race bulk topic create made deterministic:
+        one metadata round-trip now lands before the first election. Only
+        legacy (group-less) partitions answer the static broker."""
         g = self._live_group(p)
         if g is not None:
             live = self.client.leader_id(g)
-            if live is not None:
-                return live
+            return -1 if live is None else live
         return p.leader
 
     def _leads_partition(self, p: Partition) -> bool:
@@ -589,7 +605,30 @@ class Broker:
 
     async def _produce_replicated(self, group: int, batch: bytes,
                                   acks) -> tuple[int, int]:
-        """One produced batch = one proposal on the partition's group."""
+        """One produced batch = one proposal on the partition's group.
+
+        Admission gate (backpressure): while the group's proposal queue
+        holds >= broker.max_group_inflight unminted entries, the produce is
+        refused RETRYABLY instead of buffered — under sustained overload an
+        unbounded queue grows without bound while every entry's latency
+        climbs; refusing at the edge keeps memory bounded and pushes the
+        wait into the client's (seeded, in the workload plane) backoff."""
+        cap = self.config.max_group_inflight
+        if cap:
+            backlog = getattr(self.client, "proposal_backlog", None)
+            if backlog is not None and backlog(group) >= cap:
+                _m_backpressure.inc()
+                if acks == 0:
+                    # acks=0 has no response channel to carry the
+                    # retryable code: the batch is SHED. That is the
+                    # acks=0 contract (the client accepted silent loss —
+                    # same as the fire() drop path below), and shedding
+                    # the fire-and-forget tier first under overload is
+                    # the gate working as intended; logged + counted so
+                    # it is never invisible.
+                    log.warning("acks=0 produce shed under backpressure "
+                                "(group %d)", group)
+                return int(ErrorCode.THROTTLING_QUOTA_EXCEEDED), -1
         try:
             if acks == 0:
                 # Fire-and-forget: commit proceeds, nobody awaits the offset.

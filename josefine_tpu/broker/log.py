@@ -9,6 +9,7 @@ upgrades (assigned offsets, binary-search index, CRC, a real read path).
 
 from __future__ import annotations
 
+import bisect
 import os
 
 from josefine_tpu import native
@@ -94,3 +95,72 @@ class Log:
 
     def close(self) -> None:
         self._log.close()
+
+
+class MemLog:
+    """In-memory partition log with the same surface as :class:`Log`.
+
+    The workload scale driver (``josefine_tpu/workload/driver.py``) hosts
+    10k–100k partitions in ONE process to measure the product path at the
+    batched-P scale; 10k native seglogs would cost 10k directories and a
+    10 MiB index mmap each, none of which the measurement needs. Durability
+    suites and the wire path keep using the native :class:`Log`.
+    """
+
+    def __init__(self):
+        # (base_offset, count, payload) blobs in append order; bases are
+        # strictly increasing and spans contiguous from 0, so lookups are
+        # one bisect (a linear scan would make every fetch O(appends) on
+        # exactly the serve path the workload driver measures).
+        self._blobs: list[tuple[int, int, bytes]] = []
+        self._bases: list[int] = []
+        self._next = 0
+
+    def append(self, data: bytes, count: int = 1) -> int:
+        if count < 1:
+            raise ValueError(f"blob count must be >= 1, got {count}")
+        base = self._next
+        self._blobs.append((base, count, data))
+        self._bases.append(base)
+        self._next = base + count
+        return base
+
+    def _index_of(self, offset: int) -> int | None:
+        """Index of the blob containing ``offset``, or None past the end."""
+        i = bisect.bisect_right(self._bases, offset) - 1
+        if i < 0 or offset >= self._blobs[i][0] + self._blobs[i][1]:
+            return None
+        return i
+
+    def read(self, offset: int):
+        i = self._index_of(offset)
+        return None if i is None else self._blobs[i]
+
+    def read_from(self, offset: int, max_bytes: int = 1 << 20):
+        i = self._index_of(offset)
+        if i is None:
+            return []
+        out, size = [], 0
+        for blob in self._blobs[i:]:
+            if size and size + len(blob[2]) > max_bytes:
+                break
+            out.append(blob)
+            size += len(blob[2])
+        return out
+
+    def next_offset(self) -> int:
+        return self._next
+
+    def segment_count(self) -> int:
+        return 1
+
+    def wipe(self) -> None:
+        self._blobs = []
+        self._bases = []
+        self._next = 0
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

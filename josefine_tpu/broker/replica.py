@@ -13,15 +13,22 @@ import os
 import shutil
 import uuid
 
-from josefine_tpu.broker.log import Log
+from josefine_tpu.broker.log import Log, MemLog
 from josefine_tpu.broker.state import Partition
 
 
 class Replica:
-    def __init__(self, data_dir: str | os.PathLike, partition: Partition):
+    def __init__(self, data_dir: str | os.PathLike, partition: Partition,
+                 in_memory: bool = False):
         self.partition = partition
-        self.path = os.path.join(os.fspath(data_dir), "data", f"{partition.topic}-{partition.idx}")
-        self.log = Log(self.path)
+        if in_memory:
+            # Workload scale driver: 10k+ partitions in one process — no
+            # per-partition directory or index mmap (see log.MemLog).
+            self.path = None
+            self.log = MemLog()
+        else:
+            self.path = os.path.join(os.fspath(data_dir), "data", f"{partition.topic}-{partition.idx}")
+            self.log = Log(self.path)
 
     @property
     def leader(self) -> int:
@@ -34,15 +41,17 @@ class Replica:
 class ReplicaRegistry:
     """(topic, idx) -> Replica, created on LeaderAndIsr."""
 
-    def __init__(self, data_dir: str | os.PathLike):
+    def __init__(self, data_dir: str | os.PathLike, in_memory: bool = False):
         self._data_dir = os.fspath(data_dir)
+        self._in_memory = in_memory
         self._replicas: dict[tuple[str, int], Replica] = {}
 
     def ensure(self, partition: Partition) -> Replica:
         key = (partition.topic, partition.idx)
         rep = self._replicas.get(key)
         if rep is None:
-            rep = Replica(self._data_dir, partition)
+            rep = Replica(self._data_dir, partition,
+                          in_memory=self._in_memory)
             self._replicas[key] = rep
         else:
             # Refresh leader/isr on re-announce — but never let a groupless

@@ -147,6 +147,25 @@ class OffsetCommitBatch:
 
 
 @dataclass
+class PartitionBatch:
+    """All partitions of one CreateTopics request as a SINGLE replicated
+    transition: a 10k-partition topic is one consensus round-trip on the
+    metadata group instead of 10k (the workload plane's bulk-create path).
+    Applied exactly like a sequence of EnsurePartition transitions —
+    deterministic group claims included — in entry order."""
+
+    entries: list[Partition] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        return _dumps({"entries": [asdict(e) for e in self.entries]})
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "PartitionBatch":
+        d = json.loads(raw)
+        return cls(entries=[Partition(**e) for e in d["entries"]])
+
+
+@dataclass
 class TopicTombstone:
     """Replicated topic deletion marker (DeleteTopics has no reference
     analog — advertised but unimplemented there)."""

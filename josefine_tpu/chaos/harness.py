@@ -189,7 +189,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                  auto_crash: bool = True, auto_links: bool = True,
                  propose_rate: float = 0.15, max_proposals: int = 40,
                  active_set: bool = False, device_route: bool = False,
-                 flight_wire: bool = False):
+                 flight_wire: bool = False, workload=None):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -212,6 +212,11 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.flight_wire = flight_wire
         self.propose_rate = propose_rate
         self.max_proposals = max_proposals
+        # Product-load source (workload.chaos_traffic.ChaosTraffic): when
+        # set, drive_traffic() offers ITS schedule instead of the synthetic
+        # maybe_propose trickle; acks land in self.acked either way, so
+        # every safety checker covers the workload's writes unchanged.
+        self.workload = workload
         self.ids = list(range(1, n_nodes + 1))
         self.kvs = [MemKV() for _ in range(n_nodes)]
         # One FSM per (node, group): apply order is only defined per group.
@@ -335,6 +340,19 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.check_election_safety()
         if self.tick_no % 10 == 0:
             self.check_log_matching()
+
+    def drive_traffic(self):
+        """One tick's proposal source: the workload schedule when wired,
+        the synthetic trickle otherwise."""
+        if self.workload is not None:
+            self.workload.drive(self)
+        else:
+            self.maybe_propose()
+
+    def harvest_traffic(self):
+        self.harvest_acks()
+        if self.workload is not None:
+            self.workload.harvest(self)
 
     def maybe_propose(self):
         if self.rng.random() > self.propose_rate or self.proposed >= self.max_proposals:

@@ -44,6 +44,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          hb_ticks: int | None = None,
                          device_route: bool = False,
                          flight_wire: bool = False,
+                         workload: dict | None = None,
                          artifact_path: str | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
@@ -82,11 +83,22 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     plane = FaultPlane(seed, n_nodes, net=net)
     params = DEFAULT_PARAMS if hb_ticks is None else step_params(
         timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
+    traffic = None
+    if workload:
+        # Product load under the nemesis (workload.chaos_traffic): the
+        # tenant/topic model's arrivals replace the synthetic proposal
+        # trickle; acks flow into the same checkers. Seeded from the soak
+        # seed, so the determinism contract is unchanged.
+        from josefine_tpu.workload.chaos_traffic import ChaosTraffic
+        from josefine_tpu.workload.model import WorkloadSpec
+
+        spec = WorkloadSpec(**workload).validate()
+        traffic = ChaosTraffic(spec, seed, groups)
     cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
                            active_set=active_set, device_route=device_route,
-                           flight_wire=flight_wire)
+                           flight_wire=flight_wire, workload=traffic)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -98,11 +110,11 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     try:
         for _ in range(ticks):
             cluster.step(nemesis=nemesis)
-            cluster.maybe_propose()
-            cluster.harvest_acks()
+            cluster.drive_traffic()
+            cluster.harvest_traffic()
             await asyncio.sleep(0)  # let engine futures resolve
         cluster.heal(sched.heal_ticks)
-        cluster.harvest_acks()
+        cluster.harvest_traffic()
         cluster.assert_converged_and_linearizable()
     except InvariantViolation as e:
         violation = str(e)
@@ -174,6 +186,10 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
             "routed_msgs": sum(e.routed_msgs for e in cluster.engines),
             "host_msgs": cluster.host_delivered,
         } if device_route else None,
+        # Product-load epilogue: offered/acked/retry counters and the
+        # per-tenant latency view of THIS run (the registry histogram
+        # accumulates across soaks in one process; these are run-local).
+        "workload_stats": traffic.stats() if traffic is not None else None,
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "artifact": artifact,

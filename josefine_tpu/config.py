@@ -211,6 +211,12 @@ class BrokerConfig:
     # across restarts matters more than replayability (each such site
     # carries a graftlint allow(det-uuid) pragma saying so).
     seed: int = 0
+    # Produce admission (backpressure): refuse a replicated produce with
+    # THROTTLING_QUOTA_EXCEEDED while its partition's consensus-group
+    # proposal queue holds this many unminted entries (the client backs
+    # off and retries — bounded memory under overload instead of an
+    # ever-growing queue). 0 = unbounded (legacy behavior).
+    max_group_inflight: int = 128
     # Crash model (ARCHITECTURE.md "Durability"): "process" (default) makes
     # every ack durable to process crash (sqlite WAL synchronous=NORMAL, no
     # per-append seglog fsync); "power" additionally fsyncs the seglog
@@ -231,6 +237,8 @@ class BrokerConfig:
             raise ValueError(
                 f"broker.durability must be 'process' or 'power', "
                 f"got {self.durability!r}")
+        if self.max_group_inflight < 0:
+            raise ValueError("broker.max_group_inflight must be >= 0")
 
 
 @dataclass

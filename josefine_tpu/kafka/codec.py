@@ -79,6 +79,11 @@ class ErrorCode(enum.IntEnum):
     DUPLICATE_SEQUENCE_NUMBER = 46
     INVALID_PRODUCER_EPOCH = 47
     INVALID_RECORD = 87
+    # Produce admission backpressure: the partition's consensus-group
+    # proposal queue is over the broker's inflight cap. Retryable (Kafka
+    # semantics: the client backs off and resends), and distinct from
+    # NOT_LEADER so clients do not re-route off a healthy leader.
+    THROTTLING_QUOTA_EXCEEDED = 89
     UNKNOWN_SERVER_ERROR = -1
 
 

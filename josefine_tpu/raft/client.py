@@ -29,6 +29,11 @@ class RaftClient:
         process does not have)."""
         return self._server.engine.has_group(group)
 
+    def proposal_backlog(self, group: int) -> int:
+        """Queued-but-unminted proposals for ``group`` (the broker's
+        produce-admission gate — see handlers._produce_replicated)."""
+        return self._server.engine.proposal_backlog(group)
+
     def is_leader(self, group: int = 0) -> bool:
         return self._server.engine.is_leader(group)
 

@@ -127,6 +127,16 @@ _m_routed = REGISTRY.counter(
 _m_commit_lat = REGISTRY.histogram(
     "raft_commit_latency_ticks",
     "Proposal submit to commit-applied latency in device ticks (leader-side)")
+# Per-tenant attribution of the same latency: rows tagged via
+# set_group_tag (the workload plane tags each claimed row with its
+# tenant) additionally observe into this tenant-labelled histogram.
+# Capped: a 10k-tenant workload folds the tail into the overflow series
+# instead of exploding the registry (utils.metrics max_series).
+_m_commit_lat_tenant = REGISTRY.histogram(
+    "raft_commit_latency_ticks_by_tenant",
+    "Proposal submit to commit-applied latency in device ticks, attributed "
+    "to the tenant tag of the group row (leader-side; capped label set "
+    "with an _other overflow series)", max_series=256)
 # Scheduler / pipeline / backlog telemetry, refreshed at scrape time by the
 # engine's collect hook (_publish_telemetry) — the numbers live on the
 # engine object; publishing per tick would tax the hot path for data only
@@ -594,6 +604,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # group reset/recycle (the blocks can no longer commit).
         self._lat_open: dict[int, deque] = {}
         self._h_commit_lat = _m_commit_lat.bind(node=self.self_id)
+        # Group-row tenant tags (workload attribution): rows tagged here
+        # additionally observe commit latency into the per-tenant
+        # histogram. Cleared on recycle — the next claimant re-tags.
+        self._group_tags: dict[int, str] = {}
         # Last-scrape telemetry snapshots the collect hook publishes.
         self._last_wake_rows = 0
         self._last_bucket_k = 0
@@ -636,6 +650,26 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         """This node's proposal→commit latency summary in device ticks
         ({n, p50, p99, sum}), from the product-path histogram."""
         return _m_commit_lat.summary(node=self.self_id)
+
+    def set_group_tag(self, g: int, tag: str | None) -> None:
+        """Attribute group ``g``'s leader-side commit latency to ``tag``
+        (the workload plane passes the owning tenant). ``None`` clears.
+        Attribution only — never replicated, never journaled."""
+        if not (0 <= g < self.P):
+            raise ValueError(f"group {g} out of range (P={self.P})")
+        if tag is None:
+            self._group_tags.pop(g, None)
+        else:
+            self._group_tags[g] = str(tag)
+
+    def group_tag(self, g: int) -> str | None:
+        return self._group_tags.get(g)
+
+    def proposal_backlog(self, group: int) -> int:
+        """Queued-but-unminted proposals for ``group`` — the broker's
+        admission gate reads this to refuse produces (backpressure) when a
+        row's proposal queue backs up instead of buffering unboundedly."""
+        return len(self._proposals.get(group, ()))
 
     def enable_profiling(self, ring: int = 512) -> PhaseProfiler:
         """Attach (and return) a recording phase profiler; idempotent."""
@@ -1550,7 +1584,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 # Recycled by a group-0 commit hook earlier in THIS loop
                 # (group 0 is always processed first — proc order is
                 # ascending): every snapshot for this row predates the
-                # reset.
+                # reset. Proposals snapshotted for the row at tick_begin
+                # must FAIL, not leak — their futures were taken out of
+                # self._proposals, so nothing else will ever resolve them
+                # and a produce awaiting one would hang forever (found by
+                # the workload driver's delete-under-live-traffic soak).
+                for _payload, fut, _t_sub in props.pop(g, ()):
+                    if fut is not None and not fut.done():
+                        fut.set_exception(NotLeader(g, -1))
                 continue
             ch = self.chains[g]
             new_head = int(head_new[pos])
@@ -1670,10 +1711,15 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     # overwritten by another leader's branch (drop — it can
                     # never commit once the commit id is beyond it).
                     cids = {b.id for b in blocks}
+                    tag = self._group_tags.get(g)
                     while lat_q and lat_q[0][0] <= new_commit:
                         bid, t_sub = lat_q.popleft()
                         if bid in cids:
                             self._h_commit_lat.observe(t_now - t_sub)
+                            if tag is not None:
+                                _m_commit_lat_tenant.observe(
+                                    t_now - t_sub, node=self.self_id,
+                                    tenant=tag)
                     if not lat_q:
                         self._lat_open.pop(g, None)
                 app_blocks = []

@@ -128,8 +128,17 @@ class GroupAdmin:
         self._reset_group(g, parole=False)
         self._lift_parole(g)
         self._h_last_seen[g] = 0
-        self._proposals.pop(g, None)
+        # Queued-but-unminted proposals belong to the dead incarnation:
+        # fail their futures (NotLeader — the client re-routes/retries)
+        # instead of dropping them silently, which left produce awaits
+        # hanging until their transport timeout.
+        for _payload, fut, _t_sub in self._proposals.pop(g, ()):
+            if fut is not None and not fut.done():
+                fut.set_exception(NotLeader(g, -1))
         self._prop_groups.discard(g)
+        # Tenant attribution dies with the incarnation — the next claimant
+        # re-tags (a reused row must not bill latency to the dead tenant).
+        self._group_tags.pop(g, None)
         # Already-admitted intake for the old incarnation (the receive-time
         # filter passed it against the OLD local incarnation) must not reach
         # the device next tick.

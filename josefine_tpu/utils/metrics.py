@@ -21,6 +21,15 @@ Three metric types:
   :meth:`~Histogram.quantile` (linear interpolation inside the bucket), so
   the engine itself can quote p50/p99 commit latency without a scraper.
 
+Label cardinality is bounded per metric: a metric constructed with
+``max_series=N`` folds every label set beyond its first N distinct ones
+into ONE explicit overflow series (label values replaced by
+:data:`OVERFLOW`, the ``node`` label preserved so per-endpoint scoping
+survives). The workload plane labels series by tenant, and 10k tenants
+must not explode the registry or the Prometheus exposition — the overflow
+bucket keeps totals honest (nothing is silently dropped) while the series
+count stays O(cap). Unlabelled observations are never folded.
+
 Scrape-time collection: components whose interesting numbers live on live
 objects (the engine's scheduler stats, the phase profiler) register a
 *collect hook* (:meth:`Registry.add_collect_hook`) that refreshes gauges
@@ -41,17 +50,50 @@ from josefine_tpu.utils.tracing import get_logger
 log = get_logger("metrics")
 
 
-class Counter:
-    """Monotone counter, optionally labelled. ``inc(n, label=value, ...)``."""
+OVERFLOW = "_other"
 
-    def __init__(self, name: str, help_: str, registry: "Registry | None" = None):
+
+def _capped_key(labels: dict, values: dict, max_series: int | None) -> tuple:
+    """THE cardinality-cap rule, shared by every metric type: a new label
+    set that would overrun ``max_series`` folds into the overflow series
+    (values replaced by :data:`OVERFLOW`, the ``node`` label preserved so
+    per-endpoint scoping survives). One slot is reserved for the overflow
+    series itself, so the TOTAL stays <= max_series. Unlabelled
+    observations and already-tracked sets pass through untouched.
+
+    Deliberate boundary: a label set consisting SOLELY of ``node`` folds
+    to itself and is therefore never capped — node cardinality is bounded
+    by the cluster the operator deployed, not by client behavior, and
+    folding it away would break the per-endpoint scoping the exemption
+    exists for. The cap bounds CLIENT-driven labels (tenants, topics)."""
+    key = tuple(sorted(labels.items()))
+    if (not key or max_series is None or key in values
+            or len(values) < max_series - 1):
+        return key
+    return tuple((k, v if k == "node" else OVERFLOW) for k, v in key)
+
+
+class Counter:
+    """Monotone counter, optionally labelled. ``inc(n, label=value, ...)``.
+
+    ``max_series`` bounds distinct label sets: once the metric holds that
+    many, any NEW label set folds into the overflow series (values replaced
+    by :data:`OVERFLOW`; a ``node`` label keeps its value so node-scoped
+    exposition stays correct). Existing series keep accumulating."""
+
+    def __init__(self, name: str, help_: str, registry: "Registry | None" = None,
+                 max_series: int | None = None):
         self.name = name
         self.help = help_
+        self.max_series = max_series
         self.values: dict[tuple, float] = {}
         (registry or REGISTRY)._add(self)
 
+    def _key(self, labels: dict) -> tuple:
+        return _capped_key(labels, self.values, self.max_series)
+
     def inc(self, n: float = 1, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        key = self._key(labels)
         self.values[key] = self.values.get(key, 0) + n
 
     def get(self, **labels) -> float:
@@ -59,8 +101,11 @@ class Counter:
 
     def bind(self, **labels) -> "BoundCounter":
         """Pre-resolve the label key for hot paths (one dict op per inc
-        instead of kwargs + sort per call)."""
-        return BoundCounter(self, tuple(sorted(labels.items())))
+        instead of kwargs + sort per call). The cardinality cap is applied
+        at bind time (a bound handle IS its series)."""
+        key = self._key(labels)
+        self.values.setdefault(key, 0)
+        return BoundCounter(self, key)
 
     _TYPE = "counter"
 
@@ -86,12 +131,13 @@ class Gauge(Counter):
 
     _TYPE = "gauge"
 
-    def __init__(self, name: str, help_: str, registry: "Registry | None" = None):
-        super().__init__(name, help_, registry)
+    def __init__(self, name: str, help_: str, registry: "Registry | None" = None,
+                 max_series: int | None = None):
+        super().__init__(name, help_, registry, max_series=max_series)
         self._fns: dict[tuple, Callable[[], float]] = {}
 
     def set(self, v: float, **labels) -> None:
-        self.values[tuple(sorted(labels.items()))] = v
+        self.values[self._key(labels)] = v
 
     def set_fn(self, fn: Callable[[], float], **labels) -> None:
         """Register a sampled-at-scrape callback for this label set. A
@@ -159,22 +205,29 @@ class Histogram:
     _TYPE = "histogram"
 
     def __init__(self, name: str, help_: str,
-                 registry: "Registry | None" = None, levels: int = 16):
+                 registry: "Registry | None" = None, levels: int = 16,
+                 max_series: int | None = None):
         self.name = name
         self.help = help_
         self.levels = levels
+        self.max_series = max_series
         self.values: dict[tuple, _HistSeries] = {}
         (registry or REGISTRY)._add(self)
 
+    def _key(self, labels: dict) -> tuple:
+        return _capped_key(labels, self.values, self.max_series)
+
     def observe(self, v: float, **labels) -> None:
-        key = tuple(sorted(labels.items()))
+        key = self._key(labels)
         s = self.values.get(key)
         if s is None:
             s = self.values[key] = _HistSeries(self.levels)
         s.observe(v, self.levels)
 
     def bind(self, **labels) -> "BoundHistogram":
-        return BoundHistogram(self, tuple(sorted(labels.items())))
+        key = self._key(labels)
+        self.values.setdefault(key, _HistSeries(self.levels))
+        return BoundHistogram(self, key)
 
     def count(self, **labels) -> int:
         """Observation count. With no labels: summed over every series."""
@@ -297,25 +350,31 @@ class Registry:
             raise ValueError(f"duplicate metric {m.name}")
         self._metrics[m.name] = m
 
-    def counter(self, name: str, help_: str = "") -> Counter:
-        """Get-or-create (idempotent across node restarts in one process)."""
+    def counter(self, name: str, help_: str = "",
+                max_series: int | None = None) -> Counter:
+        """Get-or-create (idempotent across node restarts in one process).
+        On the create path ``max_series`` caps label cardinality (see the
+        module docstring); an existing metric keeps its original cap."""
         m = self._metrics.get(name)
         if m is None:
-            m = Counter(name, help_, self)
+            m = Counter(name, help_, self, max_series=max_series)
         return m
 
-    def gauge(self, name: str, help_: str = "") -> Gauge:
+    def gauge(self, name: str, help_: str = "",
+              max_series: int | None = None) -> Gauge:
         m = self._metrics.get(name)
         if m is None:
-            m = Gauge(name, help_, self)
+            m = Gauge(name, help_, self, max_series=max_series)
         if not isinstance(m, Gauge):
             raise ValueError(f"{name} is not a gauge")
         return m
 
-    def histogram(self, name: str, help_: str = "", levels: int = 16) -> Histogram:
+    def histogram(self, name: str, help_: str = "", levels: int = 16,
+                  max_series: int | None = None) -> Histogram:
         m = self._metrics.get(name)
         if m is None:
-            m = Histogram(name, help_, self, levels=levels)
+            m = Histogram(name, help_, self, levels=levels,
+                          max_series=max_series)
         if not isinstance(m, Histogram):
             raise ValueError(f"{name} is not a histogram")
         return m

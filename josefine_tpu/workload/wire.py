@@ -1,0 +1,308 @@
+"""Wire driver: the same tenant model over the REAL Kafka protocol.
+
+Where :mod:`josefine_tpu.workload.driver` measures the product path at
+scale inside one process, this driver is the end-to-end truth at smaller
+P: it speaks the actual wire protocol through ``broker/server.py`` —
+Metadata-routed produces to the partition leader (NotLeader re-routes),
+real consumer groups (FindCoordinator → JoinGroup → SyncGroup → Fetch →
+OffsetCommit → LeaveGroup), and payload verification: everything produced
+must come back from a fetch, attributed to the right topic-partition,
+and NOTHING else (cross-tenant delivery is an immediate failure).
+
+Real sockets mean real wall-clock scheduling, so the byte-stable-trace
+contract is the in-process driver's alone; this module's draws still come
+from the seeded schedule, so the OFFERED sequence is reproducible.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+from josefine_tpu.broker import records
+from josefine_tpu.kafka import client as kafka_client
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.utils.tracing import get_logger
+from josefine_tpu.workload.model import TenantModel, WorkloadSpec
+from josefine_tpu.workload.schedule import ArrivalSchedule
+
+log = get_logger("workload.wire")
+
+_RETRYABLE = (int(ErrorCode.NOT_LEADER_OR_FOLLOWER),
+              int(ErrorCode.LEADER_NOT_AVAILABLE),
+              int(ErrorCode.UNKNOWN_TOPIC_OR_PARTITION),
+              int(ErrorCode.THROTTLING_QUOTA_EXCEEDED),
+              int(ErrorCode.REQUEST_TIMED_OUT))
+
+
+class WireDriver:
+    """Multi-tenant sessions over real broker sockets (see module doc)."""
+
+    def __init__(self, spec: WorkloadSpec, seed: int,
+                 bootstrap: list[tuple[str, int]], replication: int = 1):
+        self.spec = spec.validate()
+        self.model = TenantModel(spec)
+        self.sched = ArrivalSchedule(spec, seed)
+        self.bootstrap = list(bootstrap)
+        self.replication = replication
+        self._clients: dict[tuple[str, int], kafka_client.KafkaClient] = {}
+        # (topic, partition) -> (host, port) of the current leader.
+        self._leaders: dict[tuple[str, int], tuple[str, int]] = {}
+        # Ground truth for verification: payload bytes per partition, in
+        # ack order.
+        self.produced: dict[tuple[str, int], list[bytes]] = {}
+        self.n_produced = 0
+        self.n_reroutes = 0
+        self.n_consumed = 0
+
+    # ------------------------------------------------------- connections
+
+    async def _client(self, addr: tuple[str, int]):
+        cl = self._clients.get(addr)
+        if cl is None:
+            cl = await kafka_client.connect(addr[0], addr[1],
+                                            client_id="workload-wire")
+            self._clients[addr] = cl
+        return cl
+
+    async def close(self) -> None:
+        for cl in list(self._clients.values()):
+            await cl.close()
+        self._clients.clear()
+
+    async def refresh_metadata(self) -> None:
+        cl = await self._client(self.bootstrap[0])
+        md = await cl.send(ApiKey.METADATA, 1, {
+            "topics": [{"name": n} for n in self.model.topic_names]})
+        brokers = {b["node_id"]: (b["host"], b["port"])
+                   for b in md["brokers"]}
+        for t in md["topics"]:
+            if t["error_code"] != ErrorCode.NONE:
+                continue
+            for p in t["partitions"]:
+                addr = brokers.get(p["leader_id"])
+                if addr is not None:
+                    self._leaders[(t["name"], p["partition_index"])] = addr
+
+    # ------------------------------------------------------------ setup
+
+    async def create_topics(self, timeout: float = 30.0) -> None:
+        cl = await self._client(self.bootstrap[0])
+        resp = await cl.send(ApiKey.CREATE_TOPICS, 1, {
+            "topics": [{"name": name,
+                        "num_partitions": self.spec.partitions_per_topic,
+                        "replication_factor": self.replication,
+                        "assignments": [], "configs": []}
+                       for name in self.model.topic_names],
+            "timeout_ms": int(timeout * 1000), "validate_only": False,
+        }, timeout=timeout)
+        for t in resp["topics"]:
+            if t["error_code"] not in (int(ErrorCode.NONE),
+                                       int(ErrorCode.TOPIC_ALREADY_EXISTS)):
+                raise RuntimeError(f"create_topics failed: {t}")
+        await self.refresh_metadata()
+
+    # ---------------------------------------------------------- produce
+
+    async def produce_batches(self, count: int, max_attempts: int = 60,
+                              retry_sleep: float = 0.2) -> None:
+        """Offer ``count`` schedule-drawn batches, each routed to its
+        partition's CURRENT leader; NotLeader refreshes metadata and
+        re-routes (the Kafka client loop)."""
+        if self.spec.produce_per_tick <= 0:
+            raise ValueError("produce_batches needs produce_per_tick > 0 "
+                             "(zero-rate schedules mint no arrivals)")
+        arrivals = []
+        tick = 0
+        while len(arrivals) < count:
+            arrivals.extend(self.sched.produce_arrivals(tick))
+            tick += 1
+        for arr in arrivals[:count]:
+            payload = arr.payload(self.spec)
+            batch = records.build_batch(payload,
+                                        self.spec.records_per_batch)
+            key = (arr.topic, arr.partition)
+            for attempt in range(max_attempts):
+                addr = self._leaders.get(key) or self.bootstrap[0]
+                cl = await self._client(addr)
+                resp = await cl.send(ApiKey.PRODUCE, 3, {
+                    "transactional_id": None, "acks": -1,
+                    "timeout_ms": 5000,
+                    "topics": [{"name": arr.topic, "partitions": [
+                        {"index": arr.partition, "records": batch}]}],
+                })
+                p = resp["responses"][0]["partitions"][0]
+                code = int(p["error_code"])
+                if code == int(ErrorCode.NONE):
+                    self.produced.setdefault(key, []).append(payload)
+                    self.n_produced += 1
+                    break
+                if code in _RETRYABLE:
+                    self.n_reroutes += 1
+                    await self.refresh_metadata()
+                    await asyncio.sleep(retry_sleep)
+                    continue
+                raise RuntimeError(
+                    f"produce to {key} failed with code {code}")
+            else:
+                raise RuntimeError(
+                    f"produce to {key} never accepted "
+                    f"({max_attempts} attempts)")
+
+    # ----------------------------------------------------------- consume
+
+    async def _coordinator_addr(self, group_id: str) -> tuple[str, int]:
+        for _attempt in range(40):
+            cl = await self._client(self.bootstrap[0])
+            resp = await cl.send(ApiKey.FIND_COORDINATOR, 1,
+                                 {"key": group_id, "key_type": 0})
+            if resp["error_code"] == ErrorCode.NONE:
+                return (resp["host"], resp["port"])
+            await asyncio.sleep(0.1)
+        raise RuntimeError(f"no coordinator for {group_id}")
+
+    async def consume_verify_tenant(self, tenant: int) -> int:
+        """One tenant's consumer group over the real group protocol: join,
+        leader assigns ranges, every member fetches its assignment from
+        offset 0, payloads are verified against the produced ground truth,
+        offsets are committed, members leave. Returns batches consumed."""
+        group_id = f"cg-{TenantModel.tenant_label(tenant)}"
+        n_members = max(1, self.spec.consumers_per_tenant)
+        co_addr = await self._coordinator_addr(group_id)
+        parts = [(topic, p)
+                 for topic in self.model.topics_of_tenant(tenant)
+                 for p in range(self.spec.partitions_per_topic)]
+
+        # One DEDICATED connection per member: the broker serves frames
+        # sequentially per connection, and JoinGroup/SyncGroup block until
+        # the rebalance round completes — members sharing one socket would
+        # serialize their joins into generation-per-member churn (and a
+        # follower's blocking sync ahead of the leader's would deadlock).
+        sessions = []
+        try:
+            for _ in range(n_members):
+                sessions.append(await kafka_client.connect(
+                    co_addr[0], co_addr[1], client_id="workload-consumer"))
+
+            async def join(cl) -> dict:
+                return await cl.send(ApiKey.JOIN_GROUP, 1, {
+                    "group_id": group_id, "session_timeout_ms": 30_000,
+                    "rebalance_timeout_ms": 30_000, "member_id": "",
+                    "protocol_type": "consumer",
+                    "protocols": [{"name": "range", "metadata": b""}]},
+                    timeout=40.0)
+
+            joins = await asyncio.gather(*(join(cl) for cl in sessions))
+            for j in joins:
+                if j["error_code"] != ErrorCode.NONE:
+                    raise RuntimeError(f"join failed: {j}")
+            generation = joins[0]["generation_id"]
+            leader_id = joins[0]["leader"]
+            member_ids = [j["member_id"] for j in joins]
+
+            # The group leader computes the range assignment and syncs it.
+            members_sorted = sorted(member_ids)
+            assignment = {
+                mid: [parts[i] for i in range(len(parts))
+                      if i % len(members_sorted) == rank]
+                for rank, mid in enumerate(members_sorted)
+            }
+
+            async def sync(cl, mid: str) -> dict:
+                body = {"group_id": group_id, "generation_id": generation,
+                        "member_id": mid, "assignments": []}
+                if mid == leader_id:
+                    body["assignments"] = [
+                        {"member_id": m,
+                         "assignment": json.dumps(a).encode()}
+                        for m, a in sorted(assignment.items())]
+                return await cl.send(ApiKey.SYNC_GROUP, 1, body,
+                                     timeout=40.0)
+
+            syncs = await asyncio.gather(
+                *(sync(cl, m) for cl, m in zip(sessions, member_ids)))
+            consumed = 0
+            for cl, mid, s in zip(sessions, member_ids, syncs):
+                if s["error_code"] != ErrorCode.NONE:
+                    raise RuntimeError(f"sync failed: {s}")
+                my_parts = [tuple(x) for x in json.loads(s["assignment"])] \
+                    if s["assignment"] else []
+                consumed += await self._fetch_verify_commit(
+                    cl, group_id, generation, mid, my_parts)
+            for cl, mid in zip(sessions, member_ids):
+                await cl.send(ApiKey.LEAVE_GROUP, 1,
+                              {"group_id": group_id, "member_id": mid})
+        finally:
+            for cl in sessions:
+                await cl.close()
+        return consumed
+
+    async def _fetch_verify_commit(self, co, group_id: str, generation: int,
+                                   mid: str, parts: list) -> int:
+        consumed = 0
+        offsets = []
+        for topic, p in parts:
+            expect = self.produced.get((topic, p), [])
+            addr = self._leaders.get((topic, p)) or self.bootstrap[0]
+            cl = await self._client(addr)
+            resp = await cl.send(ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+                "max_bytes": 1 << 22, "isolation_level": 0,
+                "topics": [{"topic": topic, "partitions": [
+                    {"partition": p, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 22}]}],
+            })
+            pr = resp["responses"][0]["partitions"][0]
+            if pr["error_code"] != ErrorCode.NONE:
+                raise RuntimeError(
+                    f"fetch {topic}[{p}] failed: {pr['error_code']}")
+            data = pr.get("records") or b""
+            for payload in expect:
+                if payload not in data:
+                    raise RuntimeError(
+                        f"produced payload missing from {topic}[{p}]")
+            # Cross-tenant isolation: every workload payload embeds its
+            # topic (w:<tenant>:<seq>:<topic>:<part>, '='-padded); any
+            # OTHER topic's header in this partition's data is delivery
+            # corruption. Digit guards skip coincidental binary "w:".
+            topic_b = topic.encode()
+            for seg in data.split(b"w:")[1:]:
+                fields = seg.split(b"=", 1)[0].split(b":")
+                if (len(fields) >= 4 and fields[0].isdigit()
+                        and fields[1].isdigit() and fields[2] != topic_b):
+                    raise RuntimeError(
+                        f"foreign payload in {topic}[{p}]: "
+                        f"{fields[:4]!r}")
+            consumed += len(expect)
+            offsets.append((topic, p, pr["high_watermark"]))
+        if offsets:
+            by_topic: dict[str, list] = {}
+            for topic, p, off in offsets:
+                by_topic.setdefault(topic, []).append(
+                    {"partition_index": p, "committed_offset": off,
+                     "committed_metadata": None})
+            resp = await co.send(ApiKey.OFFSET_COMMIT, 2, {
+                "group_id": group_id, "generation_id": generation,
+                "member_id": mid, "retention_time_ms": -1,
+                "topics": [{"name": n, "partitions": pl}
+                           for n, pl in sorted(by_topic.items())]})
+            for t in resp["topics"]:
+                for p in t["partitions"]:
+                    if p["error_code"] != ErrorCode.NONE:
+                        raise RuntimeError(f"offset commit failed: {p}")
+        self.n_consumed += consumed
+        return consumed
+
+    async def consume_verify(self) -> int:
+        total = 0
+        for tenant in range(self.spec.tenants):
+            total += await self.consume_verify_tenant(tenant)
+        return total
+
+    def summary(self) -> dict:
+        return {
+            "produced": self.n_produced,
+            "consumed": self.n_consumed,
+            "reroutes": self.n_reroutes,
+            "partitions_hit": len(self.produced),
+        }

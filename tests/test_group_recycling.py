@@ -572,3 +572,105 @@ async def test_churn_with_crashes_recycles_cleanly(tmp_path):
         for n in mgr.nodes:
             assert n.store.group_incarnation(1) == 3
             assert n.store.group_incarnation(2) == 3
+
+
+# ------------------------------------- recycle under live produce traffic
+
+
+def test_delete_recycle_reclaim_under_live_traffic():
+    """Topic delete → row recycle → re-claim while producers keep firing
+    (the workload driver's open loop never stops): in-flight proposals
+    against the deleted topic's rows fail CLEANLY (NotLeader/unknown-topic
+    refusals — never server errors, never a hang: the engine now fails
+    queued proposal futures at recycle instead of leaking them), the pool
+    reuses exactly the drained rows at a bumped incarnation, and the
+    re-created topic serves ONLY its own generation's records (no
+    cross-tenant, no cross-incarnation delivery)."""
+    from josefine_tpu.workload.driver import TrafficEngine
+    from josefine_tpu.workload.model import WorkloadSpec
+
+    spec = WorkloadSpec(tenants=3, partitions_per_topic=2, skew=0.4,
+                        produce_per_tick=6.0, payload_bytes=40,
+                        consumers_per_tenant=1, fetch_every_ticks=3)
+    # Pool of exactly 6 rows (P=7): reuse is REQUIRED, not incidental.
+    drv = TrafficEngine(spec, seed=17, engine_groups=7)
+
+    async def main():
+        await drv.start()
+        await drv.run_ticks(12)
+        victim = "t0001.0"
+        old_groups = sorted(p.group for p in
+                            drv.store.get_partitions(victim))
+        assert old_groups and all(g >= 1 for g in old_groups)
+
+        # Delete mid-traffic; the driver keeps offering load throughout.
+        await drv.delete_topic(victim)
+        assert sorted(drv.store._galloc_free_rows()) == old_groups
+        # The rows were claimed by a live producer stream: some produces
+        # MUST have been caught in flight and refused cleanly.
+        counts = drv.trace.counts()
+        assert counts.get("produce_rejected", 0) + \
+            counts.get("dropped", 0) > 0
+        assert drv.n_errors == 0
+        assert counts.get("recycle_ack") == len(old_groups)
+
+        # Re-create: the recycled rows are re-claimed, incarnation bumped.
+        await drv.create_topic(victim, spec.partitions_per_topic)
+        new_parts = drv.store.get_partitions(victim)
+        assert sorted(p.group for p in new_parts) == old_groups
+        for p in new_parts:
+            assert drv.store.group_incarnation(p.group) == 2
+            assert drv.engine.group_incarnation(p.group) == 2
+            # Fresh life: chain regressed to genesis before re-election.
+            assert drv.engine.is_leader(p.group)
+
+        await drv.run_ticks(12)
+        assert drv.n_errors == 0
+
+        # Every partition's log holds ONLY payloads addressed to it —
+        # the workload payload embeds (tenant, topic, partition), so one
+        # scan proves both cross-tenant isolation and that no pre-delete
+        # record survived into the new incarnation.
+        for p in drv.store.get_all_partitions():
+            rep = drv.broker.replicas.get(p.topic, p.idx)
+            if rep is None:
+                continue
+            blobs = rep.log.read_from(0, 1 << 22)
+            data = b"".join(b for _, _, b in blobs)
+            for seg in data.split(b"w:")[1:]:
+                fields = seg.split(b"=", 1)[0].split(b":")
+                if len(fields) >= 4 and fields[0].isdigit():
+                    assert fields[2] == p.topic.encode(), (p.topic, fields)
+                    assert int(fields[3]) == p.idx, (p.topic, fields)
+        # New-incarnation offsets restart at 0: the re-created topic's
+        # replica logs begin at base 0 with nothing carried over (a
+        # retained old-life record would put the first blob past 0).
+        for p in drv.store.get_partitions(victim):
+            rep = drv.broker.replicas.get(p.topic, p.idx)
+            blobs = rep.log.read_from(0, 1 << 22) if rep else []
+            if blobs:
+                assert blobs[0][0] == 0, (p.idx, blobs[0])
+
+    asyncio.run(main())
+
+
+def test_recycle_fails_queued_proposal_futures():
+    """The engine-level contract the driver relies on: proposals queued
+    (or snapshotted into an in-flight tick) for a row that gets recycled
+    FAIL with NotLeader instead of leaking unresolved futures — a produce
+    awaiting one would otherwise hang past every driver timeout."""
+    from josefine_tpu.raft.engine import NotLeader
+
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=2, params=PARAMS)
+        for _ in range(12):
+            e.tick()
+        assert e.is_leader(1)
+        fut = e.propose(1, b"doomed")
+        e.recycle_group(1)          # queued-but-unminted: failed here
+        await asyncio.sleep(0)
+        assert fut.done()
+        with pytest.raises(NotLeader):
+            fut.result()
+
+    asyncio.run(main())

@@ -164,3 +164,73 @@ def test_node_metrics_endpoint(tmp_path):
             await node.stop()
 
     asyncio.run(main())
+
+
+# ------------------------------------------------- label-cardinality cap
+
+
+def test_counter_label_cap_folds_into_overflow():
+    """A capped metric holds at most max_series distinct label sets; every
+    further NEW set lands in the explicit overflow series, so 10k tenants
+    cannot explode the registry while totals stay exact."""
+    from josefine_tpu.utils.metrics import OVERFLOW
+
+    reg = Registry()
+    c = Counter("tenant_reqs_total", "per-tenant requests", reg,
+                max_series=4)
+    for t in range(20):
+        c.inc(tenant="t%04d" % t)
+    # 3 individually tracked + the overflow series created by tenant 3.
+    assert len(c.values) == 4
+    assert sum(c.values.values()) == 20
+    assert c.get(tenant=OVERFLOW) == 17
+    # Established series keep accumulating individually past the cap.
+    c.inc(5, tenant="t0001")
+    assert c.get(tenant="t0001") == 6
+    text = reg.render_prometheus()
+    assert 'tenant_reqs_total{tenant="_other"} 17' in text
+
+
+def test_histogram_label_cap_preserves_node_scoping():
+    """The overflow fold keeps the node label so capped series still route
+    to the right /metrics endpoint; quantiles aggregate across the fold."""
+    from josefine_tpu.utils.metrics import Histogram, OVERFLOW
+
+    reg = Registry()
+    h = Histogram("lat_ticks", "latency", reg, max_series=3)
+    for t in range(12):
+        h.observe(4, node=1, tenant="t%d" % t)
+    assert len(h.values) == 3
+    assert h.count() == 12
+    assert h.count(node=1, tenant=OVERFLOW) == 10
+    # Node scoping survives the fold: node 2's endpoint sees nothing of it.
+    rendered_n2 = reg.render_prometheus(node=2)
+    assert 'tenant="_other"' not in rendered_n2
+    rendered_n1 = reg.render_prometheus(node=1)
+    assert 'tenant="_other"' in rendered_n1
+    # Aggregate quantile covers folded + tracked observations alike.
+    assert h.quantile(0.5) <= 4.0 and h.count() == 12
+
+
+def test_unlabelled_series_never_folds():
+    reg = Registry()
+    c = Counter("plain_total", "", reg, max_series=2)
+    c.inc(src=1)
+    c.inc()          # unlabelled: must stay the () series, never folds
+    c.inc(src=2)     # second labelled set: folds
+    assert c.get() == 1
+    assert c.get(src=1) == 1
+    from josefine_tpu.utils.metrics import OVERFLOW
+    assert c.get(src=OVERFLOW) == 1
+
+
+def test_bound_handles_respect_cap():
+    from josefine_tpu.utils.metrics import Histogram, OVERFLOW
+
+    reg = Registry()
+    h = Histogram("bound_lat", "", reg, max_series=2)
+    bound = [h.bind(tenant="t%d" % t) for t in range(5)]
+    for b in bound:
+        b.observe(1)
+    assert len(h.values) == 2
+    assert h.count(tenant=OVERFLOW) == 4

@@ -126,9 +126,21 @@ async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
                 await asyncio.sleep(0.05)
             else:
                 raise AssertionError("partition group never elected")
-            md = await asyncio.wait_for(cl.send(
-                ApiKey.METADATA, 1, {"topics": [{"name": "ka"}]}), 10)
-            leader0 = md["topics"][0]["partitions"][0]["leader_id"]
+            # Poll metadata until THIS broker reports a live leader: a
+            # leaderless group-backed partition now honestly answers -1
+            # (LEADER_NOT_AVAILABLE), and broker 0's engine only learns
+            # the winner from the first post-election AE — which at this
+            # deliberately huge heartbeat interval can lag is_leader on
+            # the winning node by seconds.
+            for _ in range(240):
+                md = await asyncio.wait_for(cl.send(
+                    ApiKey.METADATA, 1, {"topics": [{"name": "ka"}]}), 10)
+                leader0 = md["topics"][0]["partitions"][0]["leader_id"]
+                if leader0 >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            else:
+                raise AssertionError("metadata never reported a live leader")
             # Read the baseline only once all three nodes agree on the
             # group's term — a follower that did not grant the winning
             # vote adopts the new term on the first post-election AE, a

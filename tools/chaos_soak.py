@@ -67,6 +67,17 @@ def main() -> int:
     ap.add_argument("--timeline", default=None,
                     help="write the merged cluster timeline (JSONL, "
                          "(tick, node, seq) ordered) here")
+    ap.add_argument("--workload-tenants", type=int, default=0,
+                    help="drive the multi-tenant workload model as the "
+                         "proposal source (this many tenants; 0 = the "
+                         "legacy synthetic trickle). Zipf-skewed arrivals "
+                         "map onto the consensus groups; per-tenant "
+                         "commit-latency histograms are recorded and the "
+                         "summary carries workload_stats")
+    ap.add_argument("--workload-load", type=float, default=3.0,
+                    help="offered workload batches per tick (open loop)")
+    ap.add_argument("--workload-skew", type=float, default=1.1,
+                    help="Zipf exponent over the workload's topics")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -119,13 +130,20 @@ def main() -> int:
               file=sys.stderr)
         return 2
 
+    workload = None
+    if args.workload_tenants:
+        workload = {"tenants": args.workload_tenants,
+                    "produce_per_tick": args.workload_load,
+                    "skew": args.workload_skew}
+
     result = run_soak(
         args.seed, schedule, n_nodes=args.nodes, groups=args.groups,
         window=args.window, horizon=args.horizon,
         net=NetFaults.quiet() if args.quiet_net else None,
         auto_faults=args.auto_faults, active_set=args.active_set,
         hb_ticks=args.hb_ticks, device_route=args.device_route,
-        flight_wire=args.flight_wire, artifact_path=args.artifact)
+        flight_wire=args.flight_wire, workload=workload,
+        artifact_path=args.artifact)
 
     if args.events:
         with open(args.events, "w") as fh:
@@ -154,6 +172,8 @@ def main() -> int:
     summary["coverage_classes"] = result["coverage"]["class_counts"]
     if result.get("active_set_stats"):
         summary["active_set_stats"] = result["active_set_stats"]
+    if result.get("workload_stats"):
+        summary["workload_stats"] = result["workload_stats"]
     if result.get("device_route_stats"):
         summary["device_route_stats"] = result["device_route_stats"]
     # Observability epilogue: the full registry dump (counters, gauges,

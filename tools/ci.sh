@@ -95,6 +95,58 @@ chaos_smoke_device_route() {
         --horizon 200 --device-route --quiet-net
 }
 
+traffic_smoke() {
+    # Product-path traffic smoke: the in-process workload driver (real
+    # broker handlers over a live engine) at a small P for a few seconds,
+    # TWICE with one seed — the traces must be byte-identical (the
+    # workload determinism contract) and the summary must carry per-tenant
+    # latency quantiles and committed throughput.
+    echo "== traffic smoke =="
+    python tools/traffic_soak.py --tenants 8 --partitions 24 --ticks 50 \
+        --load 10 --seed 11 --churn 10 --out /tmp/ci_traffic_a.json \
+        --no-merge --trace-out /tmp/ci_traffic_a.jsonl > /dev/null
+    python tools/traffic_soak.py --tenants 8 --partitions 24 --ticks 50 \
+        --load 10 --seed 11 --churn 10 --out /tmp/ci_traffic_b.json \
+        --no-merge --trace-out /tmp/ci_traffic_b.jsonl > /dev/null
+    cmp /tmp/ci_traffic_a.jsonl /tmp/ci_traffic_b.jsonl
+    python - <<'PYEOF'
+import json
+row = json.load(open("/tmp/ci_traffic_a.json"))["results"][0]
+assert row["committed"] > 0, row
+assert row["p99_ticks"] >= row["p50_ticks"] > 0, row
+assert row["extra"]["tenants_with_latency"] > 0, row
+assert row["path_stats"]["replicated"] == row["committed"], row
+print("traffic ok:", row["committed"], "committed,",
+      f"p50 {row['p50_ticks']} / p99 {row['p99_ticks']} ticks,",
+      row["trace_sha256"][:16])
+PYEOF
+}
+
+traffic_chaos_smoke() {
+    # The leader-partition nemesis under REAL produce traffic: the
+    # workload model drives the proposal plane, every safety invariant
+    # must hold, and per-tenant commit-latency histograms must be
+    # recorded (workload_stats + the registry dump carry them).
+    echo "== traffic chaos smoke =="
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200 --workload-tenants 6 --workload-load 3 \
+        > /tmp/ci_traffic_chaos.json
+    python - <<'PYEOF'
+import json
+s = json.loads(open("/tmp/ci_traffic_chaos.json").read()
+               .strip().splitlines()[-1])
+assert s["invariants"] == "ok", s.get("violation")
+ws = s["workload_stats"]
+assert ws["acked"] > 0 and ws["tenants_with_latency"] > 0, ws
+assert ws["latency_ticks"]["n"] == ws["acked"], ws
+hist = s["registry_dump"].get("workload_commit_latency_ticks") or {}
+assert any(k.startswith("tenant=") for k in hist), sorted(hist)[:4]
+print("traffic chaos ok:", ws["acked"], "acked across",
+      ws["tenants_with_latency"], "tenants, p99",
+      ws["latency_ticks"]["p99"], "ticks under the partition")
+PYEOF
+}
+
 obs_smoke() {
     # Observability end-to-end: boot an engine to an election + commits,
     # start a MetricsServer, and assert over real HTTP that /metrics
@@ -121,6 +173,7 @@ if [[ "${1:-}" == "quick" ]]; then
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
     chaos_smoke_device_route
+    traffic_smoke
     obs_smoke
     perf_smoke
 else
@@ -145,7 +198,8 @@ else
         tests/test_kafka_golden.py tests/test_kafka_fuzz.py \
         tests/test_log.py tests/test_durability.py \
         tests/test_idempotent_produce.py tests/test_metrics.py \
-        tests/test_histogram.py tests/test_events_endpoint.py -q
+        tests/test_histogram.py tests/test_events_endpoint.py \
+        tests/test_workload.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
     # The active-set differential suite in its own chunk: the twin-cluster
@@ -161,6 +215,8 @@ else
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route
+    traffic_smoke
+    traffic_chaos_smoke
     obs_smoke
     perf_smoke
 fi

@@ -13,7 +13,11 @@ Three rows are checked:
   gather/step/scatter, decay kernel), which the dense floor never runs;
 * a device-routed row (P=10k, --device-route, PR 6) — catches
   regressions of the RouteFabric path (outbox-mask routing, on-device
-  scatter/merge, the ``route`` phase), which neither other floor runs.
+  scatter/merge, the ``route`` phase), which neither other floor runs;
+* a product-path traffic row (``traffic: true`` — tools/traffic_soak.py,
+  the in-process workload driver) — catches regressions of the SERVE
+  path (broker handlers → propose_local → per-partition FSM apply →
+  fetch), which the bench rows never touch.
 
 The floor ratio is deliberately loose (2x by default): CI boxes vary, and
 the stage exists to catch order-of-magnitude structural regressions, not
@@ -50,10 +54,44 @@ FLOOR_ROWS = [
      "active_set": True, "active_frac": 0.01},
     {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
      "device_route": True},
+    {"traffic": True, "tenants": 16, "partitions": 64, "ticks": 60,
+     "load": 16, "max_regression": 3.0},
 ]
 
 
+def run_traffic(floor: dict) -> dict:
+    """Product-path row: tools/traffic_soak.py (in-process workload
+    driver) instead of bench_engine — ms_per_tick of the serve loop."""
+    out = os.path.join(tempfile.gettempdir(),
+                       "josefine_perf_smoke_traffic_%d.json" % os.getpid())
+    cmd = [
+        sys.executable, os.path.join(ROOT, "tools", "traffic_soak.py"),
+        "--platform", "cpu",
+        "--tenants", str(floor["tenants"]),
+        "--partitions", str(floor["partitions"]),
+        "--ticks", str(floor.get("ticks", 60)),
+        "--load", str(floor.get("load", 16)),
+        "--seed", "7",
+        "--out", out, "--no-merge",
+    ]
+    env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
+    subprocess.run(cmd, check=True, cwd=ROOT, env=env,
+                   stdout=subprocess.DEVNULL,
+                   timeout=floor.get("timeout_s", 600))
+    try:
+        with open(out) as f:
+            row = json.load(f)["results"][0]
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    return row
+
+
 def run_bench(floor: dict) -> dict:
+    if floor.get("traffic"):
+        return run_traffic(floor)
     out = os.path.join(tempfile.gettempdir(),
                        "josefine_perf_smoke_%d.json" % os.getpid())
     cmd = [
@@ -86,6 +124,9 @@ def run_bench(floor: dict) -> dict:
 
 
 def _row_name(floor: dict) -> str:
+    if floor.get("traffic"):
+        return (f"traffic {floor['tenants']}x{floor['partitions']} "
+                f"(load {floor.get('load', 16)}/tick)")
     if floor.get("active_set"):
         return (f"P={floor['P']} active-set "
                 f"(active-frac {floor.get('active_frac')})")

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Multi-tenant product-traffic soak: real broker handlers, live engine.
+
+Drives the in-process workload driver (josefine_tpu/workload/driver.py):
+a single-node RaftEngine at P = total partitions + 1 with the replicated
+metadata FSM and the REAL broker handler stack in front of it, under
+seed-deterministic open-loop multi-tenant load with Zipfian topic
+popularity, bounded per-tenant inflight, seeded retry/backoff, consumer
+fetch/offset-commit sessions, and optional consumer-group churn.
+
+Usage:
+    python tools/traffic_soak.py --tenants 1000 --partitions 10000
+    python tools/traffic_soak.py --tenants 8 --partitions 32 --ticks 80 \
+        --load 16 --trace-out /tmp/trace.jsonl --out /tmp/bench.json
+
+Reproducibility contract (same as chaos_soak.py): two runs with the same
+(spec, --seed) produce byte-identical workload event traces — the summary
+quotes the trace sha256 so CI asserts it with one string compare.
+
+Rows merge into BENCH_traffic.json keyed on the workload axes
+(tenants, partitions, skew, offered load, active_set); per-tenant
+p50/p99 commit-latency quantiles, throughput split by path
+(replicated vs legacy-direct), and backpressure/retry counters land in
+every row.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_pre = argparse.ArgumentParser(add_help=False)
+_pre.add_argument("--platform", default=None)
+_platform = _pre.parse_known_args()[0].platform
+# A JOSEFINE_BENCH_PLATFORM preset (perf_smoke / run_guarded re-exec)
+# outranks --platform, same contract as bench_engine.py.
+_target = os.environ.get("JOSEFINE_BENCH_PLATFORM") or _platform
+if _target:
+    import jax
+
+    jax.config.update("jax_platforms", _target)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_OUT = os.path.join(ROOT, "BENCH_traffic.json")
+
+
+def _row_key(r: dict) -> tuple:
+    return (r["tenants"], r["partitions"], float(r["skew"]),
+            float(r["offered_per_tick"]), bool(r.get("active_set")))
+
+
+def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
+    merged = {_row_key(r): r for r in rows}
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        if prev.get("device") == device:
+            for r in prev.get("results", []):
+                if "tenants" in r:
+                    merged.setdefault(_row_key(r), r)
+    except (OSError, ValueError, AttributeError, KeyError, TypeError):
+        pass
+    with open(out_path, "w") as f:
+        json.dump({"bench": "workload_traffic", "device": device,
+                   "results": [merged[k] for k in sorted(merged)]},
+                  f, indent=1)
+        f.write("\n")
+
+
+async def run_soak(args) -> dict:
+    from josefine_tpu.workload.driver import TrafficEngine
+    from josefine_tpu.workload.model import WorkloadSpec
+
+    spec = WorkloadSpec.from_axes(
+        args.tenants, args.partitions, args.skew, args.load,
+        records_per_batch=args.records,
+        consumers_per_tenant=args.consumers,
+        churn_every_ticks=args.churn,
+        max_inflight_per_tenant=args.inflight,
+    )
+    drv = TrafficEngine(spec, seed=args.seed, active_set=args.active_set,
+                        window=args.window, hb_ticks=args.hb_ticks)
+    t0 = time.perf_counter()
+    await drv.start()
+    t_boot = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    await drv.run_ticks(args.ticks)
+    wall = time.perf_counter() - t1
+    s = drv.summary()
+    ran = drv.tick  # soak ticks incl. the drain epilogue
+    row = {
+        "driver": "inproc",
+        "tenants": spec.tenants,
+        "partitions": spec.total_partitions,
+        "skew": spec.skew,
+        "offered_per_tick": spec.produce_per_tick,
+        "ticks": ran,
+        "seed": args.seed,
+        "active_set": bool(args.active_set),
+        "window": args.window,
+        "bootstrap_s": round(t_boot, 3),
+        "wall_s": round(wall, 3),
+        "ms_per_tick": round(1000.0 * wall / max(1, ran), 3),
+        "batches_per_sec": round(s["committed"] / max(wall, 1e-9), 1),
+        "committed": s["committed"],
+        "offered": s["offered"],
+        "p50_ticks": s["latency_ticks"]["p50"],
+        "p99_ticks": s["latency_ticks"]["p99"],
+        "path_stats": s["path_stats"],
+        "backpressure": s["backpressure"],
+        "trace_sha256": s["trace_sha256"],
+        "extra": {
+            "engine_latency_device_ticks": s["engine_latency_device_ticks"],
+            "latency_by_tenant_top": s["latency_by_tenant_top"],
+            "tenants_with_latency": s["tenants_with_latency"],
+            "fetched_bytes": s["fetched_bytes"],
+            "offset_commits": s["offset_commits"],
+            "recycle_acks": s["recycle_acks"],
+            "trace_events": s["trace_events"],
+            "spec": s["spec"],
+        },
+    }
+    if args.trace_out:
+        drv.trace.dump(args.trace_out)
+        row["extra"]["trace_out"] = os.path.abspath(args.trace_out)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--platform", default=None,
+                    help="jax platform override (e.g. cpu)")
+    ap.add_argument("--tenants", type=int, default=100)
+    ap.add_argument("--partitions", type=int, default=1000,
+                    help="TOTAL partitions (one topic per tenant, "
+                         "partitions split evenly)")
+    ap.add_argument("--skew", type=float, default=1.1,
+                    help="Zipf exponent over the topic list (0 = uniform)")
+    ap.add_argument("--load", type=float, default=64.0,
+                    help="offered produce batches per virtual tick "
+                         "(open loop)")
+    ap.add_argument("--ticks", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--records", type=int, default=4,
+                    help="records per produced batch")
+    ap.add_argument("--consumers", type=int, default=1,
+                    help="consumer sessions per tenant")
+    ap.add_argument("--churn", type=int, default=0,
+                    help="consumer join/leave churn period in ticks (0=off)")
+    ap.add_argument("--inflight", type=int, default=4,
+                    help="max produce requests in flight per tenant")
+    ap.add_argument("--window", type=int, default=1)
+    ap.add_argument("--hb-ticks", type=int, default=1)
+    ap.add_argument("--active-set", action="store_true",
+                    help="engine runs the active-set compacted scheduler")
+    ap.add_argument("--trace-out", default=None,
+                    help="write the byte-stable workload event trace "
+                         "(JSONL) here")
+    ap.add_argument("--out", default=None,
+                    help="results file (default: merge into "
+                         "BENCH_traffic.json)")
+    ap.add_argument("--no-merge", action="store_true",
+                    help="write --out verbatim instead of merging")
+    args = ap.parse_args()
+
+    row = asyncio.run(run_soak(args))
+    print(json.dumps(row, indent=1))
+
+    import jax
+
+    device = str(jax.devices()[0])
+    out = args.out or DEFAULT_OUT
+    if args.no_merge:
+        with open(out, "w") as f:
+            json.dump({"bench": "workload_traffic", "device": device,
+                       "results": [row]}, f, indent=1)
+            f.write("\n")
+    else:
+        merge_rows(out, [row], device)
+    print(f"-> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
