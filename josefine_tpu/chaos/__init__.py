@@ -17,6 +17,10 @@ first-class subsystem:
   wire engines to a fault plane.
 * :mod:`josefine_tpu.chaos.soak` — the programmatic soak runner behind
   ``tools/chaos_soak.py``.
+* :mod:`josefine_tpu.chaos.search` — coverage-guided schedule search
+  (seeded mutation of nemesis schedules + workload knobs, novelty scoring
+  against a persistent corpus, ddmin repro minimization) behind
+  ``tools/chaos_search.py``.
 
 The product stack never imports this package: hooks in
 ``raft/tcp.py`` / ``utils/kv.py`` / ``broker/log.py`` default to None and

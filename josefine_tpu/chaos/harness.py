@@ -89,6 +89,7 @@ class _PlaneDrivenCluster:
         arch = getattr(self, "flight_archive", None)
         if arch is None or prev is None or i >= len(prev) or prev[i] is None:
             return
+        self.flight_dropped += prev[i].flight.dropped
         arch[i].extend(prev[i].flight.events())
         arch[i].append({"seq": -1, "tick": self.tick_no, "kind": "boot",
                         "group": -1, "term": -1, "leader": -1})
@@ -125,6 +126,19 @@ class _PlaneDrivenCluster:
     # summary's routed/host split stays correct across multiple runs in
     # one process.
     host_delivered = 0
+
+    # Flight-ring wraparound ledger: events evicted from DEAD engines'
+    # rings (banked at archive time; live engines' drops are read off
+    # their recorders directly). See flight_ring_dropped().
+    flight_dropped = 0
+
+    def flight_ring_dropped(self) -> int:
+        """Total journal events lost to ring wraparound across the run —
+        archived incarnations plus every live engine. Nonzero means the
+        merged timeline (and hence the coverage signature) was computed
+        over a truncated history."""
+        return self.flight_dropped + sum(
+            e.flight.dropped for e in self.engines if e is not None)
 
     def _deliver_matured(self) -> None:
         """Deliver delayed messages whose virtual delivery tick arrived;
@@ -189,7 +203,8 @@ class ChaosCluster(_PlaneDrivenCluster):
                  auto_crash: bool = True, auto_links: bool = True,
                  propose_rate: float = 0.15, max_proposals: int = 40,
                  active_set: bool = False, device_route: bool = False,
-                 flight_wire: bool = False, workload=None):
+                 flight_wire: bool = False, workload=None,
+                 flight_ring: int = 4096):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -210,6 +225,11 @@ class ChaosCluster(_PlaneDrivenCluster):
         # message path, not just state transitions — the substrate of the
         # coverage signatures (utils/coverage.py) and trace_report.
         self.flight_wire = flight_wire
+        # Per-engine flight-recorder ring capacity: a searched soak with
+        # wire tracing at scale overflows the 4096 default and silently
+        # truncates the timeline the coverage scorer depends on — the soak
+        # sizes it (run_soak flight_ring=) and warns on wraparound.
+        self.flight_ring = int(flight_ring)
         self.propose_rate = propose_rate
         self.max_proposals = max_proposals
         # Product-load source (workload.chaos_traffic.ChaosTraffic): when
@@ -259,6 +279,7 @@ class ChaosCluster(_PlaneDrivenCluster):
             sparse_io=True if self.sparse else None,
             active_set=self.active_set,
             flight_wire=self.flight_wire,
+            flight_ring=self.flight_ring,
         )
         if self.k_out is not None:
             e._k_out = self.k_out

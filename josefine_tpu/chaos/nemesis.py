@@ -41,6 +41,107 @@ from josefine_tpu.chaos.faults import FaultPlane
 _OPS = ("block_link", "heal_link", "partition", "isolate", "heal_all",
         "crash", "restart", "disk", "skew")
 
+#: Disk fault classes arm_disk_fault accepts (mirrored here so the DSL
+#: boundary can reject a bad ``fault`` before a soak ever starts).
+DISK_FAULTS = ("kv_write", "kv_flush", "log_append", "log_torn", "log_flush")
+
+#: Dynamic targets _resolve understands.
+TARGETS = ("leader", "follower")
+
+#: Per-op argument catalog: the single source of truth for BOTH schedule
+#: validation (Schedule.validate / from_json — mutation can generate
+#: garbage, and the boundary must reject it loudly instead of failing deep
+#: inside Nemesis.apply mid-soak) and the search mutator's generative
+#: grammar (chaos/search.py draws ops and args from this table).
+OP_ARGS: dict[str, dict[str, tuple[str, ...]]] = {
+    "block_link": {"required": ("src", "dst"), "optional": ("for",)},
+    "heal_link":  {"required": ("src", "dst"), "optional": ()},
+    "partition":  {"required": ("a", "b"),
+                   "optional": ("for", "symmetric")},
+    "isolate":    {"required": (),
+                   "optional": ("node", "target", "for", "symmetric",
+                                "group")},
+    "heal_all":   {"required": (), "optional": ()},
+    "crash":      {"required": (), "optional": ("node", "target", "for",
+                                                "group")},
+    "restart":    {"required": ("node",), "optional": ()},
+    "disk":       {"required": ("fault",),
+                   "optional": ("node", "target", "p", "for", "group")},
+    "skew":       {"required": ("stride",),
+                   "optional": ("node", "target", "group")},
+}
+
+
+def _is_int(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _check_arg(name: str, v) -> str | None:
+    """One argument's domain check; returns an error string or None."""
+    if name in ("src", "dst", "node", "group"):
+        if not _is_int(v) or v < 0:
+            return f"{name}={v!r} must be a node/group index >= 0"
+    elif name in ("a", "b"):
+        if (not isinstance(v, (list, tuple)) or not v
+                or not all(_is_int(x) and x >= 0 for x in v)):
+            return f"{name}={v!r} must be a non-empty list of node indices"
+    elif name == "for":
+        if not _is_int(v) or v < 1:
+            return f"for={v!r} must be a duration >= 1 tick"
+    elif name == "p":
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or not 0.0 <= float(v) <= 1.0:
+            return f"p={v!r} must be a probability in [0, 1]"
+    elif name == "stride":
+        if not _is_int(v) or v < 1:
+            return f"stride={v!r} must be an integer >= 1"
+    elif name == "fault":
+        if v not in DISK_FAULTS:
+            return f"fault={v!r} not one of {DISK_FAULTS}"
+    elif name == "target":
+        if v not in TARGETS:
+            return f"target={v!r} not one of {TARGETS}"
+    elif name == "symmetric":
+        if not isinstance(v, bool):
+            return f"symmetric={v!r} must be a bool"
+    return None
+
+
+def validate_step(index: int, at, op, args: dict,
+                  n_nodes: int | None = None) -> None:
+    """Validate one raw (at, op, args) triple, raising :class:`ValueError`
+    that names the offending step index — the loud boundary between the
+    schedule DSL (which mutation and operators hand us) and the soak."""
+    def bad(msg: str):
+        raise ValueError(f"schedule step {index}: {msg}")
+
+    if not _is_int(at) or at < 0:
+        bad(f"negative or non-integer at={at!r}")
+    if op not in _OPS:
+        bad(f"unknown op {op!r} (known: {', '.join(_OPS)})")
+    spec = OP_ARGS[op]
+    known = set(spec["required"]) | set(spec["optional"])
+    for name in sorted(args):
+        if name not in known:
+            bad(f"op {op!r} does not take arg {name!r} "
+                f"(takes: {', '.join(sorted(known)) or 'nothing'})")
+        err = _check_arg(name, args[name])
+        if err:
+            bad(f"op {op!r}: {err}")
+    for name in spec["required"]:
+        if name not in args:
+            bad(f"op {op!r} missing required arg {name!r}")
+    if n_nodes is not None:
+        for name in ("src", "dst", "node"):
+            if name in args and args[name] >= n_nodes:
+                bad(f"{name}={args[name]} out of range for "
+                    f"{n_nodes}-node cluster")
+        for name in ("a", "b"):
+            for x in args.get(name, ()):
+                if x >= n_nodes:
+                    bad(f"{name} contains node {x}, out of range for "
+                        f"{n_nodes}-node cluster")
+
 
 @dataclass
 class Step:
@@ -72,13 +173,38 @@ class Schedule:
 
     @classmethod
     def from_json(cls, text: str) -> "Schedule":
+        """Parse and VALIDATE the DSL. A malformed step — unknown op,
+        negative at, unknown/ill-typed/missing args — raises a
+        :class:`ValueError` naming the step index, instead of surfacing as
+        a KeyError/TypeError deep inside ``Nemesis.apply`` mid-soak."""
         d = json.loads(text)
+        if not isinstance(d.get("steps"), list):
+            raise ValueError("schedule JSON needs a 'steps' list")
         steps = []
-        for raw in d["steps"]:
+        for i, raw in enumerate(d["steps"]):
+            if not isinstance(raw, dict):
+                raise ValueError(f"schedule step {i}: not an object")
             raw = dict(raw)
-            steps.append(Step(at=raw.pop("at"), op=raw.pop("op"), args=raw))
-        return cls(name=d["name"], steps=steps, horizon=d["horizon"],
-                   heal_ticks=d.get("heal_ticks", 140))
+            at, op = raw.pop("at", None), raw.pop("op", None)
+            validate_step(i, at, op, raw)
+            steps.append(Step(at=at, op=op, args=raw))
+        sched = cls(name=d["name"], steps=steps, horizon=d["horizon"],
+                    heal_ticks=d.get("heal_ticks", 140))
+        return sched.validate()
+
+    def validate(self, n_nodes: int | None = None) -> "Schedule":
+        """Whole-schedule validation (every step via :func:`validate_step`,
+        plus horizon/heal bounds and — when ``n_nodes`` is given — node
+        ranges). Returns self, so builders can end with ``.validate()``."""
+        if not _is_int(self.horizon) or self.horizon < 1:
+            raise ValueError(f"schedule horizon={self.horizon!r} "
+                             "must be an integer >= 1")
+        if not _is_int(self.heal_ticks) or self.heal_ticks < 0:
+            raise ValueError(f"schedule heal_ticks={self.heal_ticks!r} "
+                             "must be an integer >= 0")
+        for i, s in enumerate(self.steps):
+            validate_step(i, s.at, s.op, s.args, n_nodes=n_nodes)
+        return self
 
     def then(self, other: "Schedule", gap: int = 40) -> "Schedule":
         """Compose sequentially: other's steps shifted past this horizon."""
@@ -104,6 +230,11 @@ class Nemesis:
         self.schedule = schedule
         self.plane = plane
         self.cluster = cluster
+        # Steps whose dynamic target did not resolve at apply time (e.g.
+        # "leader" during a leaderless window): skipped-and-recorded per
+        # the module contract, and surfaced in the soak summary so a
+        # search scorer can see a candidate's wasted steps.
+        self.skipped: list[dict] = []
         self._by_tick: dict[int, list[Step]] = {}
         for s in schedule.steps:
             self._by_tick.setdefault(s.at, []).append(s)
@@ -154,6 +285,8 @@ class Nemesis:
             node = self._resolve(a)
             if node is None:
                 p._event("nemesis_skipped", op=step.op, at=step.at)
+                self.skipped.append({"at": step.at, "op": step.op,
+                                     "target": a.get("target", "leader")})
                 return
             if step.op == "isolate":
                 p.isolate(node, until=self._until(a),

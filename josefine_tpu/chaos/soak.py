@@ -23,16 +23,22 @@ from josefine_tpu.models.types import step_params
 from josefine_tpu.utils.coverage import CoverageMap
 from josefine_tpu.utils.flight import merge_journals, timeline_jsonl
 from josefine_tpu.utils.metrics import REGISTRY
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("chaos.soak")
 
 
 def resolve_schedule(name_or_schedule, n_nodes: int = 3) -> Schedule:
     """A Schedule passes through; a bundled name builds one; a string of
-    JSON (or anything with a ``read``) parses the DSL."""
+    JSON (or anything with a ``read``) parses the DSL. Every path ends in
+    :meth:`Schedule.validate` against the cluster size — a mutated or
+    hand-edited schedule with garbage steps fails HERE, naming the step,
+    not deep inside ``Nemesis.apply`` mid-soak."""
     if isinstance(name_or_schedule, Schedule):
-        return name_or_schedule
+        return name_or_schedule.validate(n_nodes)
     if name_or_schedule in SCHEDULES:
         return SCHEDULES[name_or_schedule](n_nodes)
-    return Schedule.from_json(name_or_schedule)
+    return Schedule.from_json(name_or_schedule).validate(n_nodes)
 
 
 async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
@@ -45,7 +51,9 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          device_route: bool = False,
                          flight_wire: bool = False,
                          workload: dict | None = None,
-                         artifact_path: str | None = None) -> dict:
+                         artifact_path: str | None = None,
+                         flight_ring: int | None = None,
+                         commitless_limit: int | None = None) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -74,6 +82,21 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     ``coverage`` / ``coverage_signature``, the journal-derived fingerprint
     a nemesis search driver scores runs by (utils/coverage.py).
 
+    ``flight_ring`` sizes each engine's flight-recorder ring (default
+    4096). Searched soaks with wire tracing overflow the default and
+    silently truncate the timeline the coverage scorer depends on; the
+    result's ``flight_ring`` block reports how many events wraparound
+    discarded, and a nonzero count logs a warning.
+
+    ``commitless_limit`` arms the availability probe: if no proposal is
+    acked for more than this many consecutive virtual ticks during the
+    chaotic phase, the run raises an :class:`InvariantViolation`
+    ("availability: ..."). Off by default — the bundled schedules' safety
+    guarantees are stated without it; the chaos search aims it at
+    schedules that starve commit progress entirely (full quorum loss),
+    and the result's ``max_commitless_window`` lets a scorer see
+    near-misses either way.
+
     On an invariant violation the run auto-dumps a JSON repro artifact —
     the per-node flight-recorder journals, the metrics-registry dump, the
     fault-event log, and the violation — to ``artifact_path`` (default
@@ -98,7 +121,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
                            active_set=active_set, device_route=device_route,
-                           flight_wire=flight_wire, workload=traffic)
+                           flight_wire=flight_wire, workload=traffic,
+                           flight_ring=flight_ring or 4096)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -107,12 +131,35 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     # violation must still yield the summary + the event log (the repro
     # artifact is the entire point of catching one).
     violation = None
+    last_progress = 0   # last chaotic tick where the acked total grew
+    max_stall = 0       # longest commitless window seen (search telemetry)
+    prev_acked = 0
     try:
         for _ in range(ticks):
             cluster.step(nemesis=nemesis)
             cluster.drive_traffic()
             cluster.harvest_traffic()
             await asyncio.sleep(0)  # let engine futures resolve
+            now_acked = sum(len(v) for v in cluster.acked.values())
+            if now_acked > prev_acked:
+                prev_acked, last_progress = now_acked, cluster.tick_no
+            elif (traffic is None and not cluster.pending
+                    and cluster.proposed >= cluster.max_proposals):
+                # Nothing is being offered: the synthetic trickle's budget
+                # is spent and no proposal is in flight. A commitless
+                # window here is absence of LOAD, not of availability —
+                # freeze the stall clock instead of false-tripping the
+                # probe on a healthy, merely-idle cluster. (The workload
+                # source is open-loop and always offering.)
+                last_progress = cluster.tick_no
+            stall = cluster.tick_no - last_progress
+            if stall > max_stall:
+                max_stall = stall
+            if commitless_limit is not None and stall > commitless_limit:
+                raise InvariantViolation(
+                    f"availability: no ack committed for {stall} ticks "
+                    f"(> commitless_limit {commitless_limit}) at tick "
+                    f"{cluster.tick_no}")
         cluster.heal(sched.heal_ticks)
         cluster.harvest_traffic()
         cluster.assert_converged_and_linearizable()
@@ -152,6 +199,14 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         except OSError:
             artifact = None
 
+    ring_dropped = cluster.flight_ring_dropped()
+    if ring_dropped:
+        log.warning(
+            "flight ring wraparound discarded %d journal events "
+            "(capacity %d per engine) — the merged timeline and coverage "
+            "signature cover a TRUNCATED history; raise flight_ring "
+            "(chaos_soak --flight-ring)", ring_dropped, cluster.flight_ring)
+
     acked_total = sum(len(v) for v in cluster.acked.values())
     return {
         "schedule": sched.name,
@@ -190,6 +245,20 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # per-tenant latency view of THIS run (the registry histogram
         # accumulates across soaks in one process; these are run-local).
         "workload_stats": traffic.stats() if traffic is not None else None,
+        # Dynamic-target steps that resolved to nothing (e.g. "leader"
+        # during a leaderless window): skipped-and-recorded per the
+        # nemesis contract; a search scorer reads this as wasted genome.
+        "nemesis_skipped": len(nemesis.skipped),
+        "nemesis_skipped_steps": list(nemesis.skipped),
+        # Longest commitless window of the chaotic phase, and the armed
+        # limit (None = probe off): the availability axis of the run.
+        "max_commitless_window": max_stall,
+        "commitless_limit": commitless_limit,
+        # Journal-truncation honesty: nonzero dropped means the timeline
+        # (and so the coverage signature) was computed over a truncated
+        # history — size the ring up for searched soaks at scale.
+        "flight_ring": {"capacity": cluster.flight_ring,
+                        "dropped": ring_dropped},
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "artifact": artifact,

@@ -44,7 +44,8 @@ import hashlib
 
 from josefine_tpu.utils.metrics import REGISTRY
 
-__all__ = ["CoverageMap"]
+__all__ = ["CoverageMap", "corpus_coverage", "corpus_entry_filename",
+           "load_corpus_entries", "save_corpus_entry"]
 
 _WIRE_KINDS = ("msg_sent", "msg_delivered")
 
@@ -202,6 +203,12 @@ class CoverageMap:
         return CoverageMap({feat: n for feat, n in self.counts.items()
                             if feat not in other.counts})
 
+    def novelty(self, corpus: "CoverageMap") -> int:
+        """The search driver's score: how many DISTINCT features this run
+        covered that the corpus has never seen (``len(self.diff(corpus))``
+        without building the intermediate map)."""
+        return sum(1 for feat in self.counts if feat not in corpus.counts)
+
     def __len__(self) -> int:
         return len(self.counts)
 
@@ -261,3 +268,67 @@ class CoverageMap:
     @classmethod
     def from_dict(cls, data: dict) -> "CoverageMap":
         return cls(data.get("counts") or {})
+
+
+# ----------------------------------------------------------- corpus storage
+#
+# The chaos-search corpus (tests/fixtures/chaos_corpus/ and any --corpus
+# dir) is a directory of one-JSON-file-per-entry records:
+#
+#   {"name", "schedule": <DSL dict>, "workload": <knobs|null>, "seed",
+#    "signature", "class_counts", "features": [keys...], "origin",
+#    "iteration", "parent"}
+#
+# ``features`` holds the entry's covered-feature KEYS (not counts): enough
+# to rebuild the corpus union exactly without re-running any soak, which is
+# what makes the corpus resumable — a fresh search process loads the
+# directory and scores novelty against the same union the previous run
+# ended with. Filenames embed the signature prefix so entries are
+# content-addressed and a directory listing is deterministic.
+
+def corpus_entry_filename(entry: dict) -> str:
+    """Deterministic, content-addressed entry filename."""
+    sig = entry.get("signature") or "empty"
+    return f"entry_{sig[:16]}.json"
+
+
+def save_corpus_entry(dirpath: str, entry: dict) -> str:
+    """Write one corpus entry (sorted keys — byte-stable); returns the
+    path. Overwrites a same-signature entry (content-addressed)."""
+    import json
+    import os
+
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, corpus_entry_filename(entry))
+    with open(path, "w") as fh:
+        json.dump(entry, fh, sort_keys=True, indent=1)
+        fh.write("\n")
+    return path
+
+
+def load_corpus_entries(dirpath: str) -> list[dict]:
+    """Load every ``entry_*.json`` in a corpus directory, sorted by
+    filename (deterministic iteration order for scoring and parent
+    selection). A missing directory is an empty corpus."""
+    import json
+    import os
+
+    if not os.path.isdir(dirpath):
+        return []
+    out = []
+    for name in sorted(os.listdir(dirpath)):
+        if name.startswith("entry_") and name.endswith(".json"):
+            with open(os.path.join(dirpath, name)) as fh:
+                out.append(json.load(fh))
+    return out
+
+
+def corpus_coverage(entries) -> CoverageMap:
+    """The corpus union: one CoverageMap covering every feature any entry
+    covered (counts = how many entries cover the feature — the fold a
+    candidate's ``novelty()`` is scored against)."""
+    cov = CoverageMap()
+    for e in entries:
+        for feat in e.get("features", ()):
+            cov.add(feat)
+    return cov

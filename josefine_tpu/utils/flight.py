@@ -156,6 +156,14 @@ class FlightRecorder:
     def __len__(self) -> int:
         return len(self._ring)
 
+    @property
+    def dropped(self) -> int:
+        """Events evicted by ring wraparound (``seq`` counts every emit;
+        the ring holds at most ``capacity``) — nonzero means the journal a
+        reader sees is a TRUNCATED suffix of the run's history, which a
+        coverage scorer must know about (run_soak warns on it)."""
+        return self.seq - len(self._ring)
+
     def events(self, limit: int | None = None, group: int | None = None,
                kind: str | None = None,
                since: int | None = None) -> list[dict]:

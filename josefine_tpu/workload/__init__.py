@@ -24,7 +24,10 @@ Pieces:
 * :mod:`~josefine_tpu.workload.chaos_traffic` — the adapter that runs the
   same tenant model as proposal traffic inside a
   :class:`~josefine_tpu.chaos.harness.ChaosCluster`, so nemesis schedules
-  execute under real produce load with per-tenant latency attribution.
+  execute under real produce load with per-tenant latency attribution;
+* :mod:`~josefine_tpu.workload.genome` — the knob catalog (bounds +
+  seeded mutation) the coverage-guided chaos search treats as the
+  traffic half of a candidate's genome.
 """
 
 from josefine_tpu.workload.model import TenantModel, WorkloadSpec, zipf_weights

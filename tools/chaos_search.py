@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Coverage-guided chaos search CLI: mutate nemesis schedules, score by
+signature novelty, keep minimized repros.
+
+Usage:
+    # quick bounded search from the committed corpus
+    python tools/chaos_search.py --seed 7 --budget-iters 25 \
+        --corpus /tmp/corpus --log /tmp/search.jsonl
+
+    # seed a fresh corpus with the six bundled nemeses and exit
+    python tools/chaos_search.py --seed 7 --corpus /tmp/corpus --bootstrap
+
+    # the long-soak configuration: active-set + device-route + live
+    # tenant traffic, resumable corpus, wall-clock budget
+    python tools/chaos_search.py --seed 7 --budget-seconds 3600 \
+        --corpus ./chaos_corpus --repro-dir ./chaos_repros \
+        --log ./search.jsonl --active-set --hb-ticks 4 --groups 8 \
+        --device-route --quiet-net --workload-tenants 6 \
+        --commitless-limit 120
+
+Every candidate runs through ``run_soak`` (the same entry point as
+``tools/chaos_soak.py``); novelty is scored by CoverageMap.diff against
+the corpus union; invariant trips are ddmin-minimized and kept as
+replayable repro JSONs (replay one with
+``tools/chaos_soak.py --schedule-file repro.json`` is NOT the form —
+repro files carry the soak config too; use ``--replay repro.json`` here).
+
+Determinism: same seed + same starting corpus + ``--budget-iters`` =>
+byte-identical search log and final corpus signatures (the CI
+``chaos_search_smoke`` pins this). ``--budget-seconds`` reads the wall
+clock for its stop gate only; per-iteration log lines stay
+wall-clock-free either way, so a resumed long soak keeps its log
+auditable.
+
+Exit code 0 on a completed budget, 1 if any invariant violation was
+found (the repro files name them), 2 on usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def replay(path: str) -> int:
+    """Replay a repro JSON (minimized schedule + seed + soak config) and
+    report whether the recorded violation still trips."""
+    from josefine_tpu.chaos.faults import NetFaults
+    from josefine_tpu.chaos.nemesis import Schedule
+    from josefine_tpu.chaos.soak import run_soak
+
+    with open(path) as fh:
+        rep = json.load(fh)
+    soak = rep.get("soak", {})
+    result = run_soak(
+        rep["seed"], Schedule.from_json(json.dumps(rep["schedule"])),
+        n_nodes=soak.get("n_nodes", 3), groups=soak.get("groups", 2),
+        net=NetFaults.quiet() if soak.get("quiet_net") else None,
+        active_set=soak.get("active_set", False),
+        hb_ticks=soak.get("hb_ticks"),
+        device_route=soak.get("device_route", False),
+        flight_wire=soak.get("flight_wire", True),
+        workload=rep.get("workload"),
+        commitless_limit=soak.get("commitless_limit"),
+        flight_ring=soak.get("flight_ring"),
+        artifact_path=os.devnull)
+    print(json.dumps({
+        "repro": path,
+        "recorded_violation": rep["violation"],
+        "replayed_violation": result["violation"],
+        "reproduced": result["violation"] is not None,
+        "minimized_steps": rep["minimized_steps"],
+        "trigger_steps": rep["trigger_steps"],
+    }))
+    return 0 if result["violation"] is not None else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--corpus", required=False, default=None,
+                    help="corpus directory (created if missing; entries "
+                         "persist — rerunning resumes from them). Omit "
+                         "for an in-memory corpus")
+    ap.add_argument("--budget-iters", type=int, default=None,
+                    help="iterations to run THIS invocation (the "
+                         "deterministic budget; same seed + corpus => "
+                         "byte-identical log)")
+    ap.add_argument("--budget-seconds", type=float, default=None,
+                    help="wall-clock budget (long-soak mode; combinable "
+                         "with --budget-iters, whichever trips first)")
+    ap.add_argument("--bootstrap", action="store_true",
+                    help="only seed the corpus with the six bundled "
+                         "nemeses under this soak config, then exit")
+    ap.add_argument("--replay", default=None, metavar="REPRO_JSON",
+                    help="replay a repro file and exit (0 iff the "
+                         "recorded violation still trips)")
+    ap.add_argument("--log", default=None,
+                    help="append per-iteration JSONL search log here")
+    ap.add_argument("--repro-dir", default=None,
+                    help="directory for minimized-violation repro JSONs "
+                         "(default: <corpus>/repros when --corpus is set)")
+    ap.add_argument("--corpus-cap", type=int, default=64,
+                    help="max corpus entries before stale-lineage "
+                         "retirement (default 64)")
+    ap.add_argument("--min-novel", type=int, default=1,
+                    help="distinct new features a run must cover to be "
+                         "admitted (default 1)")
+    ap.add_argument("--no-minimize", action="store_true",
+                    help="skip ddmin minimization on violations (keep "
+                         "raw candidates only)")
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--groups", type=int, default=2)
+    ap.add_argument("--active-set", action="store_true",
+                    help="candidates run under the active-set compacted "
+                         "scheduler (pair with --hb-ticks > 1)")
+    ap.add_argument("--hb-ticks", type=int, default=None)
+    ap.add_argument("--device-route", action="store_true",
+                    help="candidates run with device-resident routing "
+                         "(pair with --quiet-net so clean links route)")
+    ap.add_argument("--quiet-net", action="store_true",
+                    help="no probabilistic noise; the searched schedule "
+                         "is the only fault source")
+    ap.add_argument("--no-flight-wire", action="store_true",
+                    help="disable wire tracing (drops the path-mix and "
+                         "wire-kgram coverage classes; searches score on "
+                         "state transitions only)")
+    ap.add_argument("--flight-ring", type=int, default=None,
+                    help="per-engine flight ring capacity for candidate "
+                         "soaks (see chaos_soak.py --flight-ring)")
+    ap.add_argument("--commitless-limit", type=int, default=None,
+                    help="arm the availability probe: candidates that "
+                         "starve every group's commit progress past this "
+                         "many ticks VIOLATE (the searchable liveness "
+                         "axis)")
+    ap.add_argument("--workload-tenants", type=int, default=0,
+                    help="drive tenant traffic and include the workload "
+                         "knobs (skew/churn/load/inflight) in the "
+                         "mutation genome (0 = no traffic)")
+    ap.add_argument("--workload-load", type=float, default=3.0)
+    ap.add_argument("--workload-skew", type=float, default=1.1)
+    ap.add_argument("--max-horizon", type=int, default=400,
+                    help="clamp mutated schedule horizons (soak-scale "
+                         "guard rail; default 400 ticks)")
+    ap.add_argument("--max-heal", type=int, default=140)
+    ap.add_argument("--platform", default="cpu")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", args.platform)
+    import jax
+
+    jax.config.update("jax_platforms", args.platform)
+
+    if args.replay:
+        return replay(args.replay)
+
+    from josefine_tpu.chaos.search import ChaosSearch, Corpus, SearchLimits
+
+    if not args.bootstrap and args.budget_iters is None \
+            and args.budget_seconds is None:
+        print("need --budget-iters and/or --budget-seconds "
+              "(or --bootstrap / --replay)", file=sys.stderr)
+        return 2
+
+    workload = None
+    if args.workload_tenants:
+        workload = {"tenants": args.workload_tenants,
+                    "produce_per_tick": args.workload_load,
+                    "skew": args.workload_skew}
+
+    repro_dir = args.repro_dir
+    if repro_dir is None and args.corpus:
+        repro_dir = os.path.join(args.corpus, "repros")
+
+    search = ChaosSearch(
+        args.seed, Corpus(args.corpus, cap=args.corpus_cap),
+        n_nodes=args.nodes, groups=args.groups,
+        active_set=args.active_set, hb_ticks=args.hb_ticks,
+        device_route=args.device_route,
+        flight_wire=not args.no_flight_wire, quiet_net=args.quiet_net,
+        workload=workload, commitless_limit=args.commitless_limit,
+        flight_ring=args.flight_ring,
+        limits=SearchLimits(max_horizon=args.max_horizon,
+                            max_heal=args.max_heal),
+        min_novel=args.min_novel, minimize=not args.no_minimize,
+        repro_dir=repro_dir, log_path=args.log)
+
+    if args.bootstrap:
+        added = search.bootstrap()
+        print(json.dumps({"bootstrapped": added,
+                          "corpus_entries": len(search.corpus.entries),
+                          "corpus_features": len(search.corpus.coverage)}))
+        return 0
+
+    summary = search.run(budget_iters=args.budget_iters,
+                         budget_seconds=args.budget_seconds)
+    print(json.dumps(summary))
+    return 1 if summary["violations"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,11 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--schedule", default="leader-partition",
                     help="bundled schedule name, or @path to a schedule JSON")
+    ap.add_argument("--schedule-file", default=None,
+                    help="run a schedule JSON from disk (the repro-playback "
+                         "half of the search loop: a corpus entry, a "
+                         "minimized repro, or any hand-written DSL file; "
+                         "overrides --schedule)")
     ap.add_argument("--nodes", type=int, default=3)
     ap.add_argument("--groups", type=int, default=2)
     ap.add_argument("--window", type=int, default=1,
@@ -55,6 +60,18 @@ def main() -> int:
                          "partitions/crashes/noise force the host residual "
                          "path (pair with --quiet-net so a directive "
                          "schedule leaves clean links to route)")
+    ap.add_argument("--flight-ring", type=int, default=None,
+                    help="per-engine flight-recorder ring capacity (default "
+                         "4096). Searched soaks with --flight-wire at scale "
+                         "overflow the default and silently truncate the "
+                         "timeline the coverage scorer reads; the summary's "
+                         "flight_ring block reports wraparound drops and a "
+                         "nonzero count warns on stderr")
+    ap.add_argument("--commitless-limit", type=int, default=None,
+                    help="availability probe: fail the run if no proposal "
+                         "is acked for more than this many consecutive "
+                         "ticks during chaos (default off; the search "
+                         "driver arms it to hunt quorum-starving schedules)")
     ap.add_argument("--flight-wire", action="store_true",
                     help="journal wire-level trace events (msg_sent/"
                          "msg_delivered, path-tagged routed vs host) so the "
@@ -122,12 +139,23 @@ def main() -> int:
         return 0
 
     schedule = args.schedule
-    if schedule.startswith("@"):
+    if args.schedule_file:
+        with open(args.schedule_file) as fh:
+            schedule = fh.read()
+        # Accept wrapped forms too: a corpus entry or a search repro file
+        # embeds the schedule DSL under a "schedule" key (repro files
+        # also carry the soak config — chaos_search.py --replay applies
+        # it; here only the schedule is taken).
+        doc = json.loads(schedule)
+        if isinstance(doc, dict) and "steps" not in doc \
+                and isinstance(doc.get("schedule"), dict):
+            schedule = json.dumps(doc["schedule"])
+    elif schedule.startswith("@"):
         with open(schedule[1:]) as fh:
             schedule = fh.read()
     elif schedule not in SCHEDULES:
-        print(f"unknown schedule {schedule!r}; use --list or @file.json",
-              file=sys.stderr)
+        print(f"unknown schedule {schedule!r}; use --list, "
+              f"--schedule-file PATH, or @file.json", file=sys.stderr)
         return 2
 
     workload = None
@@ -136,14 +164,21 @@ def main() -> int:
                     "produce_per_tick": args.workload_load,
                     "skew": args.workload_skew}
 
-    result = run_soak(
-        args.seed, schedule, n_nodes=args.nodes, groups=args.groups,
-        window=args.window, horizon=args.horizon,
-        net=NetFaults.quiet() if args.quiet_net else None,
-        auto_faults=args.auto_faults, active_set=args.active_set,
-        hb_ticks=args.hb_ticks, device_route=args.device_route,
-        flight_wire=args.flight_wire, workload=workload,
-        artifact_path=args.artifact)
+    try:
+        result = run_soak(
+            args.seed, schedule, n_nodes=args.nodes, groups=args.groups,
+            window=args.window, horizon=args.horizon,
+            net=NetFaults.quiet() if args.quiet_net else None,
+            auto_faults=args.auto_faults, active_set=args.active_set,
+            hb_ticks=args.hb_ticks, device_route=args.device_route,
+            flight_wire=args.flight_wire, workload=workload,
+            artifact_path=args.artifact, flight_ring=args.flight_ring,
+            commitless_limit=args.commitless_limit)
+    except ValueError as e:
+        # The DSL boundary rejected the schedule (unknown op, negative at,
+        # malformed args — it names the step). Usage error, not a crash.
+        print(f"invalid schedule: {e}", file=sys.stderr)
+        return 2
 
     if args.events:
         with open(args.events, "w") as fh:
@@ -165,7 +200,15 @@ def main() -> int:
                ("schedule", "seed", "nodes", "groups", "window",
                 "active_set", "device_route", "flight_wire", "ticks",
                 "proposed", "acked", "fault_events", "chaos_counters",
+                "nemesis_skipped", "nemesis_skipped_steps",
+                "max_commitless_window", "flight_ring",
                 "invariants", "violation", "artifact")}
+    if result["flight_ring"]["dropped"]:
+        print(f"warning: flight ring wraparound discarded "
+              f"{result['flight_ring']['dropped']} journal events "
+              f"(capacity {result['flight_ring']['capacity']}); the "
+              f"timeline/coverage cover a truncated history — raise "
+              f"--flight-ring", file=sys.stderr)
     # Coverage epilogue: the signature a search driver would score this
     # run by, plus the per-class distinct-feature counts behind it.
     summary["coverage_signature"] = result["coverage_signature"]

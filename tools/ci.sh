@@ -95,6 +95,54 @@ chaos_smoke_device_route() {
         --horizon 200 --device-route --quiet-net
 }
 
+chaos_search_smoke() {
+    # Coverage-guided chaos search (chaos/search.py): a few seeded
+    # iterations from the COMMITTED corpus (tests/fixtures/chaos_corpus)
+    # must admit >= 1 novel signature — the search actually finds
+    # behavior the six bundled nemeses don't cover — and two same-seed
+    # runs must produce a byte-identical search log and identical final
+    # corpus signatures (the determinism contract the novelty scorer
+    # rests on). --max-horizon/--max-heal match the fixture scale.
+    echo "== chaos search smoke =="
+    # The search log is opened in APPEND mode (resumable long soaks), so
+    # stale logs from an interrupted earlier run must go too or the cmp
+    # below reports a phantom determinism regression.
+    rm -rf /tmp/ci_cs_a /tmp/ci_cs_b \
+        /tmp/ci_cs_a.jsonl /tmp/ci_cs_b.jsonl \
+        /tmp/ci_cs_a.json /tmp/ci_cs_b.json
+    cp -r tests/fixtures/chaos_corpus /tmp/ci_cs_a
+    cp -r tests/fixtures/chaos_corpus /tmp/ci_cs_b
+    python tools/chaos_search.py --seed 21 --budget-iters 5 \
+        --corpus /tmp/ci_cs_a --log /tmp/ci_cs_a.jsonl \
+        --max-horizon 160 --max-heal 60 > /tmp/ci_cs_a.json
+    python tools/chaos_search.py --seed 21 --budget-iters 5 \
+        --corpus /tmp/ci_cs_b --log /tmp/ci_cs_b.jsonl \
+        --max-horizon 160 --max-heal 60 > /tmp/ci_cs_b.json
+    cmp /tmp/ci_cs_a.jsonl /tmp/ci_cs_b.jsonl
+    python - <<'PYEOF'
+import json, os
+s = json.load(open("/tmp/ci_cs_a.json"))
+assert s["admitted"] >= 1, s
+assert s["corpus_features"] > s["baseline_features"], s
+ls = lambda d: sorted(f for f in os.listdir(d) if f.startswith("entry_"))
+assert ls("/tmp/ci_cs_a") == ls("/tmp/ci_cs_b"), \
+    "same-seed corpus signatures diverged"
+print("chaos search ok:", s["admitted"], "admitted,",
+      s["corpus_features"], "features vs bundled baseline",
+      s["baseline_features"])
+PYEOF
+}
+
+chaos_search_repros() {
+    # Replay every committed minimized-repro artifact: each recorded
+    # violation must still trip exactly as recorded (exit 0 from
+    # --replay means reproduced).
+    echo "== chaos search repro replay =="
+    for f in tests/fixtures/chaos_repros/*.json; do
+        python tools/chaos_search.py --replay "$f"
+    done
+}
+
 traffic_smoke() {
     # Product-path traffic smoke: the in-process workload driver (real
     # broker handlers over a live engine) at a small P for a few seconds,
@@ -173,6 +221,7 @@ if [[ "${1:-}" == "quick" ]]; then
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
     chaos_smoke_device_route
+    chaos_search_smoke
     traffic_smoke
     obs_smoke
     perf_smoke
@@ -210,11 +259,13 @@ else
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_flight.py tests/test_flight_merge.py \
-        tests/test_coverage.py tests/test_reset_safety.py \
-        tests/test_graftlint.py -q
+        tests/test_coverage.py tests/test_chaos_search.py \
+        tests/test_reset_safety.py tests/test_graftlint.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route
+    chaos_search_smoke
+    chaos_search_repros
     traffic_smoke
     traffic_chaos_smoke
     obs_smoke
