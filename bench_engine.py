@@ -49,6 +49,14 @@ payload-bearing traffic. Adds the ``route`` phase to the profile and
 ``extra.device_route_stats`` (routed vs host-decoded message split) to
 the row; the flag joins the merge key so routed and host rows of one
 size coexist.
+
+--payload-ring (with --device-route) turns on the device payload ring:
+AppendEntries whose spans are ring-resident route on-chip too, so under
+produce load (--proposals > 0) routed_frac approaches 1.0 instead of
+stalling at the payload-free share — pair ring-on and ring-off rows
+measured adjacently to see the host decode/chain phases leave the tick.
+``extra.device_route_stats.ring`` carries the staged/routed/spill split;
+the flag joins the merge key.
 """
 
 from __future__ import annotations
@@ -124,6 +132,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
                     active_set: bool = False,
                     active_frac: float | None = None,
                     device_route: bool = False,
+                    payload_ring: bool = False,
                     flight_wire: bool = False,
                     xprof: str | None = None) -> dict:
     # hb_ticks=16: staggered per-group heartbeats (the scaled
@@ -152,7 +161,10 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     if device_route:
         from josefine_tpu.raft.route import RouteFabric
 
-        fabric = RouteFabric()
+        # payload_ring: AppendEntries with ring-resident spans route
+        # on-chip too — the produce-load rows' routed_frac should reach
+        # ~100% instead of stalling at the payload-free share.
+        fabric = RouteFabric(payload_ring=payload_ring)
         for e in engines:
             fabric.register(e)
     init_s = time.perf_counter() - t0
@@ -259,6 +271,10 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     executed = [0] * N
     for e in engines:
         e.routed_msgs = 0  # timed-loop routed count only
+    if fabric is not None and fabric.rings:
+        fabric.ring_routed = fabric.ring_capped = 0
+        for r in fabric.rings.values():
+            r.staged_total = r.spills = r.oversize = r.pin_skips = 0
     # Measure the timed loop only: drop the warmup's latency observations
     # (the registry is process-global, so this also clears any previous
     # size's series in a multi-size run) AND the engines' open entries for
@@ -288,6 +304,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
     dt = time.perf_counter() - t0
     routed_snap = sum(e.routed_msgs for e in engines)
     host_snap = host_entries
+    ring_snap = fabric.ring_stats() if fabric is not None else None
     sched_snap = [(e.active_sched_ticks, e.active_sched_rows,
                    e.active_fallback_ticks) for e in engines]
     # Windows each dispatch ACTUALLY executed during the timed loop
@@ -325,6 +342,7 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
         "active_set": active_set,
         "active_frac": active_frac,
         "device_route": device_route,
+        "payload_ring": payload_ring,
         "flight_wire": flight_wire,
         "init_s": round(init_s, 2),
         "leaders_after_warmup": leaders,
@@ -357,6 +375,10 @@ async def bench_one(P: int, ticks: int, warmup: int, window: int = 1,
             "routed_msgs": routed_snap,
             "host_msgs": host_snap,
             "routed_frac": round(routed_snap / total, 4) if total else 0.0,
+            # Payload-ring split over the timed loop (None with the ring
+            # off): staged blocks, payload AEs served on-chip, spills back
+            # to the host path, and current slot occupancy.
+            "ring": ring_snap,
         }
     if flight_wire and flight_off_ms is not None:
         # The wire-trace cost, measured on this box in this run: the timed
@@ -507,6 +529,12 @@ async def main():
                     help="join the engines to a RouteFabric: payload-free "
                          "consensus rows deliver device-resident; the host "
                          "decodes only payload-bearing traffic")
+    ap.add_argument("--payload-ring", action="store_true",
+                    help="with --device-route: stage minted/adopted block "
+                         "payloads in each engine's device payload ring so "
+                         "AppendEntries with resident spans route on-chip "
+                         "too (extra.device_route_stats.ring records the "
+                         "staged/routed/spill split)")
     ap.add_argument("--flight-wire", action="store_true",
                     help="journal wire-level trace events "
                          "(raft.flight_wire) during the timed loop AND "
@@ -542,6 +570,7 @@ async def main():
                                 active_set=args.active_set,
                                 active_frac=args.active_frac,
                                 device_route=args.device_route,
+                                payload_ring=args.payload_ring,
                                 flight_wire=args.flight_wire,
                                 xprof=args.xprof)
         results.append(r)
@@ -593,6 +622,7 @@ async def main():
                 bool(r.get("active_set")),
                 -1.0 if frac is None else float(frac),
                 bool(r.get("device_route")),
+                bool(r.get("payload_ring")),
                 bool(r.get("flight_wire")))
 
     merged = {_key(r): r for r in results}

@@ -3,7 +3,7 @@
 The engine's tick dispatch survives at P=100k because every compiled shape
 is drawn from a coarse ladder: power-of-two active-set buckets
 (``packed_step.active_bucket``), powers-of-eight route-scatter buckets
-(``packed_step.route_bucket``), and window lengths clamped to
+(``packed_step.route_bucket``, ``packed_step.ring_bucket``), and window lengths clamped to
 ``hb_ticks``.  A single call site that feeds a raw count into a jit builder
 compiles a fresh XLA program per distinct value — invisible in tests
 (small P, few ticks) and catastrophic in a soak.  Likewise a ``float()`` on
@@ -36,7 +36,7 @@ Rules:
   scanned modules) whose shape-feeding argument is a raw computation
   (``len(...)``, arithmetic, an un-provenanced local) instead of a value
   routed through an approved bucket helper (``active_bucket`` /
-  ``route_bucket``), a constant, an attribute (engine dims are fixed at
+  ``route_bucket`` / ``ring_bucket``), a constant, an attribute (engine dims are fixed at
   init), or a plain parameter (validated at ITS call site).
 """
 
@@ -61,7 +61,7 @@ _TRACE_WRAPPERS = {
 _CACHE_DECORATORS = {"functools.lru_cache", "functools.cache",
                      "lru_cache", "cache"}
 
-_BUCKET_HELPERS = {"active_bucket", "route_bucket"}
+_BUCKET_HELPERS = {"active_bucket", "route_bucket", "ring_bucket"}
 
 # numpy attributes that are plain objects (dtypes/constants), not host ops.
 _NP_BENIGN = {
@@ -167,6 +167,7 @@ class JitDisciplineChecker(Checker):
         "josefine_tpu/raft/packed_step.py",
         "josefine_tpu/raft/engine.py",
         "josefine_tpu/raft/route.py",
+        "josefine_tpu/raft/payload_ring.py",
         "josefine_tpu/parallel/",
     )
     rules = {

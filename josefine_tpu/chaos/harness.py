@@ -203,6 +203,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                  auto_crash: bool = True, auto_links: bool = True,
                  propose_rate: float = 0.15, max_proposals: int = 40,
                  active_set: bool = False, device_route: bool = False,
+                 payload_ring: bool = False,
                  flight_wire: bool = False, workload=None,
                  flight_ring: int = 4096):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
@@ -258,7 +259,12 @@ class ChaosCluster(_PlaneDrivenCluster):
         if device_route:
             from josefine_tpu.raft.route import RouteFabric
 
-            self.fabric = RouteFabric(link_filter=self.plane.link_routable)
+            # payload_ring additionally routes AppendEntries with
+            # ring-resident spans on-chip (spills and per-link gating
+            # unchanged: a faulted link's payload AEs ride the host path
+            # where the plane applies its fates, exactly like PR 6 kinds).
+            self.fabric = RouteFabric(link_filter=self.plane.link_routable,
+                                      payload_ring=payload_ring)
         self.engines = [self._make(i) for i in range(n_nodes)]
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.ledger = invariants.ElectionSafetyLedger()

@@ -49,6 +49,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          active_set: bool = False,
                          hb_ticks: int | None = None,
                          device_route: bool = False,
+                         payload_ring: bool = False,
                          flight_wire: bool = False,
                          workload: dict | None = None,
                          artifact_path: str | None = None,
@@ -73,6 +74,12 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     its fates. Pair it with ``net=NetFaults.quiet()`` so a directive
     schedule (partitions) is the only fault source and routing actually
     runs (the summary's device_route_stats shows the split).
+
+    ``payload_ring`` (with device_route) additionally stages minted/
+    adopted block payloads in each engine's bounded device payload ring,
+    so AppendEntries with ring-resident spans route on-chip too — under
+    workload traffic this is the produce path itself leaving the host
+    (device_route_stats.ring shows staged/routed/spill counts).
 
     ``flight_wire`` turns on the engines' wire-level trace events
     (msg_sent/msg_delivered, path-tagged routed vs host), so the per-node
@@ -121,6 +128,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
                            active_set=active_set, device_route=device_route,
+                           payload_ring=payload_ring and device_route,
                            flight_wire=flight_wire, workload=traffic,
                            flight_ring=flight_ring or 4096)
     nemesis = Nemesis(sched, plane, cluster)
@@ -237,9 +245,15 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # count is zero routed nothing, e.g. default probabilistic noise).
         # Both counts are per-CLUSTER (the metrics registry is
         # process-global and would accumulate across soaks in one process).
+        "payload_ring": payload_ring and device_route,
         "device_route_stats": {
             "routed_msgs": sum(e.routed_msgs for e in cluster.engines),
             "host_msgs": cluster.host_delivered,
+            # Payload-ring split (None with the ring off): blocks staged,
+            # payload AEs served on-chip, spills back to the host path —
+            # printed beside the routed/host/plane-blocked numbers so a
+            # soak line says how much of the PRODUCE path left the host.
+            "ring": cluster.fabric.ring_stats(),
         } if device_route else None,
         # Product-load epilogue: offered/acked/retry counters and the
         # per-tenant latency view of THIS run (the registry histogram

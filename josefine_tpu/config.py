@@ -112,6 +112,13 @@ class RaftConfig:
     # captures, not for the bench hot path (bench_engine --flight-wire
     # quotes the measured cost in extra.flight_wire_overhead).
     flight_wire: bool = False
+    # ring_spill trace events in the flight journal: one event per payload
+    # AppendEntries the device payload ring could NOT serve (span not
+    # resident -> host path). Off by default, same reasoning as
+    # flight_wire: a cold catch-up can spill thousands of frames; turn on
+    # when diagnosing why routed_frac is below target. The spill COUNT is
+    # always available as raft_route_ring_spills_total.
+    flight_ring_spill: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120

@@ -23,10 +23,14 @@ owns everything variable-length and durable around it:
   between co-located engines (no reference analog: messages there always
   serialize through the event loop; see ARCHITECTURE.md "Device-resident
   delivery").
+* :mod:`josefine_tpu.raft.payload_ring` — the bounded device payload ring
+  behind RouteFabric(payload_ring=True): AppendEntries with ring-resident
+  spans route on-chip, payload words crossing engines through the device.
 """
 
 from josefine_tpu.raft.chain import Block, Chain
 from josefine_tpu.raft.fsm import Fsm, Driver
+from josefine_tpu.raft.payload_ring import PayloadRing
 from josefine_tpu.raft.route import RouteFabric
 
-__all__ = ["Block", "Chain", "Fsm", "Driver", "RouteFabric"]
+__all__ = ["Block", "Chain", "Fsm", "Driver", "PayloadRing", "RouteFabric"]

@@ -172,6 +172,11 @@ _m_kout = REGISTRY.gauge(
 _m_flight_seq = REGISTRY.gauge(
     "raft_flight_events_total",
     "Consensus flight-recorder events emitted (monotone past ring eviction)")
+_m_ring_occ = REGISTRY.gauge(
+    "raft_route_ring_occupancy",
+    "Blocks resident in this engine's device payload ring (route-servable "
+    "AppendEntries payloads; see raft_route_ring_spills_total for the "
+    "misses)")
 
 _I32 = jnp.int32
 
@@ -208,6 +213,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         mesh=None,
         flight_ring: int = 4096,
         flight_wire: bool = False,
+        flight_ring_spill: bool = False,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -571,6 +577,20 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         self._routed_kinds: np.ndarray | None = None
         self.routed_msgs = 0
         self._c_routed = _m_routed.bind(node=self.self_id)
+        # Device payload ring (raft/payload_ring.py, attached via the
+        # fabric when RouteFabric(payload_ring=True)): _routed_blocks holds
+        # the ring-fed payload spans consume() handed this tick_begin (they
+        # seed the dispatch's staged-block dict, so the receiver's chain
+        # adoption walks them exactly like wire-decoded spans);
+        # _ring_stage_decode defers decode-time staging (capped catch-up
+        # reads) to the NEXT tick's stage batch — staging inside decode
+        # would run between this tick's route and its flush barrier, where
+        # a scatter could tear a slot the barrier's gather is about to
+        # read. flight_ring_spill gates the ring_spill journal event
+        # (config raft.flight_ring_spill, off by default like flight_wire).
+        self._routed_blocks: dict[int, list] | None = None
+        self._ring_stage_decode: list[tuple[int, object]] = []
+        self._flight_ring_spill = bool(flight_ring_spill)
         # Pipelined-tick state: the in-flight tick handle (tick_pipelined's
         # double buffer), the dispatch-in-flight flag (True from tick_begin
         # until the tick's device fetch materializes), and host-side
@@ -635,6 +655,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             + sum(len(b) for b in self._pending_batches), node=node)
         _m_kout.set(self._k_out, node=node)
         _m_flight_seq.set(self.flight.seq, node=node)
+        if self._fabric is not None:
+            r = self._fabric.rings.get(self.me)
+            if r is not None:
+                _m_ring_occ.set(r.occupancy(), node=node)
         if self._active_set:
             _m_wake_frac.set(
                 round(self._last_wake_rows / max(1, self.P), 6), node=node)
@@ -1134,8 +1158,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # per-(group, src) delivery stamp; the plane itself merges
             # under the host residual inside the routed step variants.
             with prof.phase("route"):
-                self._routed_plane, self._routed_kinds, rterms = \
-                    self._fabric.consume(self.me)
+                (self._routed_plane, self._routed_kinds, rterms,
+                 self._routed_blocks) = self._fabric.consume(self.me)
                 if self._routed_kinds is not None:
                     gi, si = np.nonzero(self._routed_kinds)
                     self._h_last_seen[gi, si] = self._ticks
@@ -1268,6 +1292,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                  "window": window,
                  "upload_bytes": int(in10.nbytes),
                  "fetch_bytes": int(np.prod(flat.shape)) * 4}
+        if self._routed_blocks:
+            # Ring-fed payload spans (consumed above): pre-staged blocks
+            # for exactly this dispatch — tick_finish's chain adoption
+            # walks them like wire-decoded spans, no host decode involved.
+            staged = h["staged"]
+            for g, blks in self._routed_blocks.items():
+                staged.setdefault(g, []).extend(blks)
+        self._routed_blocks = None
         self.state = new_state
         self._pending_msgs = deferred
         self._pending_batches = deferred_b
@@ -1552,6 +1584,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
 
         res = TickResult()
         reset_rows: set[int] = set()
+        # Payload-ring staging (fabric payload_ring on): every block this
+        # finish mints or adopts is collected here and staged into the
+        # sender ring in ONE batch just before the route phase — so the
+        # AEs the device emitted for these blocks (this tick or later)
+        # resolve as ring-resident and route on-chip.
+        ring = (self._fabric.rings.get(self.me)
+                if self._fabric is not None else None)
+        ring_pend: dict[int, list] = {}
         # The device tick that just completed (self._ticks increments at the
         # END of this finish) — the stamp for journal events and the commit-
         # latency clock, matching the bench's executed-tick accounting.
@@ -1601,7 +1641,9 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 res.became_leader.append(g)
                 self.flight.emit(t_now, "election_won", group=g,
                                  term=int(n_term[pos]), leader=self.me)
-                ch.append(int(n_term[pos]), b"")  # the no-op liveness block
+                noop = ch.append(int(n_term[pos]), b"")  # no-op liveness block
+                if ring is not None:
+                    ring_pend.setdefault(g, []).append(noop)
                 if g == 0:
                     # A deposed leader's conf block may sit uncommitted in
                     # our log and commit later under us — re-arm the
@@ -1647,6 +1689,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                         except ValueError as e:
                             conf_err, payload = e, b""
                     blk = ch.append(int(n_term[pos]), payload)
+                    if ring is not None:
+                        ring_pend.setdefault(g, []).append(blk)
                     # Open a commit-latency entry for the minted block
                     # (block ids are appended in mint order, so the deque
                     # stays id-sorted; commit advancement below resolves or
@@ -1695,6 +1739,11 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     cur = blk.parent
                 path.reverse()
                 ch.extend_many(path)
+                if ring is not None and path:
+                    # Adopted blocks stage too: a follower that later
+                    # leads (or relays catch-up) serves them from its own
+                    # ring instead of re-reading the chain.
+                    ring_pend.setdefault(g, []).extend(path)
                 if ch.head != new_head:
                     ch.force_head(new_head)
 
@@ -1832,6 +1881,22 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # AE-ack claims to hold, and a same-tick vote grant from the wiped
         # row is exactly the forgotten-ack vote parole exists to prevent.
         skip = self._recycled_this_tick | reset_rows
+        if ring is not None and (self._ring_stage_decode or ring_pend):
+            # Stage this finish's minted/adopted blocks — plus the capped
+            # catch-up reads the LAST decode recorded (deferred one tick:
+            # staging inside decode would fall between a route and its
+            # flush barrier, where the scatter could tear a slot the
+            # barrier's gather still needs) — before the route decision
+            # below reads residency. Rows reset/recycled this tick stay
+            # out: their blocks belong to a dead incarnation.
+            if self._ring_stage_decode:
+                pend, self._ring_stage_decode = self._ring_stage_decode, []
+                for g, blk in pend:
+                    if g not in skip:
+                        ring.stage(g, int(self._h_ginc[g]), (blk,))
+            for g, blks in ring_pend.items():
+                if g not in skip:
+                    ring.stage(g, int(self._h_ginc[g]), blks)
         routed_mask = None
         routed_dsts: set[int] = set()
         if self._fabric is not None and len(proc):

@@ -287,6 +287,17 @@ class GroupAdmin:
             # the reset — the reset zeroes the row's nxt below, and a later
             # _drain_nxt_fixups scatter must not resurrect the old pointer.
             self._nxt_fixups = [f for f in self._nxt_fixups if f[0] != g]
+        if self._ring_stage_decode:
+            # Deferred payload-ring stages for this row describe blocks the
+            # reset just discarded — they must never become resident.
+            self._ring_stage_decode = [
+                p for p in self._ring_stage_decode if p[0] != g]
+        if self._fabric is not None:
+            ring = self._fabric.rings.get(self.me)
+            if ring is not None:
+                # The sender-side ring row too: its resident payloads are
+                # the discarded chain's blocks.
+                ring.purge(g)
         if self._pipeline_h is not None:
             # A dispatch is in flight (pipelined driver): its fetched
             # values for this row were computed from pre-reset state —

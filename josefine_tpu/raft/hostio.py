@@ -334,6 +334,15 @@ class HostIO:
         ae = np.nonzero((k_all == rpc.MSG_APPEND) & (y_all != x_all))[0]
         if len(ae):
             cap = self.max_append_entries
+            # Payload-ring re-stage hook: blocks a capped catch-up frame
+            # just read from the chain are worth ring residency — the SAME
+            # span is re-sent next tick under tick_pipelined (the fixup
+            # lands one dispatch late), and the follow-on catch-up frames
+            # walk the suffix right above it; resident, those route
+            # on-chip instead of re-reading the chain. Deferred one tick
+            # via _ring_stage_decode (see its init comment).
+            ring = (self._fabric.rings.get(self.me)
+                    if self._fabric is not None else None)
             order = ae[np.argsort(g_all[ae], kind="stable")]
             edges = np.nonzero(np.diff(g_all[order]))[0] + 1
             for run in np.split(order, edges):
@@ -398,6 +407,13 @@ class HostIO:
                         y_all[i] = top
                         z_all[i] = min(int(z_all[i]), top)
                         self._nxt_fixups.append((grp, int(di[i]), top))
+                        if ring is not None and len(blks) <= ring.S:
+                            # Fits the per-group ring: next tick's re-send
+                            # of this exact span routes on-chip (stage()
+                            # dedups already-resident ids, so repeated
+                            # caps toward several followers are free).
+                            self._ring_stage_decode.extend(
+                                (grp, b) for b in blks)
                     blocks_by_dst.setdefault(int(di[i]), {})[grp] = blks
 
         out: list = []
@@ -499,13 +515,17 @@ class HostIO:
         Known pipelined-mode cost: under ``tick_pipelined`` the decode
         that records a fixup runs AFTER the next tick was dispatched with
         the old ``nxt``, so a ``max_append_entries``-capped catch-up span
-        is re-read and re-sent once before the re-root lands (and a
-        device-side reject re-root from the intervening tick loses to
-        this scatter, costing one extra reject round trip). Fixing it
-        means decode consulting the pending fixup list as the effective
-        span bottom — in both the columnar path and its pinned scalar
-        reference — which is deliberately not done yet; followers only
-        pay while > cap behind."""
+        is re-sent once before the re-root lands (and a device-side
+        reject re-root from the intervening tick loses to this scatter,
+        costing one extra reject round trip). With the payload ring on,
+        the duplicate no longer re-reads the chain or re-encodes: the cap
+        branch above stages the capped span's blocks, so the re-send
+        resolves ring-resident and routes on-chip (route_from applies the
+        identical cap + fixup — pinned by the pipelined twin case in
+        tests/test_device_route.py). The duplicate FRAME itself remains —
+        removing it means decode consulting the pending fixup list as the
+        effective span bottom in both decoders, which is deliberately not
+        done; followers only pay while > cap behind."""
         fx = np.asarray(self._nxt_fixups, np.int64).reshape(-1, 3)
         self._nxt_fixups.clear()
         # The re-rooted rows now have nxt < head — the leader must keep

@@ -229,6 +229,91 @@ def _route_scatter_new_fn(bucket: int, P: int, N: int):
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _route_scatter_vals_fn(bucket: int):
+    """Scatter HOST-BUILT routed rows into a receiver's staged inbox plane:
+    ``vals`` is a (9, bucket) int32 column block uploaded from the host.
+    Used only for ``max_append_entries``-capped payload AEs, where the
+    routed row's y/z fields must carry the capped top instead of the
+    device outbox's optimistic head claim — the 36-byte-per-row upload is
+    noise next to the chain read + encode/decode it replaces, and capping
+    is the catch-up path, never steady state. Everything else keeps the
+    pure device-to-device scatter (:func:`_route_scatter_fn`)."""
+
+    def fn(plane, vals, gids, me):
+        return plane.at[:, gids, me].set(vals, mode="drop")
+
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _route_scatter_vals_new_fn(bucket: int, P: int, N: int):
+    """First push of a round via the host-vals variant: build the zero
+    plane inside the program (memset, not an upload) and scatter into it."""
+
+    def fn(vals, gids, me):
+        plane = jnp.zeros((9, P, N), _I32)
+        return plane.at[:, gids, me].set(vals, mode="drop")
+
+    return jax.jit(fn)
+
+
+# Device-resident payload ring (PR 12). RouteFabric's PR 6 scatter moved
+# the nine packed MESSAGE rows on-chip but left every AppendEntries with a
+# real span on the host path: the sender re-read the span from its chain
+# (range_many KV I/O on the tick path) and encoded it into a wire batch the
+# receiver decoded back. The payload ring closes that half: each sender
+# owns a bounded (P, S, W) int32 device buffer of recent block payloads
+# (S slots per group, W words per slot), written once when the block is
+# minted/adopted (:func:`_ring_scatter_fn`, at the flush barrier) and read
+# once per routed span set when the fabric materializes adopted blocks for
+# the receivers (:func:`_ring_gather_fn`) — the payload crosses engines
+# through the device, never through a wire encode/decode, and the sender's
+# chain reads leave the tick path entirely. Host-side metadata mirrors
+# (raft/payload_ring.py) back the residency decisions without any device
+# fetch, the same split as the fabric's kind mirrors.
+
+
+def ring_bucket(n: int, cap: int) -> int:
+    """Scatter/gather bucket for a payload-ring row set: powers of EIGHT
+    from a floor of 64, clamped to ``cap`` (= P * S, the ring's total slot
+    count) — the same coarse ladder as :func:`route_bucket`, for the same
+    reason: the scatter/gather programs are trivial, every extra level is
+    a full XLA compile."""
+    b = 64
+    while b < n:
+        b *= 8
+    return min(b, cap) if cap >= 64 else cap
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_scatter_fn(bucket: int):
+    """Write staged block payloads into a sender's device payload ring:
+    ``buf`` is the (P, S, W) ring (DONATED — in-place slot stores, never a
+    full-buffer copy), ``gids`` the destination group rows (padded with P
+    — dropped), ``slots`` the per-group ring slot, ``words`` the (bucket,
+    W) packed payload words."""
+
+    def fn(buf, gids, slots, words):
+        return buf.at[gids, slots].set(words, mode="drop")
+
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _ring_gather_fn(bucket: int):
+    """Read a routed span set's payload slots out of a sender's device
+    ring: one gather per (sender, flush barrier), covering every block the
+    receivers will adopt this round. Padding rows (gid >= P) clamp and are
+    ignored host-side."""
+
+    def fn(buf, gids, slots):
+        P = buf.shape[0]
+        return buf[jnp.minimum(gids, P - 1), slots]
+
+    return jax.jit(fn)
+
+
 @jax.jit
 def _merge_planes_fn(ready, staging):
     """First-writer-wins overlay of a not-yet-consumed ready plane over a

@@ -1,0 +1,259 @@
+"""Device-resident payload ring: block payloads for on-chip AppendEntries.
+
+RouteFabric (PR 6, raft/route.py) delivers payload-free consensus rows
+device-to-device but stops at AppendEntries with a real span: the sender
+re-reads the span from its chain (``range_many`` KV I/O on the tick path)
+and encodes it into a wire batch the receiver decodes back — so under
+produce load the host encode/decode/chain-read phases sit on every tick.
+The payload ring closes that gap, per the ROADMAP's "AE-with-blocks routes
+like a heartbeat" item (the arxiv 1605.05619 bound: consensus throughput
+is set by where messages are processed).
+
+One :class:`PayloadRing` per registered fabric slot (the ring is
+per-SENDER: residency is a pure function of that engine's own history, so
+the twin differential can predict routing without cross-engine races):
+
+* **stage** — when the engine mints or adopts blocks (``tick_finish``
+  already holds them on their way into ``Chain.append``/``extend_many``),
+  their payloads are packed into int32 words and queued for the bounded
+  per-group ring: S slots per group, W words per slot, FIFO overwrite.
+  The device scatter (:func:`packed_step._ring_scatter_fn`, powers-of-8
+  bucket ladder) runs once per flush barrier, off the tick's critical
+  path. Host-side metadata (block id, parent, incarnation, length) backs
+  every residency decision without a device fetch — the same
+  mirror-beside-the-plane split as the fabric's kind mirrors.
+* **resolve** — the sender's route decision: walk the claimed span
+  ``(x, y]`` down the parent pointers through the metadata. Fully
+  resident -> the AE routes like a heartbeat (the packed row scatters
+  on-device, the host decode never materializes it); longer than
+  ``max_append_entries`` -> the resident prefix routes with the capped
+  top (the same cap + nxt re-root the host decode would apply); any miss
+  -> the row spills to the host path, counted and (config-gated)
+  journaled.
+* **gather** — at the fabric's flush barrier the routed spans' payload
+  words come back in ONE device gather per sender
+  (:func:`packed_step._ring_gather_fn`) and materialize as the receiver's
+  staged blocks: the payload crossed engines through the device, and the
+  receiver's chain extension adopts it without ever seeing a wire frame.
+
+Entries referenced by an unfetched route are **pinned** until that gather
+runs: staging that would overwrite a pinned slot skips the new block
+instead (it simply isn't resident -> its AE rides the host path), so no
+driver schedule can make a receiver adopt a torn slot.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from josefine_tpu.raft.chain import Block
+from josefine_tpu.raft.packed_step import (
+    _ring_gather_fn,
+    _ring_scatter_fn,
+    ring_bucket,
+)
+from josefine_tpu.utils.tracing import get_logger
+
+log = get_logger("raft.payload_ring")
+
+
+class _Entry:
+    """One resident block's host metadata (the payload bytes live ONLY in
+    the device buffer)."""
+
+    __slots__ = ("bid", "parent", "inc", "length", "slot")
+
+    def __init__(self, bid: int, parent: int, inc: int, length: int,
+                 slot: int):
+        self.bid = bid
+        self.parent = parent
+        self.inc = inc
+        self.length = length
+        self.slot = slot
+
+
+class PayloadRing:
+    """Bounded per-group device payload slots for one fabric sender slot
+    (see module docstring)."""
+
+    def __init__(self, P: int, slots: int = 8, slot_bytes: int = 512,
+                 backend: str = "jax"):
+        if slots < 1:
+            raise ValueError("payload ring needs >= 1 slot per group")
+        self.P = int(P)
+        self.S = int(slots)
+        self.W = max(1, (int(slot_bytes) + 3) // 4)
+        self.backend = backend
+        # (P, S, W) int32 device buffer (numpy for the scalar twin),
+        # allocated on first stage so a ring-enabled but idle fabric costs
+        # nothing.
+        self.buf = None
+        self._ptr: dict[int, int] = {}            # g -> monotone write ctr
+        self._ents: dict[int, dict[int, _Entry]] = {}   # g -> slot -> entry
+        self._by_id: dict[int, dict[int, _Entry]] = {}  # g -> bid -> entry
+        # Blocks staged but not yet scattered to the device (one bucketed
+        # scatter per flush barrier): (g, slot, words).
+        self._pend: list[tuple[int, int, np.ndarray]] = []
+        # Slots referenced by a routed-but-not-yet-gathered span: staging
+        # must not overwrite them (see module docstring).
+        self._pinned: set[tuple[int, int]] = set()
+        # Occupancy / spill telemetry (the fabric aggregates these into
+        # raft_route_ring_* metrics and the soak summaries).
+        self.staged_total = 0
+        self.spills = 0       # route-time residency misses (per would-be AE)
+        self.oversize = 0     # payloads wider than a slot — never resident
+        self.pin_skips = 0    # staging skipped to protect a pinned slot
+
+    # ------------------------------------------------------------- staging
+
+    def stage(self, g: int, inc: int, blocks) -> None:
+        """Queue freshly minted/adopted blocks for group ``g``'s ring.
+        Id-deduplicated (re-adopting a resident block is a no-op, so ring
+        state stays a pure function of the chain history, not of how many
+        paths staged it); FIFO slot overwrite past S live blocks."""
+        ents = self._ents.setdefault(g, {})
+        by_id = self._by_id.setdefault(g, {})
+        for b in blocks:
+            data = b.data
+            if len(data) > self.W * 4:
+                self.oversize += 1
+                continue
+            prev = by_id.get(b.id)
+            if prev is not None and prev.inc == inc:
+                continue  # already resident
+            slot = self._ptr.get(g, 0) % self.S
+            if (g, slot) in self._pinned:
+                # An unfetched routed span references this slot: the new
+                # block simply is not resident (its AE spills host-side).
+                self.pin_skips += 1
+                continue
+            old = ents.pop(slot, None)
+            if old is not None:
+                by_id.pop(old.bid, None)
+            e = _Entry(b.id, b.parent, inc, len(data), slot)
+            ents[slot] = e
+            by_id[b.id] = e
+            self._ptr[g] = self._ptr.get(g, 0) + 1
+            pad = (-len(data)) % 4
+            words = np.zeros(self.W, np.int32)
+            if data:
+                w = np.frombuffer(data + b"\x00" * pad, "<i4")
+                words[:len(w)] = w
+            self._pend.append((g, slot, words))
+            self.staged_total += 1
+
+    def flush_device(self) -> None:
+        """One bucketed scatter of everything staged since the last flush
+        barrier (a memset-sized upload; padding rows are dropped)."""
+        if not self._pend:
+            return
+        if self.buf is None:
+            zeros = np.zeros((self.P, self.S, self.W), np.int32)
+            self.buf = zeros if self.backend == "python" else jnp.asarray(zeros)
+        if self.backend == "python":
+            for g, slot, words in self._pend:
+                self.buf[g, slot] = words
+        else:
+            # Last-writer-wins per (group, slot): a busy group can cycle
+            # one slot several times between barriers (FIFO overwrite at
+            # small S) and only the final occupant is resident — the dedup
+            # also bounds the scatter at P * S rows, the bucket ladder's
+            # clamp.
+            final = {(g, slot): w for g, slot, w in self._pend}
+            n = len(final)
+            B = ring_bucket(n, self.P * self.S)
+            gids = np.full(B, self.P, np.int32)
+            slots = np.zeros(B, np.int32)
+            words = np.zeros((B, self.W), np.int32)
+            for i, ((g, slot), w) in enumerate(final.items()):
+                gids[i], slots[i] = g, slot
+                words[i] = w
+            self.buf = _ring_scatter_fn(B)(
+                self.buf, jnp.asarray(gids), jnp.asarray(slots),
+                jnp.asarray(words))
+        self._pend.clear()
+
+    # ----------------------------------------------------------- residency
+
+    def resolve(self, g: int, inc: int, x: int, y: int,
+                cap: int | None):
+        """Route decision for an AE claiming span ``(x, y]``: walk ``y``
+        down the parent pointers through the resident metadata. Returns
+        ``(entries ascending, capped_top)`` — ``capped_top`` is ``None``
+        when the full span routes as-is, else the ``cap``-th block's id
+        (the routed row's y/z are rewritten to it and the sender's nxt is
+        re-rooted, exactly like the host decode's cap) — or ``None`` when
+        any block is missing (the row spills to the host path)."""
+        if x == y:
+            return None
+        by_id = self._by_id.get(g)
+        if not by_id:
+            return None
+        chain: list[_Entry] = []
+        cur = y
+        while cur != x:
+            if len(chain) >= self.S:
+                return None  # longer than the ring can ever hold
+            e = by_id.get(cur)
+            if e is None or e.inc != inc:
+                return None
+            chain.append(e)
+            cur = e.parent
+        chain.reverse()
+        if cap is not None and len(chain) > cap:
+            chain = chain[:cap]
+            return chain, chain[-1].bid
+        return chain, None
+
+    def pin(self, g: int, entries) -> None:
+        """Protect a routed span's slots until :meth:`gather` reads them."""
+        for e in entries:
+            self._pinned.add((g, e.slot))
+
+    # ------------------------------------------------------------- gather
+
+    def gather(self, needs) -> dict[tuple[int, int], Block]:
+        """Materialize routed blocks in ONE device gather: ``needs`` is a
+        list of ``(g, entry)`` pairs; returns ``(g, bid) -> Block`` —
+        keyed WITH the group, because block ids are only unique per chain
+        (two groups at the same (term, seq) collide on the bare id). The
+        fabric flushes pending stages first and clears pins once every
+        sender's gather has run (the barrier)."""
+        out: dict[tuple[int, int], Block] = {}
+        if not needs:
+            return out
+        n = len(needs)
+        if self.backend == "python":
+            rows = [np.asarray(self.buf[g, e.slot]) for g, e in needs]
+        else:
+            B = ring_bucket(n, self.P * self.S)
+            gids = np.full(B, self.P, np.int32)
+            slots = np.zeros(B, np.int32)
+            for i, (g, e) in enumerate(needs):
+                gids[i], slots[i] = g, e.slot
+            fetched = np.asarray(_ring_gather_fn(B)(
+                self.buf, jnp.asarray(gids), jnp.asarray(slots)))
+            rows = fetched[:n]
+        for (g, e), row in zip(needs, rows):
+            data = np.ascontiguousarray(row, dtype="<i4").tobytes()[:e.length]
+            out[(g, e.bid)] = Block(id=e.bid, parent=e.parent, data=data)
+        return out
+
+    # -------------------------------------------------------------- admin
+
+    def purge(self, g: int) -> None:
+        """Drop group ``g``'s resident entries and queued stages (group
+        recycle/reset: a dead incarnation's payloads must never resolve).
+        Device words are left as garbage — every read is metadata-gated."""
+        self._ents.pop(g, None)
+        self._by_id.pop(g, None)
+        self._ptr.pop(g, None)
+        if self._pend:
+            self._pend = [p for p in self._pend if p[0] != g]
+        if self._pinned:
+            self._pinned = {p for p in self._pinned if p[0] != g}
+
+    def occupancy(self) -> int:
+        """Resident entries across all groups (the occupancy gauge)."""
+        return sum(len(e) for e in self._ents.values())

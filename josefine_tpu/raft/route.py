@@ -25,8 +25,12 @@ argument: consensus throughput is bounded by where messages are processed).
   ARE the inbox's rows 0-8, so no transform is needed, only placement);
 * the mask is handed to ``_decode_outbox`` so routed rows are never
   re-materialized host-side — the host decodes only the residual:
-  payload-bearing AppendEntries, snapshot transfers, off-fabric peers,
-  faulted links;
+  snapshot transfers, off-fabric peers, faulted links, and (ring off or
+  span not resident) payload-bearing AppendEntries. With the payload ring
+  on (PR 12, raft/payload_ring.py), an AE whose span is resident in the
+  sender's bounded device payload ring routes like a heartbeat: the
+  packed row scatters on-device and the payload words cross at the flush
+  barrier in one gather — no chain read, no encode/decode;
 * the driver calls :meth:`flush` at its delivery barrier (wherever it
   hands host-path messages to ``receive()``), promoting staged planes to
   consumable ones — so routed and host-path delivery become visible at the
@@ -67,15 +71,27 @@ from josefine_tpu.raft.packed_step import (
     _purge_plane_row_fn,
     _route_scatter_fn,
     _route_scatter_new_fn,
+    _route_scatter_vals_fn,
+    _route_scatter_vals_new_fn,
     route_bucket,
 )
+from josefine_tpu.raft.payload_ring import PayloadRing
+from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("raft.route")
 
+_m_ring_spills = REGISTRY.counter(
+    "raft_route_ring_spills_total",
+    "Payload AEs that could not route from the device payload ring "
+    "(span not resident) and fell back to the host encode/decode path")
+
 # Kinds routable without host involvement: always payload-free on the wire.
-# MSG_APPEND joins conditionally (x == y — a pure heartbeat/commit probe);
-# an AE with a real span needs chain payload attached host-side.
+# MSG_APPEND joins conditionally: x == y (a pure heartbeat/commit probe)
+# always routes; x != y routes when the payload ring is on and the span is
+# ring-resident (raft/payload_ring.py — the payload words follow through
+# the device at the flush barrier); otherwise the AE needs chain payload
+# attached host-side and rides the residual path.
 _ROUTED_ALWAYS = np.asarray(sorted((
     rpc.MSG_VOTE_REQ, rpc.MSG_VOTE_RESP,
     rpc.MSG_PREVOTE_REQ, rpc.MSG_PREVOTE_RESP,
@@ -89,11 +105,31 @@ class RouteFabric:
     join via :meth:`register`, drivers call :meth:`flush` at their
     delivery barrier."""
 
-    def __init__(self, link_filter=None):
+    def __init__(self, link_filter=None, payload_ring: bool = False,
+                 ring_slots: int = 8, ring_bytes: int = 512):
         # slot -> engine. A slot may be re-registered (restart churn):
         # the dead engine's staged/ready traffic dies with it, like the
         # pending queues inside the dead process.
         self.engines: dict[int, object] = {}
+        # Device-resident payload ring (raft/payload_ring.py): when on,
+        # each registered slot owns a bounded (P, ring_slots, ring_bytes)
+        # payload buffer, and MSG_APPEND with a real span routes on-chip
+        # whenever the span is ring-resident (host spill otherwise). Off
+        # by default — the buffers cost P * slots * bytes per engine.
+        self.payload_ring = bool(payload_ring)
+        self.ring_slots = int(ring_slots)
+        self.ring_bytes = int(ring_bytes)
+        self.rings: dict[int, PayloadRing] = {}
+        # Routed payload handoff between a sender's route (tick_finish)
+        # and the receivers' adoption: _staged_blocks accumulates the
+        # routed spans' ring entries per receiver until the flush barrier,
+        # where ONE device gather per sender materializes them as Blocks
+        # into _ready_blocks; consume() hands them to the receiver's next
+        # dispatch as pre-staged blocks.
+        self._staged_blocks: dict[int, dict[int, dict[int, tuple]]] = {}
+        self._ready_blocks: dict[int, dict[int, dict[int, object]]] = {}
+        self.ring_routed = 0  # payload AEs delivered from the ring
+        self.ring_capped = 0  # of those, capped catch-up prefixes
         # Optional (src_slot, dst_slot) -> bool gate. The chaos harness
         # wires FaultPlane.link_routable here so partitions/crashes/noisy
         # links force traffic back through the host residual path (where
@@ -152,6 +188,14 @@ class RouteFabric:
         self._ready_kinds.pop(slot, None)
         self._staging_terms.pop(slot, None)
         self._ready_terms.pop(slot, None)
+        self._staged_blocks.pop(slot, None)
+        self._ready_blocks.pop(slot, None)
+        if self.payload_ring:
+            # Fresh ring per registration: a restarted engine's resident
+            # payloads died with the process (same rule as the planes).
+            self.rings[slot] = PayloadRing(
+                self.P, slots=self.ring_slots, slot_bytes=self.ring_bytes,
+                backend=self.backend)
         self._refresh_trace()
 
     def _refresh_trace(self) -> None:
@@ -166,7 +210,8 @@ class RouteFabric:
             e._fabric = None
         for store in (self._staging, self._staging_kinds, self._staging_srcs,
                       self._ready, self._ready_kinds,
-                      self._staging_terms, self._ready_terms):
+                      self._staging_terms, self._ready_terms,
+                      self._staged_blocks, self._ready_blocks, self.rings):
             store.pop(slot, None)
         self._refresh_trace()
 
@@ -180,7 +225,19 @@ class RouteFabric:
         and scatter the routed rows into each receiver's staged plane.
         Returns the (R, N) bool mask (None when nothing routed) — the
         caller hands it to ``_decode_outbox`` so routed rows skip the host
-        decode entirely."""
+        decode entirely.
+
+        With the payload ring on, MSG_APPEND with a real span (x != y)
+        joins the decision table: the span is resolved against the
+        sender's ring metadata (parent-linked walk, incarnation match,
+        above the truncation floor), and a resident span routes exactly
+        like a heartbeat — the packed row scatters on-device, the payload
+        words follow at the flush barrier (one gather), and the host
+        never reads the chain or encodes a frame for it. A span longer
+        than ``max_append_entries`` routes its capped prefix with the
+        same y/z rewrite + nxt re-root the host decode applies; a span
+        the ring cannot serve spills to the host path (counted, and
+        journaled as ``ring_spill`` when raft.flight_ring_spill is on)."""
         me = engine.me
         dsts = [d for d, peer in self.engines.items()
                 if d != me and not peer._route_dirty and self.link_ok(me, d)]
@@ -190,20 +247,31 @@ class RouteFabric:
         gids = np.asarray(proc, np.int64)
         base = np.isin(kind, _ROUTED_ALWAYS)
         is_ae = kind == rpc.MSG_APPEND
+        ring = self.rings.get(me)
+        ae_span = None
+        x = y = None
+        i64 = np.int64
         if is_ae.any():
-            i64 = np.int64
             x = (ov[2].astype(i64) << 32) | ov[3].astype(i64)
             y = (ov[4].astype(i64) << 32) | ov[5].astype(i64)
             base |= is_ae & (x == y)  # payload-free heartbeat/commit probe
+            if ring is not None:
+                ae_span = is_ae & (x != y)  # ring candidates, per cell
         if skip:
             smask = np.isin(gids, np.fromiter(skip, np.int64, len(skip)))
             if smask.any():
                 base = base & ~smask[:, None]
-        if not base.any():
+                if ae_span is not None:
+                    ae_span = ae_span & ~smask[:, None]
+        if not base.any() and (ae_span is None or not ae_span.any()):
             return None
         routed = np.zeros_like(base)
         my_inc = engine._h_ginc[gids]
         src_ov = None
+        cap = engine.max_append_entries
+        # Span resolutions memoized per (group, x, y): the same claim
+        # toward several followers walks the ring once.
+        memo: dict[tuple[int, int, int], object] = {}
         for d in dsts:
             peer = self.engines[d]
             # Receiver-side intake rules, applied at route time so a
@@ -212,31 +280,96 @@ class RouteFabric:
             # vote-parole drop (an abstaining group refuses election
             # traffic). Rows failing either fall back to the host path,
             # where the receiver's intake applies the same rule.
-            col = base[:, d] & (my_inc == peer._h_ginc[gids])
+            inc_ok = my_inc == peer._h_ginc[gids]
+            col = base[:, d] & inc_ok
             if peer._parole:
                 par = np.fromiter(peer._parole, np.int64, len(peer._parole))
                 col &= ~(np.isin(kind[:, d], _PAROLE_DROP_ARR)
                          & np.isin(gids, par))
+            capped: list[tuple[int, int]] = []  # (row, capped top id)
+            if ae_span is not None:
+                blkmap_d = None
+                for r in np.nonzero(ae_span[:, d] & inc_ok)[0].tolist():
+                    g = int(gids[r])
+                    key = (g, int(x[r, d]), int(y[r, d]))
+                    if key in memo:
+                        res = memo[key]
+                    else:
+                        res = (ring.resolve(g, int(my_inc[r]), key[1],
+                                            key[2], cap)
+                               if key[1] >= engine.chains[g].floor else None)
+                        memo[key] = res
+                    if res is None:
+                        # Not ring-servable: the row rides the host path
+                        # (chain read + encode), exactly as before PR 12.
+                        ring.spills += 1
+                        _m_ring_spills.inc(node=engine.self_id)
+                        if engine._flight_ring_spill:
+                            engine.flight.emit(
+                                engine._flight_tick(), "ring_spill",
+                                group=g, dst=d,
+                                span=int(key[2] - key[1]) & 0xFFFFFFFF)
+                        continue
+                    entries, top = res
+                    # Payload handoff: the receiver adopts these blocks
+                    # from ONE device gather at the flush barrier; pin
+                    # their slots until then.
+                    ring.pin(g, entries)
+                    if blkmap_d is None:
+                        blkmap_d = self._staged_blocks.setdefault(d, {})
+                    gm = blkmap_d.setdefault(g, {})
+                    for e in entries:
+                        gm[e.bid] = (me, e)
+                    self.ring_routed += 1
+                    if top is None:
+                        col[r] = True  # full span: the device row is exact
+                    else:
+                        # Capped: the routed row's y/z rewrite to the cap
+                        # top and the send pointer re-roots — the same
+                        # fixup protocol as the host decode's cap.
+                        capped.append((r, top))
+                        self.ring_capped += 1
+                        engine._nxt_fixups.append((g, d, top))
             rs = np.nonzero(col)[0]
-            if not len(rs):
+            if not len(rs) and not capped:
                 continue
-            routed[rs, d] = True
-            if src_ov is None:
+            if src_ov is None and len(rs):
                 src_ov = self._src_ov(h)
-            # Source row indexing: the active-compact outbox is indexed by
-            # bucket position (rs); dense and sparse sources are the dense
-            # (9, P, N) device outbox, indexed by group id.
-            srows = rs if h["mode"] == "active" else gids[rs]
-            terms_col = ov[1][rs, d]
-            if engine._flight_wire:
-                # Wire trace: routed msg_sent, off the routed rows the
-                # decision table just selected (terms from the host-fetched
-                # compact outbox — no device read).
-                engine.flight.emit_many(
-                    engine._flight_tick(), "msg_sent", gids[rs], terms_col,
-                    kind[rs, d], engine.me, d, "routed")
-            self._push(engine, d, src_ov, srows, gids[rs],
-                       kind[rs, d], terms_col, d)
+            if len(rs):
+                routed[rs, d] = True
+                # Source row indexing: the active-compact outbox is
+                # indexed by bucket position (rs); dense and sparse
+                # sources are the dense (9, P, N) device outbox, indexed
+                # by group id.
+                srows = rs if h["mode"] == "active" else gids[rs]
+                terms_col = ov[1][rs, d]
+                if engine._flight_wire:
+                    # Wire trace: routed msg_sent, off the routed rows the
+                    # decision table just selected (terms from the
+                    # host-fetched compact outbox — no device read).
+                    engine.flight.emit_many(
+                        engine._flight_tick(), "msg_sent", gids[rs],
+                        terms_col, kind[rs, d], engine.me, d, "routed")
+                self._push(engine, d, src_ov, srows, gids[rs],
+                           kind[rs, d], terms_col, d)
+            if capped:
+                crs = np.asarray([r for r, _ in capped], np.intp)
+                routed[crs, d] = True
+                tops = np.asarray([t for _, t in capped], i64)
+                vals = np.stack([ov[i][crs, d] for i in range(9)]
+                                ).astype(np.int32)
+                z_cap = np.minimum(
+                    (ov[6][crs, d].astype(i64) << 32)
+                    | ov[7][crs, d].astype(i64), tops)
+                vals[4] = (tops >> 32).astype(np.int32)
+                vals[5] = (tops & 0xFFFFFFFF).astype(np.int32)
+                vals[6] = (z_cap >> 32).astype(np.int32)
+                vals[7] = (z_cap & 0xFFFFFFFF).astype(np.int32)
+                if engine._flight_wire:
+                    engine.flight.emit_many(
+                        engine._flight_tick(), "msg_sent", gids[crs],
+                        vals[1], vals[0], engine.me, d, "routed")
+                self._push_vals(engine, d, vals, gids[crs])
         if not routed.any():
             return None
         self.routed_total += int(routed.sum())
@@ -302,7 +435,83 @@ class RouteFabric:
         srcs = self._staging_srcs.setdefault(slot, {})
         srcs[sender.me] = srcs.get(sender.me, 0) + len(gs)
 
+    def _push_vals(self, sender, slot, vals, gs) -> None:
+        """Host-vals twin of :meth:`_push`, for rows whose wire fields
+        differ from the device outbox (``max_append_entries``-capped
+        payload AEs: y/z rewritten to the capped top). ``vals`` is the
+        (9, k) int32 column block; the 36-byte-per-row upload replaces the
+        chain read + wire round trip the host path would have paid."""
+        km = self._staging_kinds.get(slot)
+        if km is None:
+            km = self._staging_kinds[slot] = np.zeros(
+                (self.P, self.N), np.int8)
+        km[gs, sender.me] = vals[0].astype(np.int8)
+        if self.trace:
+            tm = self._staging_terms.get(slot)
+            if tm is None:
+                tm = self._staging_terms[slot] = np.zeros(
+                    (self.P, self.N), np.int32)
+            tm[gs, sender.me] = vals[1].astype(np.int32)
+        plane = self._staging.get(slot)
+        if self.backend == "python":
+            if plane is None:
+                plane = np.zeros((9, self.P, self.N), np.int32)
+            plane[:, gs, sender.me] = vals
+        else:
+            B = route_bucket(len(gs), self.P)
+            vals_b = np.zeros((9, B), np.int32)
+            vals_b[:, :vals.shape[1]] = vals
+            gids_b = np.full(B, self.P, np.int32)  # padding: dropped
+            gids_b[:len(gs)] = gs
+            args = (jnp.asarray(vals_b), jnp.asarray(gids_b),
+                    jnp.asarray(int(sender.me), jnp.int32))
+            if plane is None:
+                plane = _route_scatter_vals_new_fn(B, self.P, self.N)(*args)
+            else:
+                plane = _route_scatter_vals_fn(B)(plane, *args)
+        self._staging[slot] = plane
+        srcs = self._staging_srcs.setdefault(slot, {})
+        srcs[sender.me] = srcs.get(sender.me, 0) + len(gs)
+
     # ----------------------------------------------------------- driver barrier
+
+    def _gather_payloads(self) -> None:
+        """Materialize this round's routed payload spans: flush every
+        ring's pending device scatter, then ONE gather per sender covering
+        the union of entries its receivers will adopt; the resulting
+        Blocks land in ``_ready_blocks`` for :meth:`consume`. Runs at the
+        flush barrier — between a route and its barrier nothing stages
+        into that sender's ring, so a gathered slot is never torn (and the
+        ring's pin guard enforces it against hostile schedules)."""
+        for r in self.rings.values():
+            r.flush_device()
+        if not self._staged_blocks:
+            return
+        # Dedup key is (group, bid) — block ids are only unique per chain,
+        # so two groups at the same (term, seq) collide on the bare id.
+        needs: dict[int, dict[tuple[int, int], tuple[int, object]]] = {}
+        for groups in self._staged_blocks.values():
+            for g, gm in groups.items():
+                for bid, (src, e) in gm.items():
+                    needs.setdefault(src, {})[(g, bid)] = (g, e)
+        got: dict[int, dict[tuple[int, int], object]] = {}
+        for src, m in needs.items():
+            r = self.rings.get(src)
+            if r is not None:
+                got[src] = r.gather(list(m.values()))
+        for slot, groups in self._staged_blocks.items():
+            if self.engines.get(slot) is None:
+                continue  # removed/stopped receiver: payloads die with it
+            tgt = self._ready_blocks.setdefault(slot, {})
+            for g, gm in groups.items():
+                dst = tgt.setdefault(g, {})
+                for bid, (src, _e) in gm.items():
+                    blk = got.get(src, {}).get((g, bid))
+                    if blk is not None:
+                        dst[bid] = blk
+        self._staged_blocks.clear()
+        for r in self.rings.values():
+            r._pinned.clear()  # the barrier: every in-flight span gathered
 
     def flush(self) -> None:
         """Promote staged planes to consumable ones. Drivers call this at
@@ -312,6 +521,11 @@ class RouteFabric:
         receiver-side intake bookkeeping the host path does in
         ``receive()``: the per-src transport-liveness stamp and the
         accepted-message counter."""
+        if self.rings:
+            # Payload plane first: pending ring scatters land and this
+            # round's routed spans materialize as receiver-ready Blocks
+            # (one gather per sender) before the kind planes promote.
+            self._gather_payloads()
         for slot in list(self._staging):
             stg = self._staging.pop(slot, None)
             skm = self._staging_kinds.pop(slot, None)
@@ -350,14 +564,19 @@ class RouteFabric:
 
     def consume(self, slot: int):
         """Take the receiver's ready plane for this tick_begin: returns
-        (plane, kinds, terms) — the device plane the routed step variants
-        merge, the host (P, N) kind mirror backing occupancy/wake/stamping,
-        and the term mirror when wire tracing is live (None otherwise) —
-        or (None, None, None) when nothing was routed."""
+        (plane, kinds, terms, blocks) — the device plane the routed step
+        variants merge, the host (P, N) kind mirror backing occupancy/
+        wake/stamping, the term mirror when wire tracing is live (None
+        otherwise), and the ring-fed payload blocks (group -> [Block],
+        already materialized at the flush barrier) the receiver's chain
+        adoption will walk — or all-None when nothing was routed."""
         plane = self._ready.pop(slot, None)
         kinds = self._ready_kinds.pop(slot, None)
         terms = self._ready_terms.pop(slot, None)
-        return plane, kinds, terms
+        rb = self._ready_blocks.pop(slot, None)
+        blocks = ({g: list(m.values()) for g, m in rb.items()}
+                  if rb else None)
+        return plane, kinds, terms, blocks
 
     def purge_group(self, slot: int, g: int, kinds=None) -> None:
         """Drop pending routed traffic for group ``g`` toward ``slot`` —
@@ -365,6 +584,19 @@ class RouteFabric:
         recycle (all kinds) and parole entry (election kinds only)."""
         sel_kinds = None if kinds is None else np.asarray(sorted(kinds),
                                                          np.int8)
+        if kinds is None:
+            # Full purge (recycle/reset): the slot's OWN ring row — a dead
+            # incarnation's payloads must never resolve for the successor
+            # — and any in-flight ring-fed blocks toward it. The
+            # kind-selective parole purge keeps both: AE is not an
+            # election kind.
+            ring = self.rings.get(slot)
+            if ring is not None:
+                ring.purge(g)
+            for store in (self._staged_blocks, self._ready_blocks):
+                m = store.get(slot)
+                if m:
+                    m.pop(g, None)
         for planes, mirrors, terms in (
                 (self._staging, self._staging_kinds, self._staging_terms),
                 (self._ready, self._ready_kinds, self._ready_terms)):
@@ -396,3 +628,21 @@ class RouteFabric:
                 if km is not None:
                     out[slot] = out.get(slot, 0) + int((km != 0).sum())
         return out
+
+    def ring_stats(self) -> dict | None:
+        """Fabric-aggregate payload-ring telemetry (bench rows, chaos soak
+        summaries): blocks staged, payload AEs served from the ring,
+        spills back to the host path, and current occupancy. None when the
+        ring is off."""
+        if not self.rings:
+            return None
+        rings = self.rings.values()
+        return {
+            "staged_blocks": sum(r.staged_total for r in rings),
+            "payload_aes_routed": self.ring_routed,
+            "capped": self.ring_capped,
+            "spills": sum(r.spills for r in rings),
+            "oversize": sum(r.oversize for r in rings),
+            "pin_skips": sum(r.pin_skips for r in rings),
+            "occupancy": sum(r.occupancy() for r in rings),
+        }

@@ -9,8 +9,17 @@ admission gate) into ``propose_local`` and come back as committed batches
 applied by per-partition :class:`~josefine_tpu.broker.partition_fsm.
 PartitionFsm` instances over in-memory logs. What it deliberately does
 NOT exercise: the TCP codec (the wire driver's job,
-:mod:`josefine_tpu.workload.wire`) and multi-node replication (the chaos
-workload's job, :mod:`josefine_tpu.workload.chaos_traffic`).
+:mod:`josefine_tpu.workload.wire`).
+
+``replication > 1`` adds R-1 co-located chain-only replica engines so
+every claimed row really replicates (AE out, ack back, quorum commit) —
+and with ``device_route``/``payload_ring`` that replication leg runs
+through the RouteFabric's device payload ring, which is the serve-path
+measurement PR 12 records in BENCH_traffic.json. Replica leadership is
+pinned to the broker node (replica election timeouts past the horizon),
+so the trace's NotLeader entries still mean row lifecycle, never replica
+churn; chaotic multi-node replication remains the chaos workload's job
+(:mod:`josefine_tpu.workload.chaos_traffic`).
 
 Determinism contract (same as ``chaos/``): the driver owns a virtual tick
 loop — no wall clock anywhere in this module — and every draw comes from
@@ -187,7 +196,9 @@ class TrafficEngine:
                  engine_groups: int | None = None,
                  active_set: bool = False, window: int = 1,
                  hb_ticks: int = 1, backend: str = "jax",
-                 max_group_inflight: int | None = None):
+                 max_group_inflight: int | None = None,
+                 replication: int = 1, device_route: bool = False,
+                 payload_ring: bool = False):
         self.spec = spec.validate()
         self.seed = seed
         self.model = TenantModel(spec)
@@ -198,11 +209,54 @@ class TrafficEngine:
         self.kv = MemKV()
         self.store = Store(self.kv)
         self.fsm = JosefineFsm(self.store, group_pool=P)
+        # Replicated serve mode (replication > 1): the broker node plus
+        # R-1 co-located replica engines — every claimed row spans all R
+        # slots, so each committed produce really replicates (AE out, ack
+        # back, quorum commit) instead of self-acking. The replicas are
+        # chain-only (no broker FSMs: they persist and ack, the broker
+        # node serves), and their election timeouts are pushed past the
+        # horizon so leadership of every row deterministically stays with
+        # the broker node — NotLeader in the trace still means row
+        # lifecycle, never replica churn. Pair with device_route /
+        # payload_ring to serve the produce path through the RouteFabric:
+        # with the ring on, the AE-with-blocks leg routes on-chip and the
+        # serve loop's host share is the broker handlers themselves.
+        self.replication = max(1, int(replication))
+        node_ids = list(range(1, self.replication + 1))
         self.engine = RaftEngine(
-            self.kv, [1], 1, groups=P, fsms={0: self.fsm},
+            self.kv, node_ids, 1, groups=P, fsms={0: self.fsm},
             params=step_params(timeout_min=3, timeout_max=8,
                                hb_ticks=hb_ticks),
             base_seed=seed, backend=backend, active_set=active_set)
+        self.peers = [
+            RaftEngine(MemKV(), node_ids, nid, groups=P,
+                       params=step_params(timeout_min=1 << 20,
+                                          timeout_max=(1 << 20) + 8,
+                                          hb_ticks=hb_ticks),
+                       base_seed=seed + nid, backend=backend)
+            for nid in node_ids[1:]
+        ]
+        self.engines = [self.engine] + self.peers  # slot-indexed
+        self.fabric = None
+        if device_route and self.replication < 2:
+            # Refuse rather than silently measure the classic single-node
+            # path: the soak row records these flags in its merge key, so
+            # an ignored flag would label an unrouted run as ring-routed.
+            raise ValueError("device_route requires replication >= 2 "
+                             "(a single-node engine has no peers to route "
+                             "to)")
+        if payload_ring and not device_route:
+            raise ValueError("payload_ring requires device_route")
+        if device_route and self.replication > 1:
+            from josefine_tpu.raft.route import RouteFabric
+
+            # ring_bytes=1024: produce record batches are ~100-300 B, but
+            # the metadata group's bulk-partition transitions run 512-768 B
+            # — a 512 B slot would spill every topic-lifecycle span.
+            self.fabric = RouteFabric(payload_ring=payload_ring,
+                                      ring_bytes=1024)
+            for e in self.engines:
+                self.fabric.register(e)
         cfg = BrokerConfig(id=1, ip="127.0.0.1", port=9092, seed=seed)
         if max_group_inflight is not None:
             cfg.max_group_inflight = max_group_inflight
@@ -271,13 +325,19 @@ class TrafficEngine:
         if p.group < 1 or p.group >= eng.P:
             return
         inc = self.store.group_incarnation(p.group)
-        eng.set_group_incarnation(p.group, inc)
+        claim = set(range(self.replication))
+        for e in self.engines:
+            # Replicas mirror the claim + incarnation (they have no
+            # metadata FSM of their own; the broker node's committed
+            # transitions are the source of truth for row wiring).
+            e.set_group_incarnation(p.group, inc)
         tenant = TenantModel.tenant_of(p.topic)
         eng.set_group_tag(p.group, TenantModel.tenant_label(tenant))
         if self._bootstrapping:
-            self._boot_claims[p.group] = {eng.me}
+            self._boot_claims[p.group] = claim
         else:
-            eng.set_group_members(p.group, {eng.me})
+            for e in self.engines:
+                e.set_group_members(p.group, claim)
         rep = self.broker.replicas.ensure(p)
         if p.group not in eng.drivers:
             eng.register_fsm(p.group, PartitionFsm(
@@ -292,8 +352,9 @@ class TrafficEngine:
         if p.group < 1 or p.group >= eng.P:
             return
         eng.unregister_fsm(p.group)
-        eng.set_group_members(p.group, set())
-        eng.recycle_group(p.group)
+        for e in self.engines:
+            e.set_group_members(p.group, set())
+            e.recycle_group(p.group)
         self.kv.delete(b"pfsm:%d" % p.group)
         self.kv.delete(b"pfsm:r:%d" % p.group)
         self._pending_acks.append(
@@ -308,7 +369,23 @@ class TrafficEngine:
     def _engine_tick(self) -> None:
         res = self.engine.tick(
             window=self.engine.suggest_window(self.window))
-        if res.outbound:  # single node: nothing to send to nobody
+        if self.replication > 1:
+            # Replicated serve loop, one virtual tick: every engine ticks
+            # first, THEN all outbound delivers, THEN the fabric barrier —
+            # host-path and device-routed halves of one tick's traffic
+            # must become consumable at the same receiver tick (the PR 6
+            # byte-identity barrier; delivering the broker's host frames
+            # mid-round while routed rows wait for the flush makes every
+            # replica permanently route-dirty with slot conflicts).
+            outs = list(res.outbound)
+            for p in self.peers:
+                outs.extend(p.tick(window=p.suggest_window(
+                    self.window)).outbound)
+            for m in outs:
+                self.engines[m.dst].receive(m)
+            if self.fabric is not None:
+                self.fabric.flush()
+        elif res.outbound:  # single node: nothing to send to nobody
             raise RuntimeError("single-node engine produced wire traffic")
 
     async def start(self, max_boot_ticks: int = 4096) -> None:
@@ -317,7 +394,8 @@ class TrafficEngine:
         # Idle every data row until a topic claims it: unclaimed rows
         # default to full membership and would all run elections for
         # nothing at P=100k.
-        self.engine.configure_groups({})
+        for e in self.engines:
+            e.configure_groups({})
         for _ in range(64):
             if self.engine.is_leader(0):
                 break
@@ -347,7 +425,8 @@ class TrafficEngine:
                 raise RuntimeError(f"topic create failed: {resp}")
 
         # One mask rebuild for every claim collected during the commits.
-        self.engine.configure_groups(self._boot_claims)
+        for e in self.engines:
+            e.configure_groups(self._boot_claims)
         self._bootstrapping = False
         groups = sorted(self._boot_claims)
         for _ in range(max_boot_ticks):
@@ -741,6 +820,15 @@ class TrafficEngine:
             },
             "seed": self.seed,
             "ticks": self.tick,
+            "replication": self.replication,
+            # Serve-path delivery split (replicated mode with a fabric):
+            # consensus rows routed device-resident vs host-decoded, and
+            # the payload ring's staged/routed/spill counts — how much of
+            # the produce path left the host.
+            "route_stats": ({
+                "routed_msgs": sum(e.routed_msgs for e in self.engines),
+                "ring": self.fabric.ring_stats(),
+            } if self.fabric is not None else None),
             "latency_ticks": agg,
             "latency_by_tenant_top": top,
             "tenants_with_latency": len(self._run_lat.values),

@@ -62,6 +62,31 @@ def test_same_seed_reproduces_with_device_route():
             == b["device_route_stats"]["routed_msgs"] > 0)
 
 
+@pytest.mark.slow
+def test_same_seed_reproduces_with_payload_ring():
+    """The device payload ring preserves the reproducibility contract: a
+    routed+ring soak (AppendEntries payloads served from the device ring,
+    host spills under the schedule's partition/crash) journals and
+    digests byte-identically across same-seed runs — and actually served
+    payload AEs from the ring. Slow like its ring-off sibling; the quick
+    lane's routed chaos smoke runs the path with workload traffic."""
+    from josefine_tpu.chaos.faults import NetFaults
+
+    kw = dict(net=NetFaults.quiet(), device_route=True, payload_ring=True,
+              groups=3)
+    a = run_soak(1234, SHORT, **kw)
+    b = run_soak(1234, SHORT, **kw)
+    assert a["invariants"] == "ok", a["violation"]
+    assert a["event_log"] == b["event_log"]
+    assert a["journals"] == b["journals"]
+    assert a["state_digest"] == b["state_digest"]
+    sa = a["device_route_stats"]
+    sb = b["device_route_stats"]
+    assert sa["routed_msgs"] == sb["routed_msgs"] > 0
+    assert sa["ring"] == sb["ring"]
+    assert sa["ring"]["payload_aes_routed"] > 0
+
+
 def test_same_seed_merged_timeline_and_coverage_identical():
     """Cluster-scope determinism: a same-seed two-node soak with wire
     traces on yields BYTE-identical merged timelines and equal (non-empty)

@@ -20,7 +20,11 @@ send-pointer fixups. Covered scenarios, per the tentpole checklist:
 * ``routed`` cell masks (device-resident delivery, PR 6): a synthetic
   routed-mask variant — the payload-free cells the RouteFabric would route
   — is compared on every decode that has any, pinning that both decoders
-  emit the identical host residual.
+  emit the identical host residual;
+* payload-routed AE masks (device payload ring, PR 12): a variant where
+  alternating above-floor span cells route as ring-resident while the rest
+  stay as spill rows (payloads attached) and below-floor spans keep the
+  snapshot path — both decoders must emit the identical residual.
 """
 
 import asyncio
@@ -64,6 +68,7 @@ class DiffStats:
         self.with_snapshots = 0
         self.skip_variants = 0
         self.routed_variants = 0
+        self.payload_routed_variants = 0
 
 
 def _wire_bytes(out):
@@ -130,6 +135,30 @@ def install_differential(engine: RaftEngine, stats: DiffStats) -> None:
                 assert _wire_bytes(a) == _wire_bytes(b)
                 assert sorted(fa) == sorted(fb)
                 stats.routed_variants += 1
+            # Synthetic PAYLOAD-routed cells (device payload ring, PR 12):
+            # the mask the RouteFabric computes when SOME AE spans are
+            # ring-resident — alternating above-floor span cells route
+            # (excised from the residual), the rest are spill rows that
+            # must still decode with payloads attached, and spans whose
+            # bottom fell below the truncation floor are never routed (the
+            # ring refuses them), so the snapshot path must survive in
+            # both decoders' residuals identically.
+            span = (kind == rpc.MSG_APPEND) & (x != y)
+            if span.any():
+                pmask = rmask.copy()
+                ri, di = np.nonzero(span)
+                floors = np.asarray(
+                    [self.chains[int(groups[r])].floor for r in ri])
+                eligible = x[ri, di] >= floors
+                sel = np.nonzero(eligible)[0][::2]  # ring-resident half
+                pmask[ri[sel], di[sel]] = True
+                a, fa = run_isolated(self, reference, ov, groups, None,
+                                     pmask)
+                b, fb = run_isolated(self, columnar, ov, groups, None,
+                                     pmask)
+                assert _wire_bytes(a) == _wire_bytes(b)
+                assert sorted(fa) == sorted(fb)
+                stats.payload_routed_variants += 1
         # The columnar path runs LAST and un-isolated: its snapshot-state
         # advancement and fixups are the ones the live cluster keeps.
         nfix = len(self._nxt_fixups)
@@ -217,6 +246,8 @@ def test_decode_differential_catchup_and_capping(sparse):
         assert stats.with_fixups > 0, "capping never produced a nxt fixup"
         assert stats.skip_variants > 0
         assert stats.routed_variants > 0, "no routed-mask decode compared"
+        assert stats.payload_routed_variants > 0, \
+            "no payload-routed AE mask decode compared"
 
     asyncio.run(main())
 

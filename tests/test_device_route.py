@@ -65,11 +65,18 @@ def _wire_key(m):
             m.ok, m.inc, blocks)
 
 
-def _would_route(cluster, link_ok, m):
+def _would_route(cluster, link_ok, m, ring_fab=None):
     """Reference-side twin of the fabric's delivery decision table, applied
     to an already-decoded wire message: (routed entry count, host residual
     message or None). The twin differential pins this wire-side predicate
-    and the fabric's outbox-side one to the same answers."""
+    and the fabric's outbox-side one to the same answers.
+
+    ``ring_fab`` is the REFERENCE cluster's shadow fabric (links closed,
+    payload ring on): its rings stage the same mint/adopt history as the
+    routed twin's, so payload-AE routability — span parent-walk through
+    the sender's resident entries from the wire x up to the sender's head,
+    above its floor, under the cap — is predicted from reference state
+    alone, never by peeking at the routed cluster."""
     if not isinstance(m, rpc.MsgBatch):
         return 0, m  # WireMsgs here are snapshots/pings — host-side kinds
     recv = cluster[m.dst]
@@ -79,6 +86,22 @@ def _would_route(cluster, link_ok, m):
     base = np.isin(k, _ROUTED_ALWAYS)
     hb = np.asarray([not m.blocks.get(int(g)) for g in m.group])
     base |= (k == rpc.MSG_APPEND) & (m.x == m.y) & hb
+    ring = ring_fab.rings.get(m.src) if ring_fab is not None else None
+    if ring is not None and m.blocks:
+        sender = cluster[m.src]
+        for i in range(len(m.group)):
+            g = int(m.group[i])
+            if (int(k[i]) != rpc.MSG_APPEND or m.x[i] == m.y[i]
+                    or not m.blocks.get(g)):
+                continue
+            x = int(m.x[i])
+            if x < sender.chains[g].floor:
+                continue
+            # The routed twin resolves from the DEVICE outbox claim (x,
+            # sender head]; a capped wire y is the resolve's own rewrite.
+            if ring.resolve(g, int(m.inc[i]), x, int(sender._h_head[g]),
+                            sender.max_append_entries) is not None:
+                base[i] = True
     base &= recv._h_ginc[m.group] == m.inc
     if recv._parole:
         par = np.fromiter(recv._parole, np.int64, len(recv._parole))
@@ -261,6 +284,171 @@ def test_twin_differential_python_backend():
                 _assert_engines_equal(act[i], ref[i], f"py t={t} n={i}")
             await asyncio.sleep(0)
         assert fab.routed_total > 0
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------- payload-ring twin suites
+
+
+def _mk_ring_cluster(route, sparse, active, slots, cap, backend="jax",
+                     groups=6):
+    """A 3-node cluster with the payload ring on. The reference twin gets
+    a SHADOW fabric — links closed, so nothing ever routes, but its rings
+    stage the identical mint/adopt history — which is what lets
+    _would_route predict payload-AE routability from reference state."""
+    ids3 = [1, 2, 3]
+    fsms = {0: ListFsm(), 3: ListFsm()} if groups > 3 else {0: ListFsm()}
+    cl = [RaftEngine(MemKV(), ids3, ids3[i], groups=groups,
+                     fsms=dict(fsms), params=PARAMS, base_seed=i,
+                     sparse_io=sparse, active_set=active,
+                     max_append_entries=cap, backend=backend)
+          for i in range(3)]
+    fab = RouteFabric(link_filter=None if route else (lambda s, d: False),
+                      payload_ring=True, ring_slots=slots)
+    for e in cl:
+        fab.register(e)
+    return cl, fab
+
+
+# Tier-1 keeps three dense single-window drivers — the base ring matrix
+# case, the 2-slot overflow-spill case, and the pipelined capped-fixup
+# case (the _drain_nxt_fixups satellite pin); the rest of the matrix
+# rides the slow lane like the PR 6 suite above.
+@pytest.mark.parametrize("sparse,window,pipeline,active,slots,cap", [
+    (False, 1, False, False, 8, 64),
+    (False, 1, False, False, 2, 64),   # ring overflow -> host spill rows
+    (False, 1, True, False, 8, 2),     # pipelined capped catch-up re-route
+    pytest.param(True, 1, False, False, 8, 64, marks=pytest.mark.slow),
+    pytest.param(False, 8, False, False, 8, 64, marks=pytest.mark.slow),
+    pytest.param(True, 1, True, False, 8, 64, marks=pytest.mark.slow),
+    pytest.param(False, 1, False, True, 8, 64, marks=pytest.mark.slow),
+    pytest.param(True, 1, True, True, 8, 2, marks=pytest.mark.slow),
+])
+def test_twin_differential_payload_ring(sparse, window, pipeline, active,
+                                        slots, cap):
+    """Ring-routed AppendEntries are byte-identical to host delivery: twin
+    3-node clusters (payload ring on vs shadow) through an identical
+    schedule — multi-block proposal bursts, a 15-tick partition of node 2,
+    a t=40 recycle — stay equal every tick on state, mirrors, chains, and
+    the routed cluster's host residual equals the reference's wire traffic
+    minus exactly the would-have-routed entries (payload AEs included).
+    The 2-slot case forces ring overflow (spans longer than the ring spill
+    host-side); the cap=2 pipelined case forces capped catch-up frames to
+    re-route from the ring with the same y/z rewrite + nxt fixup as the
+    host decode's cap."""
+
+    async def main():
+        act, fab = _mk_ring_cluster(True, sparse, active, slots, cap)
+        ref, shadow = _mk_ring_cluster(False, sparse, active, slots, cap)
+        committed = [0, 0]
+        routed_ref = 0
+        for t in range(75):
+            cur_part = 15 <= t < 30  # node 2 cut off; heal = catch-up spans
+            link_ok = (lambda s, d, cp=cur_part:
+                       not (cp and (s == 2 or d == 2)))
+            fab.link_filter = link_ok
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                for k in range(3):  # multi-block spans
+                                    e.propose(g, b"t%d-g%d-%d" % (t, g, k))
+                                break
+                if t == 40:
+                    for e in cl:
+                        e.recycle_group(2)
+                        e.set_group_incarnation(2, 1)
+                for e in cl:
+                    w = e.suggest_window(window)
+                    res = e.tick_pipelined(w) if pipeline else e.tick(w)
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    if cur_part and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            fab.flush()
+            shadow.flush()
+            resid = []
+            for m in outs[1]:
+                n, r = _would_route(ref, link_ok, m, ring_fab=shadow)
+                routed_ref += n
+                if r is not None:
+                    resid.append(r)
+            assert ([_wire_key(m) for m in outs[0]]
+                    == [_wire_key(m) for m in resid]), f"residual tick {t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"t={t} n={i}")
+            await asyncio.sleep(0)
+        drain = [[], []]
+        for ci, cl in enumerate((act, ref)):
+            for e in cl:
+                if e.pipeline_window:
+                    drain[ci].extend(e.tick_drain().outbound)
+        resid = []
+        for m in drain[1]:
+            n, r = _would_route(ref, lambda s, d: True, m, ring_fab=shadow)
+            routed_ref += n
+            if r is not None:
+                resid.append(r)
+        assert ([_wire_key(m) for m in drain[0]]
+                == [_wire_key(m) for m in resid]), "drain residual"
+        assert committed[0] == committed[1] > 0
+        assert fab.routed_total == routed_ref
+        assert fab.ring_routed > 0, "no payload AE ever rode the ring"
+        if slots == 2:
+            assert sum(r.spills for r in fab.rings.values()) > 0, \
+                "2-slot ring never overflowed into a host spill"
+        if cap == 2:
+            assert fab.ring_capped > 0, \
+                "capped catch-up never re-routed from the ring"
+
+    asyncio.run(main())
+
+
+def test_twin_differential_payload_ring_python_backend():
+    """The scalar-engine payload ring (numpy buffer, host-side scatter/
+    gather) is byte-identical to host decoding on the python backend too —
+    the third backend of the equivalence contract."""
+
+    async def main():
+        act, fab = _mk_ring_cluster(True, False, False, 8, 64,
+                                    backend="python", groups=3)
+        ref, shadow = _mk_ring_cluster(False, False, False, 8, 64,
+                                       backend="python", groups=3)
+        for t in range(45):
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 6 == 0 and t > 15:
+                    for e in cl:
+                        if e.is_leader(0):
+                            e.propose(0, b"p%d" % t)
+                            e.propose(0, b"q%d" % t)
+                            break
+                for e in cl:
+                    res = e.tick()
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    cl[m.dst].receive(m)
+            fab.flush()
+            shadow.flush()
+            resid = []
+            for m in outs[1]:
+                _n, r = _would_route(ref, lambda s, d: True, m,
+                                     ring_fab=shadow)
+                if r is not None:
+                    resid.append(r)
+            assert ([_wire_key(m) for m in outs[0]]
+                    == [_wire_key(m) for m in resid]), f"py residual t={t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"py t={t} n={i}")
+            await asyncio.sleep(0)
+        assert fab.ring_routed > 0
 
     asyncio.run(main())
 
@@ -493,6 +681,46 @@ def test_fabric_register_guards():
     fab._ready_kinds[1] = np.ones((8, 3), np.int8)
     fab.register(RaftEngine(MemKV(), [0, 1, 2], 1, groups=8, params=PARAMS))
     assert 1 not in fab._ready_kinds, "restart must drop pending traffic"
+
+
+def test_ring_spill_event_config_gated():
+    """A payload AE the ring cannot serve journals a ring_spill event —
+    but only when raft.flight_ring_spill is on (config-gated like
+    flight_wire); the spill COUNTER increments either way."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+        for gated in (False, True):
+            fab = RouteFabric(payload_ring=True, ring_slots=2)
+            engines = [RaftEngine(MemKV(), ids3, ids3[i], groups=2,
+                                  fsms={0: ListFsm()}, params=PARAMS,
+                                  base_seed=i, flight_ring_spill=gated)
+                       for i in range(3)]
+            for e in engines:
+                fab.register(e)
+            _settle(engines, fab)
+            lead = next(e for e in engines if e.is_leader(0))
+            for k in range(5):  # burst > 2 ring slots: the span must spill
+                lead.propose(0, b"spill-%d" % k)
+            for _ in range(6):
+                outs = []
+                for e in engines:
+                    outs.extend(e.tick().outbound)
+                for m in outs:
+                    engines[m.dst].receive(m)
+                fab.flush()
+                await asyncio.sleep(0)
+            spills = sum(r.spills for r in fab.rings.values())
+            assert spills > 0, "5-block span through a 2-slot ring must spill"
+            events = [ev for e in engines
+                      for ev in e.flight.events(kind="ring_spill")]
+            if gated:
+                assert events, "gated-on spill must journal ring_spill"
+                assert events[0]["detail"]["span"] >= 1
+            else:
+                assert not events, "default-off must journal nothing"
+
+    asyncio.run(main())
 
 
 def test_pipelined_cpu_caveat_warns_once(caplog):

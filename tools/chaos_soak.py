@@ -60,6 +60,12 @@ def main() -> int:
                          "partitions/crashes/noise force the host residual "
                          "path (pair with --quiet-net so a directive "
                          "schedule leaves clean links to route)")
+    ap.add_argument("--payload-ring", action="store_true",
+                    help="with --device-route: stage minted/adopted block "
+                         "payloads in each engine's device payload ring so "
+                         "AppendEntries with resident spans route on-chip "
+                         "too (summary device_route_stats.ring shows the "
+                         "staged/routed/spill split)")
     ap.add_argument("--flight-ring", type=int, default=None,
                     help="per-engine flight-recorder ring capacity (default "
                          "4096). Searched soaks with --flight-wire at scale "
@@ -171,6 +177,7 @@ def main() -> int:
             net=NetFaults.quiet() if args.quiet_net else None,
             auto_faults=args.auto_faults, active_set=args.active_set,
             hb_ticks=args.hb_ticks, device_route=args.device_route,
+            payload_ring=args.payload_ring,
             flight_wire=args.flight_wire, workload=workload,
             artifact_path=args.artifact, flight_ring=args.flight_ring,
             commitless_limit=args.commitless_limit)
@@ -198,7 +205,8 @@ def main() -> int:
 
     summary = {k: result[k] for k in
                ("schedule", "seed", "nodes", "groups", "window",
-                "active_set", "device_route", "flight_wire", "ticks",
+                "active_set", "device_route", "payload_ring",
+                "flight_wire", "ticks",
                 "proposed", "acked", "fault_events", "chaos_counters",
                 "nemesis_skipped", "nemesis_skipped_steps",
                 "max_commitless_window", "flight_ring",

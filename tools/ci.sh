@@ -90,9 +90,15 @@ chaos_smoke_device_route() {
     # routing gate entirely (per-message fates must not be dodged), so the
     # default-noise run would never route a single row — the summary's
     # device_route_stats shows the routed/host split actually exercised.
+    # PR 12 grew the smoke twice over: --payload-ring so AppendEntries
+    # payloads serve from the device ring, and --workload-tenants so the
+    # payload path carries real multi-tenant PRODUCE load under the
+    # leader-partition nemesis (device_route_stats.ring shows the
+    # staged/routed/spill split; workload acks feed the safety checkers).
     echo "== chaos smoke (device-route) =="
     python tools/chaos_soak.py --seed 7 --schedule leader-partition \
-        --horizon 200 --device-route --quiet-net
+        --horizon 200 --device-route --payload-ring --quiet-net \
+        --groups 4 --workload-tenants 4 --workload-load 2
 }
 
 chaos_search_smoke() {

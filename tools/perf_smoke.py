@@ -14,6 +14,10 @@ Three rows are checked:
 * a device-routed row (P=10k, --device-route, PR 6) — catches
   regressions of the RouteFabric path (outbox-mask routing, on-device
   scatter/merge, the ``route`` phase), which neither other floor runs;
+* a routed-under-load payload-ring row (P=10k, --device-route
+  --payload-ring, PR 12) — catches regressions of the device payload
+  ring (stage scatter, residency resolve, flush-barrier gather, the
+  ring-fed chain adoption), which the ring-off routed row never runs;
 * a product-path traffic row (``traffic: true`` — tools/traffic_soak.py,
   the in-process workload driver) — catches regressions of the SERVE
   path (broker handlers → propose_local → per-partition FSM apply →
@@ -54,6 +58,8 @@ FLOOR_ROWS = [
      "active_set": True, "active_frac": 0.01},
     {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
      "device_route": True},
+    {"P": 10000, "ticks": 20, "warmup": 30, "max_regression": 2.0,
+     "device_route": True, "payload_ring": True},
     {"traffic": True, "tenants": 16, "partitions": 64, "ticks": 60,
      "load": 16, "max_regression": 3.0},
 ]
@@ -109,6 +115,8 @@ def run_bench(floor: dict) -> dict:
         cmd += ["--active-frac", str(floor["active_frac"])]
     if floor.get("device_route"):
         cmd.append("--device-route")
+    if floor.get("payload_ring"):
+        cmd.append("--payload-ring")
     env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
     subprocess.run(cmd, check=True, cwd=ROOT, env=env,
                    timeout=floor.get("timeout_s", 600))
@@ -131,7 +139,8 @@ def _row_name(floor: dict) -> str:
         return (f"P={floor['P']} active-set "
                 f"(active-frac {floor.get('active_frac')})")
     if floor.get("device_route"):
-        return f"P={floor['P']} device-routed"
+        ring = " + payload-ring" if floor.get("payload_ring") else ""
+        return f"P={floor['P']} device-routed{ring}"
     return f"P={floor['P']} dense"
 
 

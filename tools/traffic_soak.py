@@ -17,8 +17,14 @@ Reproducibility contract (same as chaos_soak.py): two runs with the same
 (spec, --seed) produce byte-identical workload event traces — the summary
 quotes the trace sha256 so CI asserts it with one string compare.
 
+--replication R adds R-1 co-located chain-only replica engines so every
+commit really replicates; --device-route/--payload-ring run that
+replication leg through the RouteFabric's device payload ring (the
+serve-path row the PR 12 tentpole records).
+
 Rows merge into BENCH_traffic.json keyed on the workload axes
-(tenants, partitions, skew, offered load, active_set); per-tenant
+(tenants, partitions, skew, offered load, active_set, replication,
+device_route, payload_ring); per-tenant
 p50/p99 commit-latency quantiles, throughput split by path
 (replicated vs legacy-direct), and backpressure/retry counters land in
 every row.
@@ -51,8 +57,12 @@ DEFAULT_OUT = os.path.join(ROOT, "BENCH_traffic.json")
 
 
 def _row_key(r: dict) -> tuple:
+    # replication/device_route/payload_ring joined the key in PR 12;
+    # legacy rows normalize to the single-node defaults.
     return (r["tenants"], r["partitions"], float(r["skew"]),
-            float(r["offered_per_tick"]), bool(r.get("active_set")))
+            float(r["offered_per_tick"]), bool(r.get("active_set")),
+            int(r.get("replication", 1)), bool(r.get("device_route")),
+            bool(r.get("payload_ring")))
 
 
 def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
@@ -85,7 +95,10 @@ async def run_soak(args) -> dict:
         max_inflight_per_tenant=args.inflight,
     )
     drv = TrafficEngine(spec, seed=args.seed, active_set=args.active_set,
-                        window=args.window, hb_ticks=args.hb_ticks)
+                        window=args.window, hb_ticks=args.hb_ticks,
+                        replication=args.replication,
+                        device_route=args.device_route,
+                        payload_ring=args.payload_ring)
     t0 = time.perf_counter()
     await drv.start()
     t_boot = time.perf_counter() - t0
@@ -103,6 +116,10 @@ async def run_soak(args) -> dict:
         "ticks": ran,
         "seed": args.seed,
         "active_set": bool(args.active_set),
+        "replication": int(args.replication),
+        "device_route": bool(args.device_route),
+        "payload_ring": bool(args.payload_ring),
+        "route_stats": s["route_stats"],
         "window": args.window,
         "bootstrap_s": round(t_boot, 3),
         "wall_s": round(wall, 3),
@@ -159,6 +176,18 @@ def main() -> int:
     ap.add_argument("--hb-ticks", type=int, default=1)
     ap.add_argument("--active-set", action="store_true",
                     help="engine runs the active-set compacted scheduler")
+    ap.add_argument("--replication", type=int, default=1,
+                    help="co-located replica engines per row (1 = classic "
+                         "single-node serve; >1 adds chain-only replicas "
+                         "so every commit really replicates)")
+    ap.add_argument("--device-route", action="store_true",
+                    help="with --replication > 1: replication traffic "
+                         "runs through a RouteFabric (device-resident "
+                         "delivery)")
+    ap.add_argument("--payload-ring", action="store_true",
+                    help="with --device-route: AppendEntries payloads "
+                         "serve from the device payload ring, so the "
+                         "produce path's replication leg routes on-chip")
     ap.add_argument("--trace-out", default=None,
                     help="write the byte-stable workload event trace "
                          "(JSONL) here")
