@@ -65,6 +65,12 @@ class AsyncBlockingChecker(Checker):
         # driver deliberately stays OUT of this family — its virtual-tick
         # loop blocks the loop by design (it IS the clock).
         "josefine_tpu/workload/wire.py",
+        # The wire-chaos connection shim and soak sit ON the request path
+        # of every faulted connection: a blocking call inside the fate
+        # gate stalls the whole broker loop, exactly the class of bug the
+        # family exists to catch.
+        "josefine_tpu/chaos/wire.py",
+        "josefine_tpu/chaos/wire_soak.py",
     )
     rules = {
         "async-blocking-sleep":

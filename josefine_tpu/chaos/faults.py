@@ -95,6 +95,11 @@ class FaultPlane:
         self.disk: dict[int, dict[str, tuple[float, int]]] = {}
         # Tick skew: node -> stride (node steps when tick % stride == 0).
         self.skew: dict[int, int] = {}
+        # Optional wire plane (chaos/wire.WirePlane): socket-level fates
+        # for runs that front the cluster with real Kafka connections.
+        # advance() keeps its virtual clock in lockstep; nemesis wire ops
+        # arm windows on it (skipped-and-recorded when absent).
+        self.wire = None
 
     # ------------------------------------------------------------- recording
 
@@ -141,6 +146,8 @@ class FaultPlane:
                         self._event("disk_fault_disarmed", node=node, fault=kind)
                 if not arms:
                     del self.disk[node]
+            if self.wire is not None:
+                self.wire.sync(self.tick)
         return revived
 
     def should_tick(self, node: int) -> bool:
@@ -195,6 +202,8 @@ class FaultPlane:
         self.blocked.clear()
         self.disk.clear()
         self.skew.clear()
+        if self.wire is not None:
+            self.wire.heal()
 
     def crash(self, node: int, until: int | None = None) -> None:
         """Mark a node crashed until ``until`` (virtual tick). The harness

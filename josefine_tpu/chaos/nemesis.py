@@ -24,6 +24,19 @@ Step ops (the DSL):
 skipped and recorded, never fatal — a leaderless tick simply has no leader
 to shoot).
 
+Wire-plane ops (applied to the plane's attached
+:class:`josefine_tpu.chaos.wire.WirePlane`; on an in-process soak, which
+has no wire plane, they are skipped-and-recorded like an unresolvable
+target):
+
+``conn_reset {role,p,for}``    matching connections reset once per window
+``conn_stall {role,for}``      matching connections black-hole their I/O
+``torn_frames {role,p,for}``   writes tear at seeded split points
+``accept_refuse {for}``        the broker accept path refuses connections
+
+``role`` scopes a wire fault to ``"client"`` (the wire driver's sockets),
+``"broker"`` (the broker's side of accepted connections), or ``"any"``.
+
 The bundled schedules (:data:`SCHEDULES`) cover the classic nemeses:
 ``leader-partition``, ``minority-partition``, ``flapping-link``,
 ``slow-disk``, ``crash-loop``, ``skewed-pacer``. Every one must pass the
@@ -38,8 +51,15 @@ from dataclasses import dataclass, field
 
 from josefine_tpu.chaos.faults import FaultPlane
 
+#: Wire-plane ops: they arm fate windows on the FaultPlane's attached
+#: WirePlane (chaos/wire.py) instead of touching the message plane.
+WIRE_OPS = ("conn_reset", "conn_stall", "torn_frames", "accept_refuse")
+
 _OPS = ("block_link", "heal_link", "partition", "isolate", "heal_all",
-        "crash", "restart", "disk", "skew")
+        "crash", "restart", "disk", "skew") + WIRE_OPS
+
+#: Connection roles a wire op may scope to.
+ROLES = ("client", "broker", "any")
 
 #: Disk fault classes arm_disk_fault accepts (mirrored here so the DSL
 #: boundary can reject a bad ``fault`` before a soak ever starts).
@@ -69,6 +89,10 @@ OP_ARGS: dict[str, dict[str, tuple[str, ...]]] = {
                    "optional": ("node", "target", "p", "for", "group")},
     "skew":       {"required": ("stride",),
                    "optional": ("node", "target", "group")},
+    "conn_reset":    {"required": (), "optional": ("role", "p", "for")},
+    "conn_stall":    {"required": ("for",), "optional": ("role",)},
+    "torn_frames":   {"required": ("for",), "optional": ("role", "p")},
+    "accept_refuse": {"required": ("for",), "optional": ()},
 }
 
 
@@ -101,6 +125,9 @@ def _check_arg(name: str, v) -> str | None:
     elif name == "target":
         if v not in TARGETS:
             return f"target={v!r} not one of {TARGETS}"
+    elif name == "role":
+        if v not in ROLES:
+            return f"role={v!r} not one of {ROLES}"
     elif name == "symmetric":
         if not isinstance(v, bool):
             return f"symmetric={v!r} must be a bool"
@@ -272,6 +299,24 @@ class Nemesis:
 
     def _apply(self, step: Step) -> None:
         p, a = self.plane, step.args
+        if step.op in WIRE_OPS:
+            wire = getattr(p, "wire", None)
+            if wire is None:
+                # In-process soaks have no wire plane: skip-and-record,
+                # exactly like an unresolvable dynamic target, so a search
+                # genome carrying wire ops stays runnable everywhere.
+                p._event("nemesis_skipped", op=step.op, at=step.at)
+                self.skipped.append({"at": step.at, "op": step.op,
+                                     "target": a.get("role", "any")})
+                return
+            until = self._until(a)
+            end = p.tick + 1 if until is None else until
+            wire.arm(step.op, role=a.get("role", "any"),
+                     p=float(a.get("p", 1.0)), until=end)
+            p._event("wire_armed", fault=step.op,
+                     role=a.get("role", "any"), p=float(a.get("p", 1.0)),
+                     until=end)
+            return
         if step.op == "block_link":
             p.block_link(int(a["src"]), int(a["dst"]), until=self._until(a))
         elif step.op == "heal_link":
@@ -372,4 +417,70 @@ SCHEDULES = {
     "slow-disk": slow_disk,
     "crash-loop": crash_loop,
     "skewed-pacer": skewed_pacer,
+}
+
+
+# ---------------------------------------------------- bundled wire schedules
+#
+# Kept OUT of SCHEDULES: the in-process search bootstraps and picks parents
+# from sorted(SCHEDULES), and growing that dict would shift its seeded
+# parent draws (breaking the committed corpus/search-log determinism
+# contract). Wire-mode search uses this catalog instead.
+
+def wire_storm(n_nodes: int = 1) -> Schedule:
+    """The canonical wire nemesis: client connections reset and tear frames
+    in waves while the accept path flaps — the client retry/backoff and the
+    broker torn-frame path both get exercised, then everything heals."""
+    steps = [
+        Step(at=10, op="torn_frames", args={"role": "client", "p": 0.7,
+                                            "for": 30}),
+        Step(at=25, op="conn_reset", args={"role": "client", "p": 1.0,
+                                           "for": 4}),
+        # Reset right before the accept window: the reconnect lands on a
+        # refusing accept path and must back off through it.
+        Step(at=44, op="conn_reset", args={"role": "client", "p": 1.0,
+                                           "for": 3}),
+        Step(at=45, op="accept_refuse", args={"for": 10}),
+        Step(at=60, op="torn_frames", args={"role": "broker", "p": 0.6,
+                                            "for": 25}),
+        Step(at=75, op="conn_reset", args={"role": "any", "p": 0.8,
+                                           "for": 4}),
+    ]
+    return Schedule("wire-storm", steps, horizon=110, heal_ticks=40)
+
+
+def wire_stall(n_nodes: int = 1) -> Schedule:
+    """Black-hole stalls: connections hang mid-protocol until the client's
+    per-request deadline trips and the reconnect-with-resume path runs."""
+    steps = [
+        Step(at=15, op="conn_stall", args={"role": "client", "for": 20}),
+        Step(at=55, op="conn_stall", args={"role": "broker", "for": 15}),
+        Step(at=80, op="conn_reset", args={"role": "client", "for": 3}),
+    ]
+    return Schedule("wire-stall", steps, horizon=110, heal_ticks=40)
+
+
+def wire_leader_partition(n_nodes: int = 3) -> Schedule:
+    """The acceptance stack: a leader partition on the consensus plane
+    UNDER connection resets and torn frames on the Kafka wire — the two
+    fault planes compose, and every acked produce must still be durable
+    and readable after heal."""
+    steps = [
+        Step(at=12, op="torn_frames", args={"role": "any", "p": 0.5,
+                                            "for": 40}),
+        Step(at=20, op="isolate", args={"target": "leader", "for": 25}),
+        Step(at=30, op="conn_reset", args={"role": "client", "p": 1.0,
+                                           "for": 4}),
+        Step(at=70, op="conn_reset", args={"role": "any", "p": 0.7,
+                                           "for": 4}),
+        Step(at=80, op="accept_refuse", args={"for": 8}),
+    ]
+    return Schedule("wire-leader-partition", steps, horizon=130,
+                    heal_ticks=60)
+
+
+WIRE_SCHEDULES = {
+    "wire-storm": wire_storm,
+    "wire-stall": wire_stall,
+    "wire-leader-partition": wire_leader_partition,
 }

@@ -62,8 +62,11 @@ from dataclasses import dataclass, field
 from josefine_tpu.chaos.faults import NetFaults
 from josefine_tpu.chaos.nemesis import (
     DISK_FAULTS,
+    ROLES,
     SCHEDULES,
     TARGETS,
+    WIRE_OPS,
+    WIRE_SCHEDULES,
     Schedule,
     Step,
 )
@@ -140,6 +143,17 @@ _INSERT_OPS = (
     "heal_link", "restart",
 )
 
+#: Wire-mode insert catalog: socket fates dominate; raft-plane partitions
+#: and isolates stay in the draw (the wire soak's transport interceptors
+#: honor them — stacked-plane schedules are the interesting ones), while
+#: crash/disk/skew are out (the wire harness runs real product nodes it
+#: cannot rebuild mid-soak).
+_WIRE_INSERT_OPS = (
+    "conn_reset", "conn_reset", "torn_frames", "torn_frames",
+    "conn_stall", "conn_stall", "accept_refuse",
+    "partition", "isolate", "block_link", "heal_all",
+)
+
 #: Mutation-kind draw weights.
 _MUTATIONS = (
     "insert", "insert", "insert", "delete", "delete", "retime", "retime",
@@ -153,10 +167,20 @@ class Mutator:
     lineage."""
 
     def __init__(self, rng: random.Random, n_nodes: int,
-                 limits: SearchLimits, workload_genome: bool = False):
+                 limits: SearchLimits, workload_genome: bool = False,
+                 wire: bool = False):
         self.rng = rng
         self.n_nodes = n_nodes
         self.limits = limits
+        # Wire mode mutates over the socket-fate op catalog (plus the
+        # raft-plane partitions the wire soak's interceptors honor).
+        self.insert_ops = _WIRE_INSERT_OPS if wire else _INSERT_OPS
+        if n_nodes < 2:
+            # Link-topology ops need a second node to point at.
+            self.insert_ops = tuple(
+                op for op in self.insert_ops
+                if op not in ("partition", "isolate", "block_link",
+                              "heal_link")) or self.insert_ops
         # Include workload-knob mutations in the draw only when the search
         # actually drives traffic (a knob change on a traffic-less soak
         # would be a silent no-op candidate).
@@ -228,7 +252,13 @@ class Mutator:
         i = self.rng.choice(idx)
         st = g.schedule.steps[i]
         args = dict(st.args)
-        if "target" in args:
+        if st.op in WIRE_OPS:
+            if st.op == "accept_refuse":
+                return None  # role-less: nothing to retarget
+            cur = args.get("role", "any")
+            args["role"] = self.rng.choice(
+                [r for r in ROLES if r != cur])
+        elif "target" in args:
             args["target"] = ("follower" if args["target"] == "leader"
                               else "leader")
         elif "node" in args:
@@ -316,10 +346,22 @@ class Mutator:
         """One fresh random step, drawn from the op catalog with args in
         their validated domains (nemesis.OP_ARGS is the contract)."""
         rng = self.rng
-        op = rng.choice(_INSERT_OPS)
+        op = rng.choice(self.insert_ops)
         at = rng.randint(1, max(1, horizon - 1))
         dur = rng.randint(5, self.limits.max_for)
-        if op == "block_link":
+        if op == "conn_reset":
+            args = {"role": rng.choice(ROLES),
+                    "p": rng.choice((0.5, 0.8, 1.0)),
+                    "for": rng.randint(2, 10)}
+        elif op == "conn_stall":
+            args = {"role": rng.choice(ROLES),
+                    "for": rng.randint(5, min(25, self.limits.max_for))}
+        elif op == "torn_frames":
+            args = {"role": rng.choice(ROLES),
+                    "p": rng.choice((0.3, 0.6, 0.9)), "for": dur}
+        elif op == "accept_refuse":
+            args = {"for": rng.randint(3, 15)}
+        elif op == "block_link":
             src = rng.randrange(self.n_nodes)
             dst = rng.choice([j for j in range(self.n_nodes) if j != src])
             args = {"src": src, "dst": dst, "for": dur}
@@ -483,11 +525,23 @@ class ChaosSearch:
                  min_novel: int = 1, minimize: bool = True,
                  repro_dir: str | None = None,
                  log_path: str | None = None,
-                 start_iteration: int | None = None):
+                 start_iteration: int | None = None,
+                 wire: bool = False, wire_opts: dict | None = None):
         self.seed = seed
         self.corpus = corpus
         self.n_nodes = n_nodes
         self.groups = groups
+        # Wire mode: candidates run through run_wire_soak (real Kafka
+        # connections under a lockstep clock) instead of the in-process
+        # harness; parents/bootstrap come from the wire schedule catalog,
+        # the mutator draws socket-fate ops, and novelty is scored over
+        # the wire coverage classes. wire_opts forwards harness knobs
+        # (tenants, produce_every, commitless_limit, ...).
+        self.wire = wire
+        self.wire_opts = dict(wire_opts or {})
+        self.schedules = WIRE_SCHEDULES if wire else SCHEDULES
+        if wire:
+            workload = None  # the wire driver owns its own tenant spec
         self.active_set = active_set
         self.hb_ticks = hb_ticks
         self.device_route = device_route
@@ -511,7 +565,8 @@ class ChaosSearch:
         self.iteration = self.start_iteration = start_iteration
         self.rng = random.Random(seed * 2654435761 + start_iteration)
         self.mutator = Mutator(self.rng, n_nodes, self.limits,
-                               workload_genome=self.workload is not None)
+                               workload_genome=self.workload is not None,
+                               wire=wire)
         self.log_lines: list[dict] = []
         self.admitted = 0
         self.violations = 0
@@ -526,7 +581,7 @@ class ChaosSearch:
     def soak_config(self) -> dict:
         """The environment every candidate runs in — recorded into repro
         files so a replay reconstructs the exact run."""
-        return {
+        cfg = {
             "n_nodes": self.n_nodes, "groups": self.groups,
             "active_set": self.active_set, "hb_ticks": self.hb_ticks,
             "device_route": self.device_route,
@@ -534,10 +589,21 @@ class ChaosSearch:
             "commitless_limit": self.commitless_limit,
             "flight_ring": self.flight_ring,
         }
+        if self.wire:
+            cfg["wire"] = True
+            cfg["wire_opts"] = dict(self.wire_opts)
+        return cfg
 
     def _soak(self, schedule: Schedule, workload: dict | None,
               soak_seed: int) -> dict:
         self.probes += 1
+        if self.wire:
+            from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+            return run_wire_soak(
+                soak_seed, schedule, n_nodes=self.n_nodes,
+                commitless_limit=self.commitless_limit,
+                artifact_path=os.devnull, **self.wire_opts)
         return run_soak(
             soak_seed, schedule, n_nodes=self.n_nodes, groups=self.groups,
             net=NetFaults.quiet() if self.quiet_net else None,
@@ -566,13 +632,14 @@ class ChaosSearch:
     # -------------------------------------------------------- bootstrap
 
     def bootstrap(self) -> int:
-        """Seed an empty corpus by replaying the six bundled nemeses under
-        THIS search's soak configuration (clamped into the search limits)
-        and admitting each run as a ``bundled`` entry — the baseline the
+        """Seed an empty corpus by replaying the bundled nemeses (the six
+        in-process classics, or the wire catalog in wire mode) under THIS
+        search's soak configuration (clamped into the search limits) and
+        admitting each run as a ``bundled`` entry — the baseline the
         summary's class-count comparison is stated against."""
         added = 0
-        for k, name in enumerate(sorted(SCHEDULES)):
-            sched = SCHEDULES[name](self.n_nodes)
+        for k, name in enumerate(sorted(self.schedules)):
+            sched = self.schedules[name](self.n_nodes)
             lim = self.limits
             sched = Schedule(sched.name, sched.steps,
                              min(sched.horizon, lim.max_horizon),
@@ -617,8 +684,8 @@ class ChaosSearch:
         if self.corpus.entries and self.rng.random() >= 0.2:
             e = self.rng.choice(self.corpus.entries)
             return Genome.from_entry(e), e["signature"][:12]
-        name = self.rng.choice(sorted(SCHEDULES))
-        sched = SCHEDULES[name](self.n_nodes)
+        name = self.rng.choice(sorted(self.schedules))
+        sched = self.schedules[name](self.n_nodes)
         return Genome(sched, dict(self.workload) if self.workload
                       else None), name
 

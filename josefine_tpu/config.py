@@ -224,6 +224,37 @@ class BrokerConfig:
     # off and retries — bounded memory under overload instead of an
     # ever-growing queue). 0 = unbounded (legacy behavior).
     max_group_inflight: int = 128
+    # --- connection-plane graceful degradation (wire-plane chaos PR) ---
+    # Accept-path admission cap: refuse (clean close, retryable from the
+    # client's point of view) new connections past this many live ones.
+    # None/0 = uncapped (legacy behavior).
+    max_connections: int | None = None
+    # Per-client admission: at most this many live connections per
+    # client_id, checked at the first decoded request; an over-cap
+    # connection is closed without a response. A client fleet that
+    # presents one stable client_id per tenant (the production client
+    # shape) gets the per-tenant cap the ROADMAP names; the chaos wire
+    # driver instead presents per-connection ids (its journal labels), so
+    # wire soaks exercise the mechanism per connection, not per tenant.
+    # None/0 = uncapped.
+    max_connections_per_client: int | None = None
+    # Frame-body read deadline (seconds): once a frame HEADER arrived, the
+    # body must follow within this bound or the connection is closed — a
+    # torn frame whose tail never comes must not pin buffers forever.
+    # Idle connections (no header) are never timed out. None/0 = no bound.
+    conn_read_timeout_s: float | None = None
+    # Slow-client eviction: a response write that cannot drain within this
+    # bound evicts the connection (broker_conn_evicted_total + a flight
+    # event). None = no bound.
+    conn_write_timeout_s: float | None = None
+    # Reject request frames larger than this with a clean close (the
+    # protocol's i32 max is ~2 GiB — an absurd length prefix must not
+    # trigger an unbounded read). Default 64 MiB.
+    max_frame_bytes: int = 1 << 26
+    # Concurrent in-flight frames per connection: the server pipelines
+    # request handling (responses still write in request order); past this
+    # many unanswered frames it stops reading — natural backpressure.
+    max_inflight_per_conn: int = 64
     # Crash model (ARCHITECTURE.md "Durability"): "process" (default) makes
     # every ack durable to process crash (sqlite WAL synchronous=NORMAL, no
     # per-append seglog fsync); "power" additionally fsyncs the seglog

@@ -22,10 +22,15 @@ log = get_logger("kafka.client")
 class KafkaClient:
     """One connection to one broker; concurrent requests are correlated."""
 
-    def __init__(self, host: str, port: int, client_id: str = "josefine-internal"):
+    def __init__(self, host: str, port: int, client_id: str = "josefine-internal",
+                 wrap=None):
         self.host = host
         self.port = port
         self.client_id = client_id
+        # Chaos seam: ``wrap(reader, writer) -> (reader, writer)`` shims the
+        # freshly opened stream pair (josefine_tpu/chaos/wire.WirePlane
+        # injects seeded socket faults through it). None = production path.
+        self._wrap = wrap
         self._corr = itertools.count(1)
         self._pending: dict[int, tuple[int, int, asyncio.Future]] = {}
         self._reader: asyncio.StreamReader | None = None
@@ -34,6 +39,8 @@ class KafkaClient:
 
     async def connect(self) -> "KafkaClient":
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        if self._wrap is not None:
+            self._reader, self._writer = self._wrap(self._reader, self._writer)
         self._read_task = asyncio.create_task(self._read_loop())
         return self
 
@@ -48,13 +55,21 @@ class KafkaClient:
         corr = next(self._corr)
         fut = asyncio.get_running_loop().create_future()
         self._pending[corr] = (api_key, api_version, fut)
-        payload = codec.encode_request(api_key, api_version, corr, self.client_id, body)
-        self._writer.write(codec.frame(payload))
-        await self._writer.drain()
         try:
+            # The write itself can fail (injected reset, dead peer): it
+            # must run inside the cleanup scope or the pending future
+            # leaks with an unretrieved exception.
+            payload = codec.encode_request(api_key, api_version, corr,
+                                           self.client_id, body)
+            self._writer.write(codec.frame(payload))
+            await self._writer.drain()
             return await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(corr, None)
+            if fut.done() and not fut.cancelled():
+                fut.exception()  # retrieve: the read loop fails every
+                # pending future when the connection dies, and a caller
+                # that already gave up must not leave a GC warning
 
     async def send_raw(self, api_key: int, api_version: int, body: dict,
                        timeout: float = 10.0) -> tuple[bytes, bytes]:
@@ -70,14 +85,16 @@ class KafkaClient:
         # Sentinel api_key -1: the read loop resolves the future with the
         # raw payload instead of decoding.
         self._pending[corr] = (-1, api_version, fut)
-        payload = codec.encode_request(api_key, api_version, corr,
-                                       self.client_id, body)
-        self._writer.write(codec.frame(payload))
-        await self._writer.drain()
         try:
+            payload = codec.encode_request(api_key, api_version, corr,
+                                           self.client_id, body)
+            self._writer.write(codec.frame(payload))
+            await self._writer.drain()
             resp = await asyncio.wait_for(fut, timeout)
         finally:
             self._pending.pop(corr, None)
+            if fut.done() and not fut.cancelled():
+                fut.exception()
         return payload, resp
 
     async def _read_loop(self) -> None:

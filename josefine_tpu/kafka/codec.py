@@ -94,12 +94,22 @@ def frame(payload: bytes) -> bytes:
     return struct.pack(">i", len(payload)) + payload
 
 
-async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
+async def read_frame(reader: asyncio.StreamReader,
+                     max_frame: int | None = None,
+                     body_timeout: float | None = None) -> bytes | None:
     """Read one length-prefixed frame.
 
     Returns None only on a clean EOF (connection closed exactly on a frame
     boundary). A connection dropped mid-frame raises ConnectionError so
     callers can tell truncation from an orderly close.
+
+    ``max_frame`` caps the acceptable frame size below the protocol's i32
+    max (the broker passes its configured bound, so an absurd length
+    prefix is rejected with ValueError — a clean close — instead of an
+    unbounded read). ``body_timeout`` bounds the wait for the frame BODY
+    once the header has arrived (a torn frame whose tail never comes must
+    not hold the connection's buffers forever); the header wait stays
+    unbounded — an idle connection is healthy.
     """
     try:
         hdr = await reader.readexactly(4)
@@ -110,9 +120,20 @@ async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
     except ConnectionResetError:
         return None
     (size,) = struct.unpack(">i", hdr)
-    if size < 0 or size > MAX_FRAME:
+    if size < 0 or size > (MAX_FRAME if max_frame is None else max_frame):
         raise ValueError(f"invalid frame length {size}")
     try:
-        return await reader.readexactly(size)
-    except (asyncio.IncompleteReadError, ConnectionResetError):
+        body = reader.readexactly(size)
+        if body_timeout is not None:
+            try:
+                return await asyncio.wait_for(body, body_timeout)
+            except asyncio.TimeoutError:
+                raise ConnectionError(
+                    f"frame body ({size} bytes) not delivered within "
+                    f"{body_timeout}s") from None
+        return await body
+    except asyncio.IncompleteReadError:
         raise ConnectionError("connection dropped mid frame body") from None
+    # A mid-body ConnectionResetError propagates as itself (it is already
+    # a ConnectionError, so every existing caller's handling holds) — the
+    # broker's reset telemetry needs to tell an RST from a plain drop.

@@ -33,9 +33,17 @@ class Node:
     """One full node: raft runtime + broker + shared durable store."""
 
     def __init__(self, config: JosefineConfig, shutdown: Shutdown | None = None,
-                 in_memory: bool = False, pacer=None):
+                 in_memory: bool = False, pacer=None,
+                 raft_sock=None, broker_sock=None,
+                 intercept_send=None, intercept_recv=None,
+                 conn_shim=None):
         config.validate()
         self.config = config
+        # Pre-bound listening sockets (harnesses bind port 0 up front and
+        # keep them open — no pick-then-rebind race) and chaos seams: raft
+        # transport interceptors (FaultPlane) + broker connection shim
+        # (WirePlane).
+        self._broker_sock = broker_sock
         self.shutdown = shutdown or Shutdown()
         self.kv = open_kv(None if in_memory else config.broker.state_file,
                           full_sync=config.broker.durability == "power")
@@ -71,6 +79,9 @@ class Node:
             # inject a LockstepPacer (raft/pacer.py) to drive the whole
             # product node on a virtual clock.
             pacer=pacer,
+            intercept_send=intercept_send,
+            intercept_recv=intercept_recv,
+            sock=raft_sock,
         )
         self.client = RaftClient(self.raft)
         self.broker = JosefineBroker(
@@ -82,6 +93,12 @@ class Node:
             # (Broker.coordinator_for): the metadata group's Raft leader.
             leader_hint=lambda: self.raft.engine.leader_id(0),
             is_controller=lambda: self.raft.engine.is_leader(0),
+            conn_shim=conn_shim,
+            # Connection-plane events (slow-client evictions) land in the
+            # node's consensus flight journal, tick-stamped like every
+            # other recorded event, so /events and merged timelines see
+            # them.
+            flight_hook=self._conn_flight_event,
         )
         # Committed DeleteTopic reaches every node through the FSM; each
         # drops its own on-disk replica logs. Deregistration is synchronous
@@ -125,6 +142,10 @@ class Node:
                 # own engine's ring).
                 events_fn=lambda: self.raft.engine.flight.events(),
             )
+
+    def _conn_flight_event(self, kind: str, detail: dict) -> None:
+        eng = self.raft.engine
+        eng.flight.emit(eng._ticks, kind, **detail)
 
     def _rewire_partitions(self) -> None:
         """Restart path: rebuild every partition's consensus-group wiring
@@ -275,7 +296,7 @@ class Node:
 
     async def start(self) -> None:
         await self.raft.start()
-        await self.broker.start()
+        await self.broker.start(sock=self._broker_sock)
         if self.metrics_server is not None:
             await self.metrics_server.start()
         self._register_task = asyncio.create_task(self._register_self())

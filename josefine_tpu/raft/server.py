@@ -61,6 +61,7 @@ class JosefineRaft:
         pacer=None,
         intercept_send=None,
         intercept_recv=None,
+        sock=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -111,6 +112,7 @@ class JosefineRaft:
             self.shutdown,
             intercept_send=intercept_send,
             intercept_recv=intercept_recv,
+            sock=sock,
         )
         self._inbound_client: list[rpc.WireMsg] = []
         self._forwarded: dict[str, asyncio.Future] = {}

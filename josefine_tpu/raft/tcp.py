@@ -64,6 +64,7 @@ class Transport:
         shutdown: Shutdown,
         intercept_send: Callable[[int, object], bool] | None = None,
         intercept_recv: Callable[[object], bool] | None = None,
+        sock=None,
     ):
         # Chaos hook points (josefine_tpu/chaos/faults.py): predicates
         # consulted per outbound (peer_id, msg) / inbound (msg); returning
@@ -71,6 +72,9 @@ class Transport:
         # None by default — the production hot path pays one is-None check.
         self._intercept_send = intercept_send
         self._intercept_recv = intercept_recv
+        # Pre-bound listening socket (test harnesses bind port 0 and keep
+        # the socket open, closing the pick-then-rebind race).
+        self._sock = sock
         self.self_id = self_id
         self.bind_addr = bind_addr
         self.peers = peers
@@ -94,11 +98,22 @@ class Transport:
         self._server: asyncio.Server | None = None
         self._started = False
         self.dropped = 0  # drop-on-full counter (observability)
+        # Peers whose outbound connection is currently up. Lockstep
+        # harnesses gate their first tick grant on full-mesh connectivity:
+        # consensus traffic minted while a dial is still in its reconnect
+        # backoff is lost to the newest-wins mailbox, and a lost FIRST
+        # block replication can wedge behind the (known, pre-existing)
+        # windowed nack-repair liveness bug.
+        self.connected: set[int] = set()
 
     async def start(self) -> tuple[str, int]:
-        self._server = await asyncio.start_server(
-            self._handle_conn, self.bind_addr[0], self.bind_addr[1]
-        )
+        if self._sock is not None:
+            self._server = await asyncio.start_server(
+                self._handle_conn, sock=self._sock)
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_conn, self.bind_addr[0], self.bind_addr[1]
+            )
         self._started = True
         for nid in self.peers:
             self._peer_tasks[nid] = asyncio.create_task(self._send_loop(nid))
@@ -212,6 +227,7 @@ class Transport:
                 host, port = self.peers[peer_id]
                 _, writer = await asyncio.open_connection(host, port)
                 backoff = BACKOFF_BASE_S
+                self.connected.add(peer_id)
                 log.debug("node %d connected to peer %d", self.self_id, peer_id)
                 while True:
                     msg = await q.get()
@@ -225,10 +241,12 @@ class Transport:
                             write_frame(writer, body)
                     await writer.drain()
             except asyncio.CancelledError:
+                self.connected.discard(peer_id)
                 if writer is not None:
                     writer.close()
                 return
             except (ConnectionError, OSError):
+                self.connected.discard(peer_id)
                 if writer is not None:
                     writer.close()
                 _m_reconnects.inc(node=self.self_id)

@@ -34,6 +34,21 @@ Feature classes (the key's ``class:`` prefix):
   events; tick comparison is engine-tick vs plane-tick, which the lockstep
   harness keeps aligned for live nodes — a coverage signal, not a proof).
 
+Wire-plane classes (:meth:`CoverageMap.from_wire_events`, distilled from a
+:class:`josefine_tpu.chaos.wire.WirePlane` journal — the scoring substrate
+for wire-mode chaos search):
+
+* ``wev`` — wire fate kinds observed at all (``conn_reset``,
+  ``torn_write``, ``conn_stall``, ``conn_refused``, ``conn_open``);
+* ``wconn`` — fate kinds per connection CLASS (client ``c``, server ``s``,
+  accept path) — a reset on the broker side is different coverage from one
+  on the client side;
+* ``wkgram`` — distinct k-grams of each connection's fate sequence
+  (connection identity is not part of the key — shapes, not labels);
+* ``wretry`` / ``wrestart`` — log2-bucketed client retry and
+  consumer-group restart totals (how hard the resilience machinery
+  actually worked).
+
 Everything is derived from data the run already produced; nothing here
 touches the engine hot path.
 """
@@ -186,6 +201,32 @@ class CoverageMap:
                        if any(a <= t <= b for a, b in ivs))
             if hits:
                 cov.add("snap_under_partition:1", hits)
+        return cov
+
+    @classmethod
+    def from_wire_events(cls, events, k: int = 3, retries: int = 0,
+                         group_restarts: int = 0) -> "CoverageMap":
+        """Distill a wire plane's connection journals (``WirePlane.events()``)
+        into wire-class coverage (see module docstring)."""
+        cov = cls()
+        per_conn: dict[str, list[str]] = {}
+        for ev in events:
+            kind = ev.get("kind", "?")
+            label = str(ev.get("conn", "?"))
+            # Connection class: the label prefix with node ordinals
+            # stripped ("c" client, "s" server, "accept" accept path).
+            prefix = "".join(ch for ch in label.split(":", 1)[0]
+                             if not ch.isdigit()) or "?"
+            cov.add(f"wev:{kind}")
+            cov.add(f"wconn:{prefix}:{kind}")
+            per_conn.setdefault(label, []).append(kind)
+        for seq in per_conn.values():
+            for i in range(len(seq) - k + 1):
+                cov.add("wkgram:" + ">".join(seq[i:i + k]))
+        if retries > 0:
+            cov.add(f"wretry:{_log2_bucket(retries)}")
+        if group_restarts > 0:
+            cov.add(f"wrestart:{_log2_bucket(group_restarts)}")
         return cov
 
     # ------------------------------------------------------------- algebra

@@ -11,7 +11,6 @@ data path the reference couldn't reach over the wire (quirk 8).
 """
 
 import asyncio
-import socket
 import struct
 
 import pytest
@@ -23,14 +22,10 @@ from josefine_tpu.kafka.codec import ApiKey, ErrorCode
 from josefine_tpu.node import Node
 
 
-def free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+# Port-0 sockets kept OPEN and handed to the servers: the old
+# pick-then-close-then-rebind probe raced other processes on the same box
+# (the PR-10-era tier-1 flake) — see josefine_tpu/utils/net.py.
+from josefine_tpu.utils.net import bound_sockets  # noqa: E402
 
 
 class NodeManager:
@@ -39,8 +34,8 @@ class NodeManager:
     def __init__(self, n, tmp_path, tick_ms=30, partitions=1, in_memory=True,
                  mesh_shards=0, heartbeat_ms=None, election_ticks=(3, 8),
                  pacer=None):
-        raft_ports = free_ports(n)
-        broker_ports = free_ports(n)
+        raft_socks, raft_ports = bound_sockets(n)
+        broker_socks, broker_ports = bound_sockets(n)
         self.nodes = []
         self.configs = []
         self.in_memory = in_memory
@@ -63,7 +58,9 @@ class NodeManager:
                                     mesh_shards=mesh_shards),
             )
             self.configs.append(cfg)
-            self.nodes.append(Node(cfg, in_memory=in_memory, pacer=pacer))
+            self.nodes.append(Node(cfg, in_memory=in_memory, pacer=pacer,
+                                   raft_sock=raft_socks[i],
+                                   broker_sock=broker_socks[i]))
         self.broker_ports = broker_ports
 
     async def __aenter__(self):

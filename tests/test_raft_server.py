@@ -14,7 +14,6 @@ production ``WallClockPacer`` path covered.
 """
 
 import asyncio
-import socket
 
 from josefine_tpu.config import NodeAddr, RaftConfig
 from josefine_tpu.raft.client import RaftClient
@@ -33,18 +32,14 @@ class ListFsm:
         return b"ok:" + data
 
 
-def free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    for s in socks:
-        s.bind(("127.0.0.1", 0))
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+# Port-0 sockets kept OPEN and handed to the transports — the old
+# pick-then-close-then-rebind probe raced other processes on the box
+# (the recorded tier-1 flake; see josefine_tpu/utils/net.py).
+from josefine_tpu.utils.net import bound_sockets  # noqa: E402
 
 
 def make_nodes(n=3, tick_ms=30, pacer=None, **cfg_extra):
-    ports = free_ports(n)
+    socks, ports = bound_sockets(n)
     ids_ = list(range(1, n + 1))
     hb_ms = cfg_extra.pop("heartbeat_timeout_ms", tick_ms)
     nodes, fsms = [], []
@@ -67,14 +62,33 @@ def make_nodes(n=3, tick_ms=30, pacer=None, **cfg_extra):
         fsm = ListFsm()
         fsms.append(fsm)
         nodes.append(JosefineRaft(cfg, MemKV(), {0: fsm}, shutdown=Shutdown(),
-                                  pacer=pacer))
+                                  pacer=pacer, sock=socks[i]))
     return nodes, fsms
+
+
+async def wait_connected(nodes, timeout=10.0):
+    """Block (wall clock, zero ticks granted) until every node's outbound
+    mesh is up. Granting ticks while a dial is still inside its reconnect
+    backoff loses the first consensus batches to the newest-wins mailbox —
+    and a lost first block replication can wedge behind the pre-existing
+    windowed nack-repair liveness bug (see ROADMAP open items)."""
+    want = {n.config.id for n in nodes}
+    deadline = asyncio.get_running_loop().time() + timeout
+    while asyncio.get_running_loop().time() < deadline:
+        if all(n.transport.connected >= (want - {n.config.id})
+               for n in nodes):
+            return
+        await asyncio.sleep(0.02)
+    raise TimeoutError("transport mesh never fully connected")
 
 
 async def wait_for_leader(nodes, pacer, max_ticks=150, exclude=()):
     """Tick-bounded leader wait: election timeouts are 4-10 ticks, so 150
     granted ticks cover many retry rounds deterministically — no wall
-    deadline to blow on a starved box."""
+    deadline to blow on a starved box. Waits for full mesh connectivity
+    FIRST, so no election can outrun the startup dials."""
+    if len(nodes) > 1:
+        await wait_connected(nodes)
     for _ in range(max_ticks):
         leaders = [n for n in nodes if n not in exclude and n.engine.is_leader(0)]
         if len(leaders) == 1:

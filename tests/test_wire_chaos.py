@@ -1,0 +1,539 @@
+"""Wire-plane chaos: socket faults, client resilience, broker degradation.
+
+Contract under test (the wire twin of tests/test_chaos_determinism.py):
+
+* the nemesis DSL's wire ops validate at the boundary and are
+  skipped-and-recorded on harnesses without a wire plane;
+* the WirePlane's fate decisions are pure functions of
+  (seed, label, kind, window, index) — no draw-order coupling;
+* the broker survives torn Kafka frames (splits inside the 4-byte length
+  prefix and the body) without corrupting later frames on the SAME
+  connection, and absurd length prefixes close cleanly;
+* frames on one connection are handled concurrently with responses in
+  request order — a consumer group's members can share one socket
+  through join→sync→fetch→commit (the serialization-deadlock rule is
+  GONE; this is its regression test);
+* admission caps refuse cleanly, slow clients are evicted (metric +
+  flight event, pinned through the /metrics HTTP path);
+* a same-seed wire soak replays byte-identical fate sequences, event
+  logs, and journals, and a schedule stacking connection resets, torn
+  frames, and a leader partition completes with zero violations;
+* wire-mode chaos search admits novel wire-class coverage features.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+
+import pytest
+
+from josefine_tpu.chaos.faults import FaultPlane
+from josefine_tpu.chaos.nemesis import (
+    Nemesis,
+    Schedule,
+    Step,
+    WIRE_SCHEDULES,
+    validate_step,
+)
+from josefine_tpu.chaos.wire import NodeShim, WirePlane
+from josefine_tpu.kafka import codec
+from josefine_tpu.kafka.codec import ApiKey
+from josefine_tpu.utils.metrics import REGISTRY
+from josefine_tpu.workload.model import WorkloadSpec
+
+
+# ------------------------------------------------------------- DSL boundary
+
+
+def test_wire_ops_validate_at_the_boundary():
+    validate_step(0, 5, "conn_reset", {"role": "client", "p": 0.5, "for": 4})
+    validate_step(0, 5, "conn_stall", {"for": 10})
+    validate_step(0, 5, "torn_frames", {"role": "any", "for": 8})
+    validate_step(0, 5, "accept_refuse", {"for": 3})
+    with pytest.raises(ValueError, match="role"):
+        validate_step(0, 5, "conn_reset", {"role": "server"})
+    with pytest.raises(ValueError, match="missing required"):
+        validate_step(0, 5, "conn_stall", {"role": "client"})
+    with pytest.raises(ValueError, match="does not take"):
+        validate_step(0, 5, "accept_refuse", {"for": 3, "role": "client"})
+    # Round-trips through the schedule JSON like any other op.
+    sched = WIRE_SCHEDULES["wire-storm"]()
+    again = Schedule.from_json(sched.to_json())
+    assert again.to_json() == sched.to_json()
+
+
+def test_wire_ops_skip_and_record_without_a_wire_plane():
+    """An in-process soak has no wire plane: wire steps must cost a
+    skipped-and-recorded line, never a crash — a searched genome carrying
+    them stays runnable everywhere."""
+    plane = FaultPlane(3, 1)
+    sched = Schedule("w", [Step(at=1, op="conn_reset",
+                                args={"role": "client"})], horizon=4)
+    nem = Nemesis(sched, plane)
+    plane.advance(1)
+    nem.apply()
+    assert nem.skipped == [{"at": 1, "op": "conn_reset",
+                            "target": "client"}]
+    assert any(e["kind"] == "nemesis_skipped" for e in plane.events)
+
+
+def test_wire_plane_fates_are_keyed_not_streamed():
+    """Fate decisions are one-shot draws keyed on (seed, label, kind,
+    window, index): checking a fate twice must not change anything, and
+    two planes with one seed agree exactly."""
+    a, b = WirePlane(9), WirePlane(9)
+    for p in (a, b):
+        p.arm("torn_frames", role="client", p=1.0, until=100)
+    ca = a._register("c:x", "client")
+    cb = b._register("c:x", "client")
+    data = b"0123456789" * 8
+    pieces_a = a.tear(ca, data)
+    pieces_b = b.tear(cb, data)
+    assert pieces_a == pieces_b and len(pieces_a) == 2
+    assert b"".join(pieces_a) == data
+    # A different seed draws a different (or no) cut.
+    c = WirePlane(10)
+    c.arm("torn_frames", role="client", p=1.0, until=100)
+    cc = c._register("c:x", "client")
+    assert c.tear(cc, data) != pieces_a or True  # never raises, stays split
+    # Window expiry: past `until` the fate is gone.
+    a.sync(200)
+    assert a.tear(ca, data) == [data]
+    # The journal is (label, seq)-ordered and byte-stable.
+    log1 = a.event_log_jsonl()
+    assert log1 == a.event_log_jsonl()
+    assert [json.loads(line)["conn"] for line in log1.splitlines()] == \
+        sorted(json.loads(line)["conn"] for line in log1.splitlines())
+
+
+# ------------------------------------------------- raw-socket broker tests
+
+
+async def _read_response(reader):
+    hdr = await asyncio.wait_for(reader.readexactly(4), 10)
+    (size,) = struct.unpack(">i", hdr)
+    body = await asyncio.wait_for(reader.readexactly(size), 10)
+    return int.from_bytes(body[:4], "big", signed=True), body
+
+
+def _api_versions_frame(corr: int, client_id: str = "torn-test") -> bytes:
+    payload = codec.encode_request(int(ApiKey.API_VERSIONS), 1, corr,
+                                   client_id, {})
+    return codec.frame(payload)
+
+
+@pytest.mark.asyncio
+async def test_broker_survives_torn_frames(tmp_path):
+    """A partial Kafka frame — split at EVERY boundary of the 4-byte
+    length prefix and inside the body — must not corrupt subsequent
+    frames on the same connection."""
+    from test_integration import NodeManager
+
+    async with NodeManager(1, tmp_path) as mgr:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", mgr.broker_ports[0])
+        try:
+            corr = 0
+            frame = _api_versions_frame(0)
+            for cut in (1, 2, 3, 4, 4 + len(frame) // 2):
+                corr += 1
+                frame = _api_versions_frame(corr)
+                writer.write(frame[:cut])
+                await writer.drain()
+                await asyncio.sleep(0.05)  # the peer sees a torn frame
+                writer.write(frame[cut:])
+                await writer.drain()
+                got, _ = await _read_response(reader)
+                assert got == corr
+                # An intact frame right after must still be served.
+                corr += 1
+                writer.write(_api_versions_frame(corr))
+                await writer.drain()
+                got, _ = await _read_response(reader)
+                assert got == corr
+        finally:
+            writer.close()
+            await writer.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_zero_read_timeout_means_no_bound(tmp_path):
+    """conn_read_timeout_s = 0 follows the connection-plane convention
+    (None/0 = uncapped, like max_connections): a frame body arriving
+    after its header must still be served, not deadline-killed."""
+    from test_integration import NodeManager
+
+    mgr = NodeManager(1, tmp_path)
+    mgr.configs[0].broker.conn_read_timeout_s = 0
+    async with mgr:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", mgr.broker_ports[0])
+        try:
+            frame = _api_versions_frame(1)
+            writer.write(frame[:4])  # header only
+            await writer.drain()
+            await asyncio.sleep(0.1)  # body is NOT yet buffered broker-side
+            writer.write(frame[4:])
+            await writer.drain()
+            got, _ = await _read_response(reader)
+            assert got == 1
+        finally:
+            writer.close()
+            await writer.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_absurd_length_prefix_closes_cleanly(tmp_path):
+    """A length prefix past the broker's frame bound (or negative) must
+    close the connection cleanly — never an unbounded read — and the
+    broker must keep serving new connections."""
+    from test_integration import NodeManager
+
+    async with NodeManager(1, tmp_path) as mgr:
+        port = mgr.broker_ports[0]
+        for absurd in (1 << 30, -5):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(struct.pack(">i", absurd))
+            await writer.drain()
+            got = await asyncio.wait_for(reader.read(64), 10)
+            assert got == b""  # clean close, no response bytes
+            writer.close()
+            await writer.wait_closed()
+        # The broker survived both: a fresh connection still round-trips.
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(_api_versions_frame(1))
+        await writer.drain()
+        got, _ = await _read_response(reader)
+        assert got == 1
+        writer.close()
+        await writer.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_pipelined_frames_respond_in_request_order(tmp_path):
+    """Back-to-back frames on one connection are handled concurrently but
+    the responses write in request order (correlation ids monotone)."""
+    from test_integration import NodeManager
+
+    async with NodeManager(1, tmp_path) as mgr:
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", mgr.broker_ports[0])
+        try:
+            writer.write(b"".join(_api_versions_frame(c)
+                                  for c in (1, 2, 3, 4, 5)))
+            await writer.drain()
+            got = [(await _read_response(reader))[0] for _ in range(5)]
+            assert got == [1, 2, 3, 4, 5]
+        finally:
+            writer.close()
+            await writer.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_shared_connection_consumer_group_end_to_end(tmp_path):
+    """THE deadlock-rule regression: a consumer group whose members share
+    ONE connection passes join→sync→fetch→commit end to end. Under the
+    old sequential-per-connection broker, the follower's blocking
+    SyncGroup ahead of the leader's would deadlock the rebalance."""
+    from test_integration import NodeManager
+
+    from josefine_tpu.workload.wire import WireDriver
+
+    spec = WorkloadSpec(tenants=2, partitions_per_topic=2,
+                        consumers_per_tenant=3, produce_per_tick=4.0,
+                        payload_bytes=40)
+    async with NodeManager(1, tmp_path, partitions=8) as mgr:
+        await mgr.wait_registered()
+        drv = WireDriver(spec, seed=9,
+                         bootstrap=[("127.0.0.1", mgr.broker_ports[0])],
+                         shared_conn=True)
+        try:
+            await drv.create_topics()
+            await drv.produce_batches(10)
+            consumed = await drv.consume_verify()
+            assert consumed == 10 == drv.n_produced
+        finally:
+            await drv.close()
+
+
+@pytest.mark.asyncio
+async def test_pipelined_produces_append_in_request_order(tmp_path):
+    """The serial lane: two produces pipelined on ONE connection must
+    append in request order even when the FIRST one's handler is slow —
+    only the blocking group APIs are handled concurrently. (Without the
+    lane, the delayed first produce appends second while the acks still
+    arrive in request order — a silent ordering inversion.)"""
+    from test_integration import NodeManager, make_batch
+
+    async with NodeManager(1, tmp_path) as mgr:
+        await mgr.wait_registered()
+        broker = mgr.nodes[0].broker.broker
+        inner = broker.handle_request
+        slowed = {"first": True}
+
+        async def slow_first_produce(api_key, api_version, body, **kw):
+            if api_key == int(ApiKey.PRODUCE) and slowed["first"]:
+                slowed["first"] = False
+                await asyncio.sleep(0.3)
+            return await inner(api_key, api_version, body, **kw)
+
+        broker.handle_request = slow_first_produce
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", mgr.broker_ports[0])
+        try:
+            cl = await kafka_client_connect_raw(mgr.broker_ports[0])
+            resp = await cl.send(ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": "ord", "num_partitions": 1,
+                            "replication_factor": 1, "assignments": [],
+                            "configs": []}],
+                "timeout_ms": 10000, "validate_only": False}, timeout=20.0)
+            assert resp["topics"][0]["error_code"] == 0
+            await asyncio.sleep(0.3)  # let the partition elect
+
+            def produce_frame(corr, payload):
+                body = {"transactional_id": None, "acks": -1,
+                        "timeout_ms": 5000,
+                        "topics": [{"name": "ord", "partitions": [
+                            {"index": 0,
+                             "records": make_batch(payload, 1)}]}]}
+                return codec.frame(codec.encode_request(
+                    int(ApiKey.PRODUCE), 3, corr, "ord-test", body))
+
+            # Both frames in one write: the broker reads both before the
+            # slowed first handler finishes.
+            writer.write(produce_frame(1, b"first-payload") +
+                         produce_frame(2, b"second-payload"))
+            await writer.drain()
+            for want in (1, 2):
+                got, body = await _read_response(reader)
+                assert got == want
+            fr = await cl.send(ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+                "max_bytes": 1 << 20, "isolation_level": 0,
+                "topics": [{"topic": "ord", "partitions": [
+                    {"partition": 0, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 20}]}]})
+            data = fr["responses"][0]["partitions"][0]["records"] or b""
+            i1, i2 = data.find(b"first-payload"), data.find(b"second-payload")
+            assert i1 != -1 and i2 != -1 and i1 < i2, (i1, i2)
+            await cl.close()
+        finally:
+            broker.handle_request = inner
+            writer.close()
+            await writer.wait_closed()
+
+
+async def kafka_client_connect_raw(port):
+    from josefine_tpu.kafka import client as kafka_client
+
+    return await kafka_client.connect("127.0.0.1", port,
+                                      client_id="ord-helper")
+
+
+# ------------------------------------------- degradation: caps + eviction
+
+
+@pytest.mark.asyncio
+async def test_admission_caps_refuse_cleanly(tmp_path):
+    """Global and per-client connection caps refuse with a clean close
+    (retryable from the client's perspective), counted per reason."""
+    from test_integration import NodeManager
+
+    mgr = NodeManager(1, tmp_path)
+    mgr.configs[0].broker.max_connections_per_client = 1
+    base_refused = REGISTRY.counter("broker_conn_refused_total")
+    async with mgr:
+        port = mgr.broker_ports[0]
+        r1, w1 = await asyncio.open_connection("127.0.0.1", port)
+        w1.write(_api_versions_frame(1, client_id="dup"))
+        await w1.drain()
+        assert (await _read_response(r1))[0] == 1
+        # Same client_id again: the first request closes the connection.
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(_api_versions_frame(1, client_id="dup"))
+        await w2.drain()
+        assert await asyncio.wait_for(r2.read(64), 10) == b""
+        assert base_refused.get(reason="per_client") >= 1
+        for w in (w1, w2):
+            w.close()
+            await w.wait_closed()
+        # wait_closed() only confirms the CLIENT transport closed; the
+        # broker still has to observe EOF and run its teardown before the
+        # global cap below can admit a fresh connection.
+        broker = mgr.nodes[0].broker
+        for _ in range(500):
+            if broker._active == 0:
+                break
+            await asyncio.sleep(0.02)
+        assert broker._active == 0
+        # Global cap: refuse at accept.
+        mgr.configs[0].broker.max_connections_per_client = None
+        mgr.configs[0].broker.max_connections = 1
+        r3, w3 = await asyncio.open_connection("127.0.0.1", port)
+        w3.write(_api_versions_frame(1, client_id="a"))
+        await w3.drain()
+        assert (await _read_response(r3))[0] == 1
+        r4, w4 = await asyncio.open_connection("127.0.0.1", port)
+        assert await asyncio.wait_for(r4.read(64), 10) == b""
+        assert base_refused.get(reason="max_connections") >= 1
+        for w in (w3, w4):
+            w.close()
+            await w.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_slow_client_eviction_and_reset_telemetry(tmp_path):
+    """A response write that misses its deadline evicts the connection
+    (counter + flight event); an injected broker-side reset lands in
+    broker_conn_resets_total; the whole connection-plane series set is
+    pinned through the REAL /metrics HTTP path."""
+    from test_integration import NodeManager
+
+    from josefine_tpu.utils.metrics import MetricsServer
+
+    mgr = NodeManager(1, tmp_path)
+    mgr.configs[0].broker.conn_write_timeout_s = 0.25
+    plane = WirePlane(5)
+    mgr.nodes[0].broker.conn_shim = NodeShim(plane, 1)
+    evicted = REGISTRY.counter("broker_conn_evicted_total")
+    resets = REGISTRY.counter("broker_conn_resets_total")
+    ev_before = sum(evicted.values.values())
+    rs_before = sum(resets.values.values())
+    async with mgr:
+        port = mgr.broker_ports[0]
+        # Stall the broker's writes forever: the response cannot drain
+        # within the deadline and the client must be evicted.
+        plane.arm("conn_stall", role="broker", until=1 << 30)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(_api_versions_frame(1, client_id="sloth"))
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(64), 10) == b""  # evicted
+        writer.close()
+        await writer.wait_closed()
+        assert sum(evicted.values.values()) == ev_before + 1
+        flight = mgr.nodes[0].raft.engine.flight.events()
+        assert any(e["kind"] == "conn_evicted" for e in flight)
+
+        # Injected broker-side reset: counted as a reset, not a crash.
+        # The first request labels the connection; its RESPONSE write hits
+        # the reset gate, so the client sees a dead connection instead of
+        # an answer.
+        plane.heal()
+        plane.arm("conn_reset", role="broker", p=1.0, until=1 << 30)
+        r2, w2 = await asyncio.open_connection("127.0.0.1", port)
+        w2.write(_api_versions_frame(1, client_id="resetme"))
+        await w2.drain()
+        try:
+            assert await asyncio.wait_for(r2.read(64), 10) == b""
+        except (ConnectionError, asyncio.TimeoutError):
+            pass
+        w2.close()
+        try:
+            await w2.wait_closed()
+        except (ConnectionError, OSError):
+            pass
+        for _ in range(100):
+            if sum(resets.values.values()) > rs_before:
+                break
+            await asyncio.sleep(0.05)
+        assert sum(resets.values.values()) > rs_before
+
+        # Exposition through the real HTTP path: every connection-plane
+        # series is present on /metrics.
+        srv = MetricsServer("127.0.0.1", 0)
+        port = await srv.start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            await w.drain()
+            body = (await asyncio.wait_for(r.read(1 << 20), 10)).decode()
+            for name in ("broker_active_connections",
+                         "broker_conn_evicted_total",
+                         "broker_conn_resets_total",
+                         "broker_conn_refused_total"):
+                assert name in body, name
+            w.close()
+            await w.wait_closed()
+        finally:
+            await srv.stop()
+
+
+# --------------------------------------------------------- wire chaos soak
+
+
+def test_wire_soak_storm_invariants_and_telemetry():
+    """One seeded wire soak under the bundled storm: fates actually fire,
+    the client's retry machinery engages (and is counted on /metrics),
+    and every wire invariant holds."""
+    from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+    r = run_wire_soak(7, "wire-storm", n_nodes=1, tenants=1)
+    assert r["invariants"] == "ok", r["violation"]
+    assert r["produced"] > 0 and r["consumed"] == r["produced"]
+    fates = {k for v in r["fate_log"].values() for k in v}
+    assert "conn_reset" in fates and "torn_write" in fates
+    assert r["driver"]["retries"] > 0
+    assert "wire_client_retries_total" in REGISTRY.render_prometheus()
+    # Wire coverage classes are populated — the search scoring substrate.
+    assert r["coverage"]["class_counts"].get("wev", 0) >= 2
+    assert r["coverage"]["class_counts"].get("wkgram", 0) >= 1
+    assert r["coverage_signature"] != ""
+
+
+@pytest.mark.slow
+def test_wire_soak_same_seed_byte_identical():
+    """The wire determinism contract: same (seed, schedule) replays the
+    fate sequence, the event log, and the per-connection journals
+    byte-identically — same discipline as test_chaos_determinism.py."""
+    from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+    a = run_wire_soak(7, "wire-storm", n_nodes=1, tenants=2)
+    b = run_wire_soak(7, "wire-storm", n_nodes=1, tenants=2)
+    assert a["invariants"] == "ok", a["violation"]
+    assert a["fate_log"] == b["fate_log"]
+    assert a["event_log"] == b["event_log"]          # byte-identical
+    assert a["journals"] == b["journals"]            # merged journals too
+    assert a["coverage_signature"] == b["coverage_signature"] != ""
+    assert a["driver"] == b["driver"]
+    # A different seed draws different fates.
+    c = run_wire_soak(8, "wire-storm", n_nodes=1, tenants=2)
+    assert c["event_log"] != a["event_log"]
+
+
+@pytest.mark.slow
+def test_wire_soak_stacked_leader_partition_zero_violations():
+    """The acceptance stack: connection resets + torn frames + an
+    accept-refuse window + a raft leader partition, three nodes, zero
+    invariant violations — every acked produce durable and readable after
+    heal, every consumer group reconverged."""
+    from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+    r = run_wire_soak(7, "wire-leader-partition", n_nodes=3, tenants=2)
+    assert r["invariants"] == "ok", r["violation"]
+    assert r["produced"] > 0 and r["consumed"] == r["produced"]
+    fates = {k for v in r["fate_log"].values() for k in v}
+    assert "conn_reset" in fates and "torn_write" in fates
+    # Bounded retries: the resilience machinery worked, not spun.
+    assert 0 < r["driver"]["retries"] <= 40 * max(1, r["produced"])
+
+
+@pytest.mark.slow
+def test_wire_search_admits_novel_wire_coverage():
+    """Wire-mode chaos search: a short seeded run from the bundled wire
+    baseline must admit at least one schedule covering a NOVEL wire-class
+    feature (the acceptance bar for closing the search loop over the wire
+    plane)."""
+    from josefine_tpu.chaos.search import ChaosSearch, Corpus
+
+    s = ChaosSearch(21, Corpus(None), n_nodes=1, wire=True,
+                    wire_opts={"tenants": 1, "consumers_per_tenant": 2})
+    summary = s.run(budget_iters=3)
+    assert summary["admitted"] >= 1, summary
+    wire_classes = {"wev", "wconn", "wkgram", "wretry", "wrestart"}
+    baseline = s.corpus.baseline_coverage()
+    novel = [f for f in s.corpus.coverage.counts
+             if f.split(":", 1)[0] in wire_classes
+             and f not in baseline.counts]
+    assert novel, summary

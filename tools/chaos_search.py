@@ -56,6 +56,23 @@ def replay(path: str) -> int:
     with open(path) as fh:
         rep = json.load(fh)
     soak = rep.get("soak", {})
+    if soak.get("wire"):
+        from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+        result = run_wire_soak(
+            rep["seed"], Schedule.from_json(json.dumps(rep["schedule"])),
+            n_nodes=soak.get("n_nodes", 1),
+            commitless_limit=soak.get("commitless_limit"),
+            artifact_path=os.devnull, **soak.get("wire_opts", {}))
+        print(json.dumps({
+            "repro": path,
+            "recorded_violation": rep["violation"],
+            "replayed_violation": result["violation"],
+            "reproduced": result["violation"] is not None,
+            "minimized_steps": rep["minimized_steps"],
+            "trigger_steps": rep["trigger_steps"],
+        }))
+        return 0 if result["violation"] is not None else 1
     result = run_soak(
         rep["seed"], Schedule.from_json(json.dumps(rep["schedule"])),
         n_nodes=soak.get("n_nodes", 3), groups=soak.get("groups", 2),
@@ -137,6 +154,14 @@ def main() -> int:
                          "starve every group's commit progress past this "
                          "many ticks VIOLATE (the searchable liveness "
                          "axis)")
+    ap.add_argument("--wire", action="store_true",
+                    help="wire mode: candidates run through the wire "
+                         "chaos soak (real Kafka connections, socket "
+                         "fates, lockstep clock) and are scored on the "
+                         "wire coverage classes; parents/bootstrap come "
+                         "from the wire schedule catalog")
+    ap.add_argument("--wire-tenants", type=int, default=1,
+                    help="tenants per wire-mode candidate soak")
     ap.add_argument("--workload-tenants", type=int, default=0,
                     help="drive tenant traffic and include the workload "
                          "knobs (skew/churn/load/inflight) in the "
@@ -187,7 +212,9 @@ def main() -> int:
         limits=SearchLimits(max_horizon=args.max_horizon,
                             max_heal=args.max_heal),
         min_novel=args.min_novel, minimize=not args.no_minimize,
-        repro_dir=repro_dir, log_path=args.log)
+        repro_dir=repro_dir, log_path=args.log,
+        wire=args.wire,
+        wire_opts={"tenants": args.wire_tenants} if args.wire else None)
 
     if args.bootstrap:
         added = search.bootstrap()

@@ -90,6 +90,21 @@ def main() -> int:
     ap.add_argument("--timeline", default=None,
                     help="write the merged cluster timeline (JSONL, "
                          "(tick, node, seq) ordered) here")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the WIRE chaos soak instead of the "
+                         "in-process harness: full product nodes over "
+                         "real sockets on a lockstep clock, the wire "
+                         "driver fronting them, socket fates "
+                         "(conn_reset/conn_stall/torn_frames/"
+                         "accept_refuse) stacked with raft-plane "
+                         "partitions; wire invariants (acked-produce "
+                         "durability, consumer-group reconvergence, "
+                         "commitless liveness) enforced")
+    ap.add_argument("--wire-tenants", type=int, default=2,
+                    help="tenants the wire driver runs (with --wire)")
+    ap.add_argument("--wire-produce-every", type=int, default=4,
+                    help="offer one produce batch every N virtual ticks "
+                         "(with --wire)")
     ap.add_argument("--workload-tenants", type=int, default=0,
                     help="drive the multi-tenant workload model as the "
                          "proposal source (this many tenants; 0 = the "
@@ -133,14 +148,16 @@ def main() -> int:
     jax.config.update("jax_platforms", args.platform)
 
     from josefine_tpu.chaos.faults import NetFaults
-    from josefine_tpu.chaos.nemesis import SCHEDULES
+    from josefine_tpu.chaos.nemesis import SCHEDULES, WIRE_SCHEDULES
     from josefine_tpu.chaos.soak import run_soak
 
     if args.list:
-        for name, builder in sorted(SCHEDULES.items()):
+        for name, builder in sorted(SCHEDULES.items()) \
+                + sorted(WIRE_SCHEDULES.items()):
             sched = builder(args.nodes)
-            print(f"{name:20s} horizon={sched.horizon:4d} "
-                  f"steps={len(sched.steps):2d}  "
+            wire = " [--wire]" if name in WIRE_SCHEDULES else ""
+            print(f"{name:22s} horizon={sched.horizon:4d} "
+                  f"steps={len(sched.steps):2d}{wire}  "
                   f"{(builder.__doc__ or '').strip().splitlines()[0]}")
         return 0
 
@@ -159,10 +176,47 @@ def main() -> int:
     elif schedule.startswith("@"):
         with open(schedule[1:]) as fh:
             schedule = fh.read()
-    elif schedule not in SCHEDULES:
+    elif schedule not in (WIRE_SCHEDULES if args.wire else SCHEDULES):
         print(f"unknown schedule {schedule!r}; use --list, "
               f"--schedule-file PATH, or @file.json", file=sys.stderr)
         return 2
+
+    if args.wire:
+        from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+        try:
+            result = run_wire_soak(
+                args.seed, schedule, n_nodes=args.nodes,
+                tenants=args.wire_tenants,
+                produce_every=args.wire_produce_every,
+                commitless_limit=args.commitless_limit,
+                artifact_path=args.artifact)
+        except ValueError as e:
+            print(f"invalid schedule: {e}", file=sys.stderr)
+            return 2
+        if args.events:
+            with open(args.events, "w") as fh:
+                fh.write(result["event_log"])
+        if args.journals:
+            with open(args.journals, "w") as fh:
+                json.dump(result["journals"], fh, indent=1)
+        if args.coverage_out:
+            with open(args.coverage_out, "w") as fh:
+                json.dump(result["coverage"], fh, indent=1)
+        if args.dump_schedule:
+            with open(args.dump_schedule, "w") as fh:
+                fh.write(result["schedule_json"])
+        summary = {k: result[k] for k in
+                   ("schedule", "seed", "nodes", "ticks", "offered",
+                    "produced", "consumed", "driver", "nemesis_skipped",
+                    "max_commitless_window", "commitless_limit",
+                    "invariants", "violation", "artifact",
+                    "coverage_signature")}
+        summary["wire"] = True
+        summary["fate_log"] = result["fate_log"]
+        summary["coverage_classes"] = result["coverage"]["class_counts"]
+        print(json.dumps(summary))
+        return 0 if result["invariants"] == "ok" else 1
 
     workload = None
     if args.workload_tenants:

@@ -101,6 +101,40 @@ chaos_smoke_device_route() {
         --groups 4 --workload-tenants 4 --workload-load 2
 }
 
+wire_chaos_smoke() {
+    # Wire-plane chaos end to end: a 3-node cluster over REAL sockets
+    # under the wire-leader-partition schedule (a raft leader partition
+    # STACKED with connection resets, torn frames, and an accept-refuse
+    # window). Zero invariant violations required (acked-produce
+    # durability + consumer-group reconvergence after heal), client
+    # retries must stay bounded, and two same-seed runs must produce
+    # cmp-byte-identical wire event logs — the wire twin of the
+    # chaos-determinism contract.
+    echo "== wire chaos smoke =="
+    rm -f /tmp/ci_wire_a.jsonl /tmp/ci_wire_b.jsonl
+    python tools/chaos_soak.py --wire --seed 7 \
+        --schedule wire-leader-partition --nodes 3 \
+        --events /tmp/ci_wire_a.jsonl > /tmp/ci_wire_a.json
+    python tools/chaos_soak.py --wire --seed 7 \
+        --schedule wire-leader-partition --nodes 3 \
+        --events /tmp/ci_wire_b.jsonl > /tmp/ci_wire_b.json
+    cmp /tmp/ci_wire_a.jsonl /tmp/ci_wire_b.jsonl
+    python - <<'PYEOF'
+import json
+s = json.load(open("/tmp/ci_wire_a.json"))
+assert s["invariants"] == "ok", s["violation"]
+d = s["driver"]
+assert d["produced"] > 0 and d["produced"] == s["consumed"], s
+assert d["retries"] <= 40 * max(1, d["produced"]), d  # bounded, not runaway
+fates = {k for v in s["fate_log"].values() for k in v}
+assert "conn_reset" in fates and "torn_write" in fates, fates
+assert s["coverage_classes"].get("wkgram", 0) > 0, s["coverage_classes"]
+print("wire chaos ok:", d["produced"], "produced/", s["consumed"],
+      "consumed,", d["retries"], "retries,", d["reconnects"],
+      "reconnects, fates", sorted(fates))
+PYEOF
+}
+
 chaos_search_smoke() {
     # Coverage-guided chaos search (chaos/search.py): a few seeded
     # iterations from the COMMITTED corpus (tests/fixtures/chaos_corpus)
@@ -228,6 +262,7 @@ if [[ "${1:-}" == "quick" ]]; then
     chaos_smoke
     chaos_smoke_device_route
     chaos_search_smoke
+    wire_chaos_smoke
     traffic_smoke
     obs_smoke
     perf_smoke
@@ -266,12 +301,14 @@ else
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_flight.py tests/test_flight_merge.py \
         tests/test_coverage.py tests/test_chaos_search.py \
+        tests/test_wire_chaos.py \
         tests/test_reset_safety.py tests/test_graftlint.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route
     chaos_search_smoke
     chaos_search_repros
+    wire_chaos_smoke
     traffic_smoke
     traffic_chaos_smoke
     obs_smoke
