@@ -588,10 +588,42 @@ async def main():
                       f, indent=1)
         return
     out_path = "BENCH_engine_kernel.json" if args.kernel else "BENCH_engine.json"
-    # A CPU run writes a suffixed artifact so it can never clobber
-    # device-measured rows — UNLESS the main artifact's rows are themselves
-    # CPU-measured (device matches), in which case updating it in place is
-    # the honest refresh (the merge below only keeps same-device rows).
+    for r in results:
+        r["backend"] = _BACKEND
+    merge_engine_rows(results, device, out_path, name)
+
+
+def _row_key(r):
+    # Legacy rows lacking the newer keys are single-tick, non-pipelined,
+    # 256-proposal, dense-scheduler, unsharded measurements — normalize so
+    # a rerun replaces them instead of leaving a stale twin row beside the
+    # fresh one.
+    # active_frac must sort against legacy rows' None — normalize to a
+    # float sentinel so mixed keys stay orderable; device_route
+    # normalizes the same way (missing on legacy rows -> False), and
+    # mesh_devices (bench_podsim's sharded engine rows) to 0.
+    frac = r.get("active_frac")
+    return (r["P"], r.get("window") or 1, bool(r.get("pipeline")),
+            r.get("proposals_per_tick", 256),
+            bool(r.get("active_set")),
+            -1.0 if frac is None else float(frac),
+            bool(r.get("device_route")),
+            bool(r.get("payload_ring")),
+            bool(r.get("flight_wire")),
+            int(r.get("mesh_devices") or 0))
+
+
+def merge_engine_rows(results, device, out_path="BENCH_engine.json",
+                      name="engine_host_bridge"):
+    """Merge measured rows into the committed artifact by the full axis
+    key (shared with bench_podsim's sharded engine rows so both benches
+    land in one table without clobbering each other). A CPU run writes a
+    suffixed artifact so it can never clobber device-measured rows —
+    UNLESS the main artifact's rows are themselves CPU-measured (device
+    matches), in which case updating it in place is the honest refresh
+    (the merge only keeps same-device rows)."""
+    import jax
+
     if jax.default_backend() == "cpu":
         try:
             with open(out_path) as f:
@@ -600,32 +632,7 @@ async def main():
             main_dev = None
         if main_dev != device:
             out_path = out_path.replace(".json", "_cpu.json")
-    # Merge by (P, window, pipeline, offered load) with any existing
-    # same-device results so a partial-size rerun never silently drops rows
-    # the README cites, and window-1/window-K/pipelined rows of the same
-    # size coexist (they are different measurements, not reruns of each
-    # other).
-    for r in results:
-        r["backend"] = _BACKEND
-
-    def _key(r):
-        # Legacy rows lacking the newer keys are single-tick, non-pipelined,
-        # 256-proposal, dense-scheduler measurements — normalize so a rerun
-        # replaces them instead of leaving a stale twin row beside the
-        # fresh one.
-        # active_frac must sort against legacy rows' None — normalize to a
-        # float sentinel so mixed keys stay orderable; device_route
-        # normalizes the same way (missing on legacy rows -> False).
-        frac = r.get("active_frac")
-        return (r["P"], r.get("window") or 1, bool(r.get("pipeline")),
-                r.get("proposals_per_tick", 256),
-                bool(r.get("active_set")),
-                -1.0 if frac is None else float(frac),
-                bool(r.get("device_route")),
-                bool(r.get("payload_ring")),
-                bool(r.get("flight_wire")))
-
-    merged = {_key(r): r for r in results}
+    merged = {_row_key(r): r for r in results}
     try:
         with open(out_path) as f:
             prev = json.load(f)
@@ -633,7 +640,7 @@ async def main():
             # Same-device rows only (older files carried device per row).
             if prev.get("device", r.get("device")) == device and "P" in r:
                 r.setdefault("window", 1)  # stamp legacy rows: see merge key
-                merged.setdefault(_key(r), r)
+                merged.setdefault(_row_key(r), r)
     except (OSError, ValueError, AttributeError, KeyError, TypeError):
         pass
     keys = sorted(merged)

@@ -1,13 +1,25 @@
 #!/usr/bin/env python
-"""Pod-scale sharded simulation: the BASELINE row-5 stand-in.
+"""Pod-scale sharded runs: the BASELINE row-5 config, simulated AND measured.
 
 BASELINE.md config 5 calls for "1M partitions across v5e-64, psum vote
 aggregation over ICI". Real multi-chip hardware is not reachable from this
-environment (one tunneled chip), so this bench runs the SAME sharded
-program — ``parallel/sharded.py``'s shard_map'd cluster step, 'p'-axis data
-parallelism, per-tick all_to_all delivery when the node axis is split — on a
-virtual CPU device mesh, exactly as the driver's ``dryrun_multichip`` does,
-and scales it to the full 1M-partition shape.
+environment (one tunneled chip), so both modes run on a virtual CPU device
+mesh, exactly as the driver's ``dryrun_multichip`` does:
+
+* **simulation mode** (default): ``parallel/sharded.py``'s shard_map'd
+  cluster step — the fully device-resident N-node cluster, 'p'-axis data
+  parallelism, per-tick all_to_all delivery when the node axis is split.
+* **engine mode** (``--engine``, PR 14): the PRODUCT path measured — a
+  ``RaftEngine(mesh=..., active_set=True)`` serving seeded Zipfian
+  multi-tenant workload traffic (mostly-idle tenants), with the
+  shard-local compacted scheduler doing the work that makes a million
+  LIVE groups affordable: only woken rows step, quiescent rows ride the
+  sharded ``decay_idle`` closed form, per-shard wake fractions land on
+  the ``raft_active_wake_fraction{shard=}`` gauges, and ``--device-route``
+  /``--payload-ring`` join a multi-engine cluster to the shard-local
+  RouteFabric. Engine rows merge into MULTICHIP_podsim.json (keyed on
+  the grown axis set) AND into BENCH_engine.json via bench_engine's
+  shared merge (``mesh_devices`` axis).
 
 Output: one weak-scaling row per device count (P/device held constant, so
 the top row IS the 1M-partition config on 8 devices), with per-shard memory
@@ -17,6 +29,11 @@ the sharded program at scale, NOT interconnect performance (all_to_all over
 virtual devices is a memcpy, and all 8 "devices" share this box's single
 core, so expect wall time to grow ~linearly with total P instead of staying
 flat — on real chips each shard would step its 131k groups in parallel).
+The engine rows' honest caveat is the same, with one addition: the
+mostly-idle steady state steps only ~wake-fraction x P rows, so the CPU
+box CAN measure the 1M-row config directly — that is the point of the
+active-set plane (the one-time cold-start election settle still runs
+dense and dominates each row's wall clock; it is reported separately).
 
 Memory wall math (why 1M is nowhere near the limit): one 5-node group costs
 ~760 B of state + ~900 B of in-flight inbox = ~1.7 KB; 1M groups ~1.7 GB,
@@ -25,12 +42,16 @@ VERDICT asked to budget are the 400 B/group match/nxt share of that.
 
 Usage: python bench_podsim.py [--per-device 131072] [--devices 1,2,4,8]
                               [--ticks 10] [--warmup 15]
+       python bench_podsim.py --engine [--cluster 1] [--tenants 1000]
+                              [--skew 1.2] [--offered 2048] [--hb-ticks 256]
+                              [--window 8] [--device-route] [--payload-ring]
 Writes MULTICHIP_podsim.json and prints one JSON line per row.
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
 import time
@@ -117,41 +138,267 @@ def bench_row(per_device: int, devices: int, ticks: int, warmup: int,
     }
 
 
+async def bench_engine_row(per_device: int, devices: int, ticks: int, warmup: int,
+                     cluster: int = 1, tenants: int = 1000, skew: float = 1.2,
+                     offered: int = 2048, hb_ticks: int = 256,
+                     window: int = 8, device_route: bool = False,
+                     payload_ring: bool = False, seed: int = 0) -> dict:
+    """One MEASURED engine-path row: a ``cluster``-engine RaftEngine
+    cluster at P = per_device * devices groups on a 'p' mesh, active-set
+    scheduling on, serving seeded Zipfian tenant traffic. The scaled
+    config staggers heartbeats very wide (``hb_ticks``; the aggregate
+    keepalive carries liveness, same argument as bench_engine's 16) so
+    the steady-state wake floor is ~P/hb_ticks rows, not P."""
+    from jax.sharding import Mesh
+
+    from josefine_tpu.raft.engine import RaftEngine
+    from josefine_tpu.utils.kv import MemKV
+    from josefine_tpu.utils.metrics import REGISTRY
+    from josefine_tpu.workload.model import WorkloadSpec
+    from josefine_tpu.workload.schedule import ArrivalSchedule
+
+    P = per_device * devices
+    mesh = Mesh(np.array(jax.devices()[:devices]), ("p",))
+    params = step_params(timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
+    spec = WorkloadSpec.from_axes(tenants, P, skew, float(offered))
+    sched = ArrivalSchedule(spec, seed)
+    # Topic-partition -> group row: topics own contiguous row runs in
+    # name order (the same mapping workload/driver.py's scale path uses).
+    topic_row = {name: i * spec.partitions_per_topic
+                 for i, name in enumerate(sched.model.topic_names)}
+
+    class _Fsm:
+        __slots__ = ()
+
+        def transition(self, data):
+            return b""
+
+    fsm = _Fsm()
+    ids_ = list(range(cluster))
+    t0 = time.perf_counter()
+    engines = [RaftEngine(MemKV(), ids_, i, groups=P, params=params,
+                          fsms={g: fsm for g in range(P)}, base_seed=i,
+                          active_set=True, mesh=mesh)
+               for i in ids_]
+    fabric = None
+    if device_route:
+        from josefine_tpu.raft.route import RouteFabric
+
+        fabric = RouteFabric(payload_ring=payload_ring)
+        for e in engines:
+            fabric.register(e)
+    init_s = time.perf_counter() - t0
+
+    committed = 0
+    executed = [0] * cluster
+
+    def _retrieve(fut):
+        fut.cancelled() or fut.exception()
+
+    async def one_tick(arrivals):
+        nonlocal committed
+        outs = []
+        for i, e in enumerate(engines):
+            w = e.suggest_window(window)
+            res = e.tick(w)
+            executed[i] += w
+            committed += len(res.committed)
+            outs.extend(res.outbound)
+        for m in outs:
+            engines[m.dst].receive(m)
+        if fabric is not None:
+            fabric.flush()
+        for arr in arrivals:
+            g = topic_row[arr.topic] + arr.partition
+            for e in engines:
+                if e.is_leader(g):
+                    e.propose(g, b"podsim").add_done_callback(_retrieve)
+                    break
+        # One loop turn so commit-resolved futures run their callbacks.
+        await asyncio.sleep(0)
+
+    # Cold-start settle: every group elects once. These ticks run DENSE
+    # (leaderless rows are always awake — the predicate's conservative
+    # half), which is the honest one-time cost of bringing P rows live;
+    # it is reported separately from the steady-state measurement.
+    t0 = time.perf_counter()
+    settle = 0
+    while settle < 40 * max(1, cluster):
+        await one_tick(())
+        settle += 1
+        if sum(int((e._h_role == LEADER).sum()) for e in engines) == P:
+            break
+    settle_s = time.perf_counter() - t0
+    leaders = sum(int((e._h_role == LEADER).sum()) for e in engines)
+
+    tick_no = 0
+    for _ in range(warmup):  # compile the bucket-ladder shapes under load
+        await one_tick(sched.produce_arrivals(tick_no))
+        tick_no += 1
+
+    committed = 0
+    executed = [0] * cluster
+    wake_rows = n_scheds = 0
+    shard_wake = np.zeros(devices, np.int64)
+    buckets: set[int] = set()
+    for e in engines:
+        e.active_sched_ticks = e.active_sched_rows = 0
+        e.active_fallback_ticks = 0
+        e.routed_msgs = 0
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        await one_tick(sched.produce_arrivals(tick_no))
+        tick_no += 1
+        for e in engines:
+            wake_rows += e._last_wake_rows
+            if e._last_wake_shard is not None:
+                shard_wake += np.asarray(e._last_wake_shard, np.int64)
+                n_scheds += 1
+            buckets.add(int(e._last_bucket_k))
+    dt = time.perf_counter() - t0
+    dev_ticks = min(executed) if min(executed) else ticks
+
+    # Per-shard wake fractions: the schedule's own split, averaged over
+    # the timed loop — and the SAME numbers the
+    # raft_active_wake_fraction{shard=} gauges publish at scrape time
+    # (one scrape here proves the exposition path at this scale).
+    shard_rows = P // devices
+    shard_frac = [round(float(c) / max(1, n_scheds * shard_rows), 6)
+                  for c in shard_wake]
+    prom = REGISTRY.render_prometheus()
+    gauge_ok = ("raft_active_wake_fraction" in prom and 'shard="0"' in prom)
+
+    row = {
+        "devices": devices,
+        "P": P,
+        "per_device": per_device,
+        "engine": True,
+        "mesh_devices": devices,
+        "cluster_nodes": cluster,
+        "active_set": True,
+        "device_route": device_route,
+        "payload_ring": payload_ring,
+        "window": window,
+        "pipeline": False,
+        "proposals_per_tick": offered,
+        "hb_ticks": hb_ticks,
+        "workload": {"tenants": tenants, "skew": skew,
+                     "offered_per_tick": offered, "seed": seed},
+        "init_s": round(init_s, 2),
+        "settle_ticks": settle,
+        "settle_s": round(settle_s, 2),
+        "leaders_after_settle": leaders,
+        "ticks": dev_ticks,
+        "dispatch_rounds": ticks,
+        "ticks_per_sec": round(dev_ticks / dt, 3),
+        "ms_per_tick": round(1000 * dt / dev_ticks, 2),
+        "committed_group_advances": committed,
+        "avg_wake_rows": round(wake_rows / max(1, n_scheds), 1),
+        "avg_wake_frac": round(wake_rows / max(1, n_scheds) / P, 6),
+        "shard_wake_frac": shard_frac,
+        "wake_frac_gauge_exposed": gauge_ok,
+        "bucket_levels": sorted(buckets),
+        "sched_ticks": sum(e.active_sched_ticks for e in engines),
+        "fallback_ticks": sum(e.active_fallback_ticks for e in engines),
+    }
+    if device_route:
+        row["routed_msgs"] = sum(e.routed_msgs for e in engines)
+        if fabric is not None and fabric.rings:
+            row["ring"] = fabric.ring_stats()
+    return row
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--per-device", type=int, default=131072)
     ap.add_argument("--devices", default="1,2,4,8")
     ap.add_argument("--ticks", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=15)
+    ap.add_argument("--engine", action="store_true",
+                    help="measure the PRODUCT engine path (active-set + "
+                         "sharded scheduler under Zipfian tenant traffic) "
+                         "instead of the device-resident simulation")
+    ap.add_argument("--cluster", type=int, default=1,
+                    help="engine mode: engines in the co-located cluster "
+                         "(1 = single-member groups, the megascale shape; "
+                         "3 + --device-route measures the routed plane)")
+    ap.add_argument("--tenants", type=int, default=1000)
+    ap.add_argument("--skew", type=float, default=1.2)
+    ap.add_argument("--offered", type=int, default=2048,
+                    help="engine mode: offered produce batches per tick "
+                         "across the whole tenant universe (mostly-idle "
+                         "means offered << P)")
+    ap.add_argument("--hb-ticks", type=int, default=256)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--device-route", action="store_true")
+    ap.add_argument("--payload-ring", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write rows to this path verbatim (no artifact "
+                         "merge; CI smoke uses a tmp path)")
     args = ap.parse_args()
 
+    rows = []
     for d in (int(x) for x in args.devices.split(",")):
-        r = bench_row(args.per_device, d, args.ticks, args.warmup)
+        if args.engine:
+            r = asyncio.run(bench_engine_row(
+                args.per_device, d, args.ticks, args.warmup,
+                                 cluster=args.cluster, tenants=args.tenants,
+                                 skew=args.skew, offered=args.offered,
+                                 hb_ticks=args.hb_ticks, window=args.window,
+                device_route=args.device_route,
+                payload_ring=args.payload_ring))
+        else:
+            r = bench_row(args.per_device, d, args.ticks, args.warmup)
         print(json.dumps(r), flush=True)
-        # Persist after EVERY row, merging with existing rows by
-        # (devices, per_device): rows take tens of minutes each on this
-        # box, and a deadline/crash mid-table must not discard the
-        # measured ones (it did, once — the run_guarded re-exec restarted
-        # a 3-row table from scratch).
+        rows.append(r)
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump({"bench": "pod_podsim", "results": rows}, f,
+                          indent=1)
+            continue
+        # Persist after EVERY row, merging with existing rows by the axis
+        # key: rows take tens of minutes each on this box, and a
+        # deadline/crash mid-table must not discard the measured ones (it
+        # did, once — the run_guarded re-exec restarted a 3-row table
+        # from scratch).
         _write_artifact([r])
+        if args.engine:
+            # The measured sharded-engine row also lands in the engine
+            # bench table (shared axis key; mesh_devices tells the rows
+            # apart from the unsharded bench_engine ones).
+            from bench_engine import merge_engine_rows
+
+            merge_engine_rows([r], str(jax.devices()[0]))
+
+
+def _artifact_key(r):
+    # Legacy (pre-engine-mode) rows normalize to the simulation axis
+    # values, so re-measuring either mode replaces its own row and never
+    # the other's. active_set/device_route are the PR-14 axis growth.
+    return (r["devices"], r["per_device"], bool(r.get("engine")),
+            bool(r.get("active_set")), bool(r.get("device_route")),
+            bool(r.get("payload_ring")), int(r.get("cluster_nodes") or 0))
 
 
 def _write_artifact(rows):
-    merged = {(r["devices"], r["per_device"]): r for r in rows}
+    merged = {_artifact_key(r): r for r in rows}
     try:
         with open("MULTICHIP_podsim.json") as f:
             prev = json.load(f)
         for r in prev.get("results", []):
-            merged.setdefault((r["devices"], r["per_device"]), r)
+            merged.setdefault(_artifact_key(r), r)
     except (OSError, ValueError, KeyError, TypeError):
         pass
     allrows = [merged[k] for k in sorted(merged)]
     out = {
-        "bench": "pod_sharded_simulation",
+        "bench": "pod_sharded_podsim",
         "backend": "cpu-virtual-mesh (8 devices on 1 physical core; "
                    "validates the sharded program + memory layout, not "
                    "interconnect perf)",
-        "sharding": "shard_map over ('p','n') mesh, p-axis data parallel",
+        "sharding": "shard_map over ('p','n') mesh, p-axis data parallel; "
+                    "engine:true rows are MEASURED product-path runs "
+                    "(RaftEngine mesh + shard-local active set under "
+                    "Zipfian tenant traffic), not simulations",
         "weak_scaling_note": "P/device held constant per row; on shared-"
                              "core virtual devices wall time grows with "
                              "total P (no parallel hardware underneath). "

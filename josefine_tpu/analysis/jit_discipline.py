@@ -36,8 +36,11 @@ Rules:
   scanned modules) whose shape-feeding argument is a raw computation
   (``len(...)``, arithmetic, an un-provenanced local) instead of a value
   routed through an approved bucket helper (``active_bucket`` /
-  ``route_bucket`` / ``ring_bucket``), a constant, an attribute (engine dims are fixed at
-  init), or a plain parameter (validated at ITS call site).
+  ``route_bucket`` / ``ring_bucket`` / the sharded engine path's
+  ``shard_bucket`` / ``split_shard_rows``, tuple unpacks included), a
+  constant, an attribute (engine dims are fixed at init; ``ShardPlan.k``
+  is ladder-derived), a bool-valued comparison (two programs max), or a
+  plain parameter (validated at ITS call site).
 """
 
 from __future__ import annotations
@@ -61,7 +64,8 @@ _TRACE_WRAPPERS = {
 _CACHE_DECORATORS = {"functools.lru_cache", "functools.cache",
                      "lru_cache", "cache"}
 
-_BUCKET_HELPERS = {"active_bucket", "route_bucket", "ring_bucket"}
+_BUCKET_HELPERS = {"active_bucket", "route_bucket", "ring_bucket",
+                   "shard_bucket", "split_shard_rows"}
 
 # numpy attributes that are plain objects (dtypes/constants), not host ops.
 _NP_BENIGN = {
@@ -311,6 +315,11 @@ class JitDisciplineChecker(Checker):
             return self._approved_arg(arg.operand, approved_names)
         if isinstance(arg, ast.Starred):
             return True  # *args forwarding — validated where built
+        if isinstance(arg, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq))
+                for op in arg.ops):
+            return True  # bool-valued flag (e.g. `plane is None`): two
+            # programs max, the routed/new-plane axis — not a shape
         return False
 
     def _check_builder_call_sites(self, module: Module, idx: _ModuleIndex,
@@ -331,6 +340,15 @@ class JitDisciplineChecker(Checker):
                         and isinstance(node.targets[0], ast.Name):
                     if self._approved_arg(node.value, approved):
                         approved.add(node.targets[0].id)
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Tuple) \
+                        and self._approved_arg(node.value, approved):
+                    # Tuple unpack of an approved call — e.g.
+                    # `B, lids, shard, pos = split_shard_rows(...)`: every
+                    # unpacked name carries the ladder's provenance.
+                    for elt in node.targets[0].elts:
+                        if isinstance(elt, ast.Name):
+                            approved.add(elt.id)
                 for child in ast.iter_child_nodes(node):
                     collect(child)
 

@@ -479,8 +479,39 @@ def wire_leader_partition(n_nodes: int = 3) -> Schedule:
                     heal_ticks=60)
 
 
+def wire_reconnect_loss(n_nodes: int = 3) -> Schedule:
+    """Reconnect-window block-batch loss — the schedule class that hunts
+    the neighborhood of the windowed nack-repair wedge (found by the
+    wire-plane PR, fixed engine-side in ``packed_step._merge_outbox``;
+    pinned by tests/test_raft_server.py::
+    test_windowed_nack_repair_over_sockets). Short REPEATED raft-plane
+    cuts mean block-bearing AE batches are repeatedly minted into a
+    transport dial's reconnect window and lost to the newest-wins
+    mailbox, so the NACK -> rewind -> re-send repair must run again and
+    again under window folding; client-plane resets compose so the
+    Kafka socket layer reconnects through the same storm. The scored
+    axis is liveness: commits must resume inside the probe window after
+    every heal (pre-fix, this class starves commits forever)."""
+    steps = []
+    # Five cut/heal rounds at a cadence near the fold window: each heal
+    # is a fresh dial whose reconnect backoff swallows the next block
+    # batches, re-arming the loss the NACK path must repair.
+    for i in range(5):
+        at = 14 + 16 * i
+        steps.append(Step(at=at, op="isolate",
+                          args={"target": "leader", "for": 7}))
+        if i % 2:
+            steps.append(Step(at=at + 4, op="conn_reset",
+                              args={"role": "client", "p": 1.0, "for": 3}))
+    steps.append(Step(at=100, op="torn_frames",
+                      args={"role": "any", "p": 0.4, "for": 15}))
+    return Schedule("wire-reconnect-loss", steps, horizon=140,
+                    heal_ticks=60)
+
+
 WIRE_SCHEDULES = {
     "wire-storm": wire_storm,
     "wire-stall": wire_stall,
     "wire-leader-partition": wire_leader_partition,
+    "wire-reconnect-loss": wire_reconnect_loss,
 }

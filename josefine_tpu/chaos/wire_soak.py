@@ -160,11 +160,14 @@ class WireCluster:
     async def start(self) -> None:
         for n in self.nodes:
             await n.start()
-        # Full-mesh gate before any tick is granted: consensus traffic
-        # minted while a startup dial is still in its reconnect backoff is
-        # lost to the newest-wins transport mailbox (and a lost FIRST
-        # block replication can wedge behind the pre-existing windowed
-        # nack-repair liveness bug — ROADMAP open items).
+        # Full-mesh gate before any tick is granted. NOT a correctness
+        # crutch (the windowed nack-repair wedge is fixed — a lost first
+        # block replication repairs through the NACK path, pinned by
+        # tests/test_raft_server.py): it exists so the soak's reported
+        # fault history is a pure function of the schedule + seed. Startup
+        # dials race the wall clock, and traffic lost to a dial still in
+        # its reconnect backoff would vary run to run, breaking the
+        # byte-identical event-log contract the wire smoke cmp's.
         if len(self.nodes) > 1:
             deadline = asyncio.get_event_loop().time() + 10.0
             ids = {n.config.raft.id for n in self.nodes}

@@ -1,25 +1,61 @@
-"""shard_map'd cluster step over a ('p', 'n') mesh.
+"""shard_map'd device programs over the partition mesh.
 
-Sharding layout:
+Two families live here:
 
-* ``'p'`` — partition axis: P independent Raft groups, no cross-shard
-  communication at all (pure data parallelism over consensus groups).
-* ``'n'`` — node axis: the N members of each group are split across chips.
-  Per-tick message delivery (``inbox[p, dst, src] = outbox[p, src, dst]``)
-  then requires moving each node's outgoing messages to the chip hosting the
-  destination node: exactly one ``lax.all_to_all`` over ``'n'`` per tick,
-  riding ICI. Vote tallies and quorum commit stay *local* to the chip that
-  hosts the candidate/leader (votes/acks were already delivered to it), so
-  no further collective is needed — the all_to_all is the entire
-  communication footprint of consensus.
+1. **The cluster-step simulation** (:func:`make_sharded_cluster_step`,
+   BASELINE config 5 / bench_podsim): the fully device-resident cluster
+   over a ('p', 'n') mesh —
 
-Parity note: this replaces the reference's cluster transport
-(``src/raft/tcp.rs`` JSON-over-TCP full mesh) for device-resident groups;
-host-side TCP remains for the Kafka surface and block payload transport
-(``josefine_tpu.raft.tcp``).
+   * ``'p'`` — partition axis: P independent Raft groups, no cross-shard
+     communication at all (pure data parallelism over consensus groups).
+   * ``'n'`` — node axis: the N members of each group are split across
+     chips. Per-tick message delivery (``inbox[p, dst, src] =
+     outbox[p, src, dst]``) then requires moving each node's outgoing
+     messages to the chip hosting the destination node: exactly one
+     ``lax.all_to_all`` over ``'n'`` per tick, riding ICI. Vote tallies
+     and quorum commit stay *local* to the chip that hosts the
+     candidate/leader (votes/acks were already delivered to it), so no
+     further collective is needed.
+
+2. **The sharded ENGINE path** (everything below ``shard_bucket``): the
+   product engine's active-set scheduling and device routing made
+   shard-local, so ``RaftEngine(mesh=...)`` accepts ``active_set=True``
+   and a RouteFabric (ARCHITECTURE.md "Sharded active-set & routing").
+   The mesh here is the engine's 1-axis ``('p',)`` mesh — the node axis
+   stays host-local (the other members of each group are OTHER engines,
+   reached over the wire or the fabric). Per tick, each 'p' shard owns:
+
+   * its slice of the host wake predicate (the engine's mirrors are
+     host-global; :class:`ShardPlan` splits the scheduled set per shard),
+   * its own power-of-EIGHT bucket ladder (:func:`shard_bucket`, clamped
+     to the SHARD-LOCAL row count — compiled shapes are bounded by
+     ~log8(P/S) levels per window length, independent of shard count),
+   * its gather → window-step → ``decay_idle`` → scatter-back pipeline
+     (:func:`make_sharded_active_window` — the same kernels as the
+     unsharded compact path, run per shard inside ``shard_map``),
+   * its route/ring scatter into CO-SHARDED inbox planes and payload
+     rings (:func:`make_sharded_route_scatter` /
+     :func:`make_sharded_ring_scatter`): a routed row's source group and
+     destination plane row are the SAME group id, so the scatter never
+     crosses shards by construction.
+
+   The ONLY cross-shard traffic is aggregate telemetry — the cluster
+   wake-row total rides a ``lax.psum`` over ``'p'`` (one int32 per shard
+   per tick) appended to the compact fetch. Vote tallies and quorum
+   commit are per-group math over the LOCAL node axis, so consensus
+   itself needs no collective at all; that psum lane is the entire ICI
+   footprint of a sharded compacted tick, and the contract every future
+   cross-shard aggregate must follow.
+
+Parity note: the cluster-step family replaces the reference's cluster
+transport (``src/raft/tcp.rs`` JSON-over-TCP full mesh) for
+device-resident groups; host-side TCP remains for the Kafka surface and
+block payload transport (``josefine_tpu.raft.tcp``).
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -29,6 +65,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from josefine_tpu.models import chained_raft as cr
 from josefine_tpu.models.types import Msgs, NodeState, StepParams
+from josefine_tpu.ops import ids
 
 # shard_map stabilized as jax.shard_map (replication-check kwarg renamed
 # check_rep -> check_vma); older jax in this image only has the
@@ -140,3 +177,314 @@ def make_sharded_cluster_step(mesh: Mesh, N: int):
         **{_CHECK_KW: False},
     )
     return jax.jit(stepped, donate_argnums=(2, 3))
+
+
+# --------------------------------------------------------------------------
+# Sharded ENGINE path (see module docstring §2): shard-local active-set
+# stepping and route/ring scatters for RaftEngine(mesh=...). All builders
+# are lru_cached on (mesh, static shape ints) — jax.sharding.Mesh is
+# hashable — so compiled program count is bounded by the bucket ladders,
+# exactly like the unsharded packed_step caches.
+
+
+def mesh_shards(mesh: Mesh) -> int:
+    """Partition-shard count of an engine mesh: the size of the 'p' axis
+    (shard_map splits over 'p' alone and replicates any other axis —
+    counting total devices on a multi-axis mesh would mis-bin the
+    per-shard local ids). Falls back to the device count for meshes
+    without a 'p' axis (the cluster-step simulation's factorizations)."""
+    if "p" in mesh.shape:
+        return int(mesh.shape["p"])
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def shard_bucket(n: int, L: int) -> int:
+    """Per-shard compact bucket: powers of EIGHT from a floor of 64,
+    clamped to the SHARD-LOCAL row count ``L = P / shards``. The ladder is
+    deliberately coarser than the unsharded active path's power-of-two
+    (``packed_step.active_bucket``): every level is a full XLA compile of
+    the S-way shard_map program, and the padding rows cost one dropped
+    store each — so compiled shapes stay bounded at ~log8(L) levels per
+    window length, independent of shard count."""
+    b = 64
+    while b < n:
+        b *= 8
+    return min(b, L) if L >= 64 else L
+
+
+def _engine_state_spec() -> NodeState:
+    """Engine-layout NodeState specs: every leaf shards its leading (P)
+    axis over 'p'; the node axis (votes/match/nxt) stays whole — the
+    other members of each group live on other HOSTS, not other shards."""
+    one, two = P("p"), P("p", None)
+    return NodeState(
+        term=one, voted_for=one, role=one, leader=one,
+        head=ids.Bid(t=one, s=one), commit=ids.Bid(t=one, s=one),
+        elapsed=one, timeout=one, hb_elapsed=one, alive=one, seed=one,
+        votes=two, match=ids.Bid(t=two, s=two), nxt=ids.Bid(t=two, s=two),
+    )
+
+
+_PARAMS_SPEC = StepParams(timeout_min=P(), timeout_max=P(), hb_ticks=P(),
+                          auto_proposals=P(), prevote=P())
+
+
+def place_engine_state(tree, mesh: Mesh):
+    """device_put an engine-layout pytree with its 'p'-sharded specs (the
+    leading axis of every leaf is the partition axis)."""
+    def spec(a):
+        return P("p", *([None] * (a.ndim - 1)))
+
+    return jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, spec(a))), tree)
+
+
+class ShardPlan:
+    """Partition one tick's scheduled active set across the 'p' shards.
+
+    ``G`` is the scheduler's sorted global row-id vector; with
+    ``L = P / S`` rows per shard, shard ``s`` owns ``G`` entries in
+    ``[s*L, (s+1)*L)`` — a contiguous run, because ``G`` is sorted. The
+    plan materializes the per-shard LOCAL index bucket (``idx``,
+    ``(S, k)`` padded with ``L`` — dropped by the scatter), the uniform
+    bucket ``k`` (:func:`shard_bucket` of the largest shard's count:
+    shard_map shapes must be uniform across shards), and the scatter
+    coordinates that remap a compact host-built inbox into the
+    shard-major layout."""
+
+    def __init__(self, G: np.ndarray, P_total: int, S: int):
+        L = P_total // S
+        self.S, self.L = S, L
+        self.A = len(G)
+        # One layout implementation: the plan IS split_shard_rows over a
+        # sorted id vector (the stable argsort there is a no-op then),
+        # plus the per-shard counts the gather reassembly needs.
+        self.k, self.idx, self.shard, self.pos = split_shard_rows(G, S, L)
+        self.counts = np.bincount(self.shard, minlength=S).astype(np.int64)
+
+    def scatter_vals(self, vals: np.ndarray) -> np.ndarray:
+        """(10, A, N) compact host inbox (rows in G order) -> the
+        (S, 10, k, N) shard-major bucket the shard_map step consumes."""
+        rows, _, N = vals.shape[0], vals.shape[1], vals.shape[2]
+        out = np.zeros((self.S, rows, self.k, N), np.int32)
+        if self.A:
+            out[self.shard, :, self.pos, :] = \
+                vals[:, :self.A, :].transpose(1, 0, 2)
+        return out
+
+    def gather_flat(self, flat_np: np.ndarray, N: int):
+        """Per-shard flat fetches -> the compact (13, A) mirror and
+        (9, A, N) outbox in G order, plus the psum'd cluster wake total
+        (identical on every shard — the ICI aggregate lane)."""
+        k = self.k
+        cut = 13 * k
+        sv_parts, ov_parts = [], []
+        for s in range(self.S):
+            A_s = int(self.counts[s])
+            if not A_s:
+                continue
+            row = flat_np[s]
+            sv_parts.append(row[:cut].reshape(13, k)[:, :A_s])
+            ov_parts.append(
+                row[cut:cut + 9 * k * N].reshape(9, k, N)[:, :A_s, :])
+        if sv_parts:
+            sv13 = np.concatenate(sv_parts, axis=1).astype(np.int64)
+            ov = np.concatenate(ov_parts, axis=1)
+        else:
+            sv13 = np.zeros((13, 0), np.int64)
+            ov = np.zeros((9, 0, N), np.int32)
+        total = int(flat_np[0, -1]) if len(flat_np) else 0
+        return sv13, ov, total
+
+    def split_rows(self, gids: np.ndarray):
+        return split_shard_rows(gids, self.S, self.L)
+
+
+def split_shard_rows(gids: np.ndarray, S: int, L: int, cap: int | None = None):
+    """Per-shard padded LOCAL id layout for route/ring scatters: returns
+    ``(B, (S, B) local ids padded L, shard, pos)`` for an arbitrary
+    (unsorted is fine) global id vector. ``B`` is the per-shard
+    :func:`shard_bucket` of the fullest shard (``cap`` overrides the
+    clamp bound — the payload ring's slot count multiplies it)."""
+    gids = np.asarray(gids, np.int64)
+    shard = gids // L
+    counts = np.bincount(shard, minlength=S)
+    B = shard_bucket(int(counts.max()) if len(gids) else 0,
+                     L if cap is None else cap)
+    order = np.argsort(shard, kind="stable")
+    starts = np.zeros(S, np.int64)
+    starts[1:] = np.cumsum(counts)[:-1]
+    pos = np.empty(len(gids), np.int64)
+    pos[order] = np.arange(len(gids)) - starts[shard[order]]
+    lids = np.full((S, B), L, np.int32)
+    if len(gids):
+        lids[shard, pos] = (gids % L).astype(np.int32)
+    return B, lids, shard, pos
+
+
+def _shard_map_1p(fn, mesh, in_specs, out_specs):
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: False})
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_active_window(mesh: Mesh, k: int, ticks: int, N: int,
+                               routed: bool):
+    """The shard-local compacted window step: per 'p' shard, gather the
+    scheduled local rows into the ``k`` bucket, run the SAME tick-1 +
+    quiet-ticks window pipeline as the unsharded compact path, advance
+    every quiescent local row through ``decay_idle``, and scatter the
+    stepped rows back — one fused program, no cross-shard data motion.
+    The flat output appends ONE psum lane: the cluster-total scheduled
+    row count aggregated over 'p' (the wake-fraction telemetry's ICI
+    contract; identical on every shard by construction).
+
+    Signature: ``fn(params, member, me, state, vals, pf, idx[, plane])``
+    with host-global shapes ``member (P, N)``, ``vals (S, 10, k, N)``,
+    ``idx (S, k)`` (local ids, pad = L), ``plane (9, P, N)``; returns
+    ``(new_state, flat (S, 13k + 9kN + 1))``."""
+    from josefine_tpu.raft.packed_step import (
+        _active_outputs,
+        _gather_routed,
+        _merge_routed,
+        _msgs_from_packed,
+        _scan_quiet_ticks,
+        _vstep_nodes,
+    )
+
+    state_spec = _engine_state_spec()
+    member_spec = P("p", None)
+    sk = P("p", None)           # (S, k) -> (1, k) per shard
+    vals_spec = P("p", None, None, None)
+    plane_spec = P(None, "p", None)
+
+    def local(params, member_l, me, state_l, vals_l, pf, idx_l, plane_l):
+        L = member_l.shape[0]
+        idx1 = idx_l[0]                       # (k,) local ids, pad = L
+        cidx = jnp.minimum(idx1, L - 1)       # clamp pads for the gather
+        state_c = jax.tree.map(lambda a: a[cidx], state_l)
+        member_c = member_l[cidx]
+        in10 = vals_l[0]                      # (10, k, N)
+        if routed:
+            # Compact the shard's routed plane slice onto the bucket rows
+            # (pads mask to zero — _gather_routed reads L as its bound).
+            in10 = _merge_routed(
+                jnp, in10, _gather_routed(jnp, plane_l, idx1))
+        inbox = _msgs_from_packed(in10)
+        props = in10[9, :, 0]
+        st, out, met = _vstep_nodes(params, member_c, me, state_c, inbox,
+                                    props, pf)
+        st, out, met = _scan_quiet_ticks(params, member_c, me, st, out, met,
+                                         inbox, props, pf, ticks)
+        # Quiescent-row decay fused with the active scatter-back, exactly
+        # like the unsharded _decay_scatter_fn — shard-local rows only.
+        full = cr.decay_idle(params, state_l, pf, ticks)
+        full = jax.tree.map(
+            lambda f, r: f.at[idx1].set(r, mode="drop"), full, st)
+        flat = _active_outputs(jnp, st, out, met)
+        # The one ICI collective of a sharded compacted tick: cluster
+        # wake-row total via psum over 'p' (telemetry aggregate).
+        total = jax.lax.psum(jnp.sum(idx1 < L).astype(jnp.int32), "p")
+        return full, jnp.concatenate([flat, total[None]])[None, :]
+
+    in_specs = [_PARAMS_SPEC, member_spec, P(), state_spec, vals_spec,
+                P(), sk]
+    if routed:
+        in_specs.append(plane_spec)
+
+        def wrapped(params, member, me, state, vals, pf, idx, plane):
+            return local(params, member, me, state, vals, pf, idx, plane)
+    else:
+
+        def wrapped(params, member, me, state, vals, pf, idx):
+            return local(params, member, me, state, vals, pf, idx, None)
+
+    stepped = _shard_map_1p(wrapped, mesh, tuple(in_specs),
+                            (state_spec, P("p", None)))
+    return jax.jit(stepped, donate_argnums=(3,))
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_decay_only(mesh: Mesh, ticks: int):
+    """All-quiescent sharded tick: decay IS the whole device step, run
+    shard-local (the sharded twin of packed_step._decay_only_fn)."""
+    state_spec = _engine_state_spec()
+
+    def local(params, state_l, pf):
+        return cr.decay_idle(params, state_l, pf, ticks)
+
+    stepped = _shard_map_1p(local, mesh, (_PARAMS_SPEC, state_spec, P()),
+                            state_spec)
+    return jax.jit(stepped, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_route_scatter(mesh: Mesh, B: int, P_total: int, N: int,
+                               new_plane: bool):
+    """Shard-local routed-row scatter into a CO-SHARDED staged inbox
+    plane. Mesh fabrics always push host-built value columns (the
+    engine's tick_finish fetched the compact outbox anyway, and a 36-byte
+    row beats resharding a device-resident source): ``vals (S, 9, B)``,
+    ``lids (S, B)`` local group ids padded ``L`` (dropped), ``me`` the
+    sender's inbox column. A routed row's source group and its plane row
+    are the same group id, so the scatter is shard-local by
+    construction."""
+    plane_spec = P(None, "p", None)
+    vsp = P("p", None, None)
+    lsp = P("p", None)
+    L = P_total // mesh_shards(mesh)
+
+    if new_plane:
+        def local(vals_l, lids_l, me):
+            plane = jnp.zeros((9, L, N), _I32)
+            return plane.at[:, lids_l[0], me].set(vals_l[0], mode="drop")
+
+        return jax.jit(_shard_map_1p(local, mesh, (vsp, lsp, P()),
+                                     plane_spec))
+
+    def local(plane_l, vals_l, lids_l, me):
+        return plane_l.at[:, lids_l[0], me].set(vals_l[0], mode="drop")
+
+    return jax.jit(_shard_map_1p(local, mesh, (plane_spec, vsp, lsp, P()),
+                                 plane_spec), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_ring_scatter(mesh: Mesh, B: int):
+    """Shard-local payload-ring stage scatter: ``buf (P, S_slots, W)``
+    co-sharded over 'p', ``lgids (S, B)`` local group ids padded ``L``
+    (dropped), ``slots (S, B)``, ``words (S, B, W)``."""
+    bsp = P("p", None, None)
+
+    def local(buf_l, lgids_l, slots_l, words_l):
+        return buf_l.at[lgids_l[0], slots_l[0]].set(words_l[0], mode="drop")
+
+    return jax.jit(
+        _shard_map_1p(local, mesh,
+                      (bsp, P("p", None), P("p", None), bsp), bsp),
+        donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def make_sharded_ring_gather(mesh: Mesh, B: int):
+    """Shard-local payload-ring gather: returns the (S, B, W) per-shard
+    slot reads (pads clamp; the host picks real rows by (shard, pos))."""
+    bsp = P("p", None, None)
+
+    def local(buf_l, lgids_l, slots_l):
+        L = buf_l.shape[0]
+        return buf_l[jnp.minimum(lgids_l, L - 1), slots_l]
+
+    return jax.jit(
+        _shard_map_1p(local, mesh, (bsp, P("p", None), P("p", None)), bsp))
+
+
+@jax.jit
+def purge_plane_row_masked(plane, g, keep_mask):
+    """Mesh twin of packed_step._purge_plane_row_fn: zero group ``g``'s
+    routed slots where ``keep_mask`` (N,) is False, as a pure elementwise
+    select over an iota — no dynamic-index scatter, so GSPMD keeps the
+    plane 'p'-sharded with zero cross-shard traffic."""
+    gi = jax.lax.broadcasted_iota(jnp.int32, plane.shape, 1)
+    sel = (gi == g) & ~keep_mask[None, None, :]
+    return jnp.where(sel, jnp.zeros_like(plane), plane)

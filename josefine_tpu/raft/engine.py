@@ -399,6 +399,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # variant keeps using parallel/sharded.py's all_to_all for the
         # fully device-resident simulation).
         self._mesh = mesh
+        self._shards = 1
+        self._shard_rows = self.P
         if mesh is not None:
             if backend != "jax":
                 raise ValueError("mesh sharding requires the jax backend")
@@ -406,6 +408,17 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             if self.P % shards:
                 raise ValueError(
                     f"groups={self.P} not divisible by mesh devices {shards}")
+            if active_set and "p" not in mesh.shape:
+                raise ValueError(
+                    "active_set on a sharded engine needs a 'p' mesh axis "
+                    "(the shard-local compact step is a shard_map over 'p')")
+            # The partition axis is 'p' ALONE: shard_map splits over 'p'
+            # and replicates any other mesh axis, so the plan/telemetry
+            # split must count 'p' shards, not total devices — counting
+            # devices on a multi-axis mesh would mis-bin the per-shard
+            # local ids (mesh_shards() in parallel/sharded.py agrees).
+            self._shards = int(mesh.shape.get("p", shards))
+            self._shard_rows = self.P // self._shards
             from jax.sharding import NamedSharding, PartitionSpec
 
             def _spec(a):
@@ -414,6 +427,10 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             self.state = jax.tree.map(
                 lambda a: jax.device_put(a, NamedSharding(mesh, _spec(a))),
                 self.state)
+            # Member rides co-sharded with the state rows: the shard-local
+            # compact step gathers it per shard, and an unsharded copy
+            # would reshard on every dispatch.
+            self.member = self._place_member(self.member)
         # Host mirrors (numpy) for fast per-tick diffing. head/commit mirror
         # the packed chain ids so tick() can select active groups with one
         # vectorized compare instead of an O(P) Python scan.
@@ -457,12 +474,15 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # kernel. Off by default (the dense/sparse step over all P rows);
         # bit-exactness between the two is pinned by
         # tests/test_active_set.py.
-        if active_set and mesh is not None:
-            # Gather/scatter by arbitrary row ids across a sharded P axis
-            # would turn the pure data-parallel step into all-to-all
-            # traffic; the sharded engine keeps the dense schedule.
-            raise ValueError("active_set requires an unsharded engine (mesh=None)")
+        # Sharded engines run the active-set path SHARD-LOCAL (PR 14,
+        # parallel/sharded.py): each 'p' shard gathers its own scheduled
+        # rows by LOCAL index, steps them through the same window kernel,
+        # and decays/scatters its own block inside shard_map — never a
+        # cross-shard gather. Only the wake-row total crosses ICI (psum).
         self._active_set = bool(active_set)
+        # Per-shard wake counts of the last schedule (mesh engines only):
+        # backs the raft_active_wake_fraction{shard=} gauges.
+        self._last_wake_shard: np.ndarray | None = None
         # Auto-fallback: when the scheduler wakes more than this fraction
         # of rows, compaction overhead exceeds the dense step's — run the
         # plain dense/sparse dispatch for the tick (timer mirrors refetch
@@ -662,6 +682,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         if self._active_set:
             _m_wake_frac.set(
                 round(self._last_wake_rows / max(1, self.P), 6), node=node)
+            if self._mesh is not None and self._last_wake_shard is not None:
+                # Per-shard wake fractions (the sharded scheduler's skew
+                # view): shard s woke counts[s] of its P/shards rows.
+                for s, c in enumerate(self._last_wake_shard):
+                    _m_wake_frac.set(
+                        round(int(c) / max(1, self._shard_rows), 6),
+                        node=node, shard=s)
             _m_bucket.set(self._last_bucket_k, node=node)
             _m_sched_ticks.set(self.active_sched_ticks, node=node)
             _m_fallback_ticks.set(self.active_fallback_ticks, node=node)
@@ -1010,15 +1037,24 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             wake[gp] = True
         G = np.nonzero(wake)[0]
         self._last_wake_rows = len(G)  # scrape-time wake-fraction gauge
+        if self._mesh is not None:
+            # Per-shard wake split (telemetry; the plan recomputes its own
+            # counts from the same G).
+            self._last_wake_shard = np.bincount(
+                G // self._shard_rows, minlength=self._shards)
         if len(G) > self.active_fallback_frac * self.P:
             return None
         return G
 
     def _step_active(self, G: np.ndarray, k: int, vals: np.ndarray,
-                     pf: np.ndarray, window: int, prof):
+                     pf: np.ndarray, window: int, prof, plan=None):
         """Gather the active rows into the bucket, run the compact window
         step, and scatter back fused with the quiescent decay kernel.
-        Returns (new full state, flat output or None, upload/fetch bytes)."""
+        Returns (new full state, flat output or None, upload/fetch bytes).
+        ``plan`` is the mesh engine's :class:`ShardPlan` (None unsharded):
+        gather/step/decay/scatter run SHARD-LOCAL inside one fused
+        shard_map program — the compact/scatter phases fold into
+        "dispatch" there, and the fetch grows one psum telemetry lane."""
         A = len(G)
         if A == 0:
             # All-quiescent tick: decay IS the device step; nothing to
@@ -1029,10 +1065,31 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     new_state = cr.decay_idle(
                         self.params, jax.tree.map(np.array, self.state),
                         pf, window, xp=np)
+                elif self._mesh is not None:
+                    from josefine_tpu.parallel.sharded import (
+                        make_sharded_decay_only)
+                    new_state = make_sharded_decay_only(self._mesh, window)(
+                        self.params, self.state, jnp.asarray(pf))
                 else:
                     new_state = _decay_only_fn(window)(
                         self.params, self.state, jnp.asarray(pf))
             return new_state, None, 0, 0
+        if plan is not None:
+            from josefine_tpu.parallel.sharded import (
+                make_sharded_active_window)
+            rp = self._routed_plane
+            vals_sh = plan.scatter_vals(vals)
+            with prof.phase("dispatch"):
+                fn = make_sharded_active_window(
+                    self._mesh, plan.k, window, self.N, rp is not None)
+                args = (self.params, self.member, self._me_dev, self.state,
+                        jnp.asarray(vals_sh), jnp.asarray(pf),
+                        jnp.asarray(plan.idx))
+                new_state, flat = fn(*args, rp) if rp is not None \
+                    else fn(*args)
+            return (new_state, flat,
+                    int(plan.idx.nbytes + vals_sh.nbytes),
+                    int(np.prod(flat.shape)) * 4)
         idx = np.full(k, self.P, np.int32)
         idx[:A] = G
         rp = self._routed_plane
@@ -1202,7 +1259,19 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 self._sched_mode = mode
         if G is not None:
             A = len(G)
-            k = active_bucket(A, self.P)
+            plan = None
+            if self._mesh is not None:
+                # Shard-local schedule: split G per 'p' shard, with the
+                # per-shard power-of-8 bucket ladder setting the compiled
+                # shape. The host inbox is built compactly in G order and
+                # remapped shard-major by the plan.
+                from josefine_tpu.parallel.sharded import ShardPlan
+                plan = ShardPlan(G, self.P, self._shards)
+                k = plan.k
+                build_k = max(A, 1)
+            else:
+                k = active_bucket(A, self.P)
+                build_k = k
             self._last_bucket_k = k
             with prof.phase("inbox"):
                 # Compact-domain inbox: rows line up with the gathered
@@ -1210,13 +1279,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 # Proposal staging happens inside the builder, as in the
                 # sparse branch.
                 (vals, staged,
-                 deferred, deferred_b) = self._build_inbox_active(G, k)
+                 deferred, deferred_b) = self._build_inbox_active(G, build_k)
             new_state, flat, upload, fetchb = self._step_active(
-                G, k, vals, pf, window, prof)
+                G, k, vals, pf, window, prof, plan)
             with prof.phase("decay"):
                 self._decay_mirrors(G, window, pf)
             h = {"mode": "active", "flat": flat, "G": G, "k": k,
-                 "staged": staged, "window": window,
+                 "plan": plan, "staged": staged, "window": window,
                  "upload_bytes": upload, "fetch_bytes": fetchb}
             self._sched_pending.append(G)
         elif self._sparse:
@@ -1441,7 +1510,12 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             # needs no appended extras, unlike the sparse path).
             proc = h["G"].astype(np.int64, copy=False)
             A = len(proc)
-            if A:
+            if A and h.get("plan") is not None:
+                # Sharded compact fetch: per-shard (13k + 9kN + psum-lane)
+                # rows reassembled into G order (shard-major == sorted).
+                sv13, ov_c, _wake_total = h["plan"].gather_flat(
+                    h["flat_np"], self.N)
+            elif A:
                 flat = h["flat_np"]
                 cut = _MIRROR13_ROWS * h["k"]
                 sv13 = (flat[:cut].reshape(_MIRROR13_ROWS, h["k"])

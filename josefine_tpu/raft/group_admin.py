@@ -76,7 +76,23 @@ class GroupAdmin:
         for g in self._group_claims:
             m[g] = self._claim_row(g, active)
         self._mask_np = m
-        return jnp.asarray(m)
+        return self._place_member(m)
+
+    def _place_member(self, m):
+        """Device-place a (P, N) membership mask. Mesh engines co-shard it
+        with the state rows (PartitionSpec('p', None)) — a bare
+        jnp.asarray here would hand the next dispatch an unsharded leaf
+        and force a full (P, N) reshard on EVERY subsequent tick (the
+        exact cost engine init's placement exists to avoid; claim changes
+        on the Kafka surface hit this path per EnsurePartition)."""
+        mesh = getattr(self, "_mesh", None)
+        if mesh is None:
+            return jnp.asarray(m)
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            np.asarray(m), NamedSharding(mesh, PartitionSpec("p", None)))
 
     def set_group_members(self, g: int, slots) -> None:
         """Claim (or idle, with an empty set) a data group's member columns.
@@ -87,9 +103,10 @@ class GroupAdmin:
             self._group_claims.pop(g, None)
         else:
             self._group_claims[g] = frozenset(int(s) for s in slots)
-        # Incremental: rewrite only row g of the host mask, re-upload.
+        # Incremental: rewrite only row g of the host mask, re-upload
+        # (co-sharded on mesh engines — see _place_member).
         self._mask_np[g] = self._claim_row(g, self._active_vec())
-        self.member = jnp.asarray(self._mask_np)
+        self.member = self._place_member(self._mask_np)
         # A claim change moves quorum/membership for the row — wake it so
         # the full kernel (not the decay closed form) sees the new mask.
         # (Dense engines never drain _force_active, so only track it when

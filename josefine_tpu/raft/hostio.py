@@ -21,6 +21,7 @@ per-message serialization in ``src/raft/leader.rs:124-174``.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -538,5 +539,13 @@ class HostIO:
         ns = np.array(self.state.nxt.s)
         nt[fx[:, 0], fx[:, 1]] = fx[:, 2] >> 32
         ns[fx[:, 0], fx[:, 1]] = fx[:, 2] & 0xFFFFFFFF
-        self.state = self.state.replace(
-            nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))
+        if getattr(self, "_mesh", None) is not None:
+            # Re-place co-sharded: a bare jnp.asarray would hand the next
+            # shard_map dispatch an unsharded leaf and force a reshard.
+            from jax.sharding import NamedSharding, PartitionSpec
+            s = NamedSharding(self._mesh, PartitionSpec("p", None))
+            self.state = self.state.replace(
+                nxt=ids.Bid(jax.device_put(nt, s), jax.device_put(ns, s)))
+        else:
+            self.state = self.state.replace(
+                nxt=ids.Bid(jnp.asarray(nt), jnp.asarray(ns)))

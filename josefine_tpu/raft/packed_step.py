@@ -21,8 +21,9 @@ bit-exact — tests/test_window.py, tests/test_differential.py):
 Multi-tick windows (``ticks > 1``) fold consecutive ticks into one
 dispatch: the uploaded inbox applies at tick 1, ticks 2..K run with an
 empty inbox, and the outbox is merged LAST-WRITER-WINS per (group, dst)
-slot with REPLIES frozen (see :func:`_merge_outbox` for why that is both
-safe and, for K <= hb_ticks, lossless). The single-tick step is DEFINED as
+slot with REPLIES and SPAN-CARRYING AEs frozen (see :func:`_merge_outbox`
+for why that is both safe and, for K <= hb_ticks, lossless). The
+single-tick step is DEFINED as
 the window of length 1, so there is exactly one implementation per backend.
 
 This module replaces the reference's per-role step functions
@@ -363,7 +364,8 @@ def _purge_plane_row_fn(plane, g, keep_mask):
 
 def _merge_outbox(xp, acc, out):
     """Overlay ``out`` on ``acc``, except that a slot already holding a
-    REPLY is frozen for the rest of the window.
+    REPLY or a SPAN-CARRYING AppendEntries is frozen for the rest of the
+    window.
 
     Replies outrank later broadcasts — the same priority rule node_step
     applies within one tick (its pre-vote broadcast defers to pending
@@ -374,11 +376,26 @@ def _merge_outbox(xp, acc, out):
     at window=4, timeout 3-8). A reply slot can't collide with a second
     reply: replies are only generated at tick 1 (the only tick with an
     inbox), so freezing it loses at most a heartbeat, which the aggregate
-    keepalive already covers."""
+    keepalive already covers.
+
+    Span AEs (x != y — a catch-up or fresh-mint replication frame) freeze
+    for the same reason, against the leader's OWN later heartbeat. A NACK
+    processed at tick 1 re-roots ``nxt`` and emits the repair span that
+    same tick; when the heartbeat cadence then fires at tick 2..K of the
+    SAME window, the last-writer empty AE (x == y == head) used to erase
+    the repair — and since both the NACK round trip and the heartbeat
+    phase repeat with the window, the span was erased EVERY round: the
+    windowed nack-repair liveness wedge (ROADMAP open item; leader
+    heartbeats forever, followers NACK forever, commit stalls). Span AEs
+    are only generated at tick 1 (mint and NACK re-roots both apply at
+    the inbox tick; the optimistic nxt advance stops repeats), so a
+    frozen span slot loses at most that same heartbeat. Pinned by
+    tests/test_raft_server.py::test_windowed_nack_repair_over_sockets."""
     resp = ((acc.kind == rpc.MSG_VOTE_RESP)
             | (acc.kind == rpc.MSG_PREVOTE_RESP)
             | (acc.kind == rpc.MSG_APPEND_RESP))
-    sel = (out.kind != rpc.MSG_NONE) & ~resp
+    span_ae = (acc.kind == rpc.MSG_APPEND) & ~ids.eq(acc.x, acc.y)
+    sel = (out.kind != rpc.MSG_NONE) & ~resp & ~span_ae
     return jax.tree.map(lambda n, o: xp.where(sel, n, o), out, acc)
 
 

@@ -78,13 +78,18 @@ class PayloadRing:
     (see module docstring)."""
 
     def __init__(self, P: int, slots: int = 8, slot_bytes: int = 512,
-                 backend: str = "jax"):
+                 backend: str = "jax", mesh=None):
         if slots < 1:
             raise ValueError("payload ring needs >= 1 slot per group")
         self.P = int(P)
         self.S = int(slots)
         self.W = max(1, (int(slot_bytes) + 3) // 4)
         self.backend = backend
+        # Sharded fabric (PR 14): the (P, S, W) buffer co-shards its group
+        # axis over the engines' 'p' mesh, and scatter/gather go through
+        # the shard-local programs (a block's ring row IS its group row,
+        # so residency never crosses a shard).
+        self.mesh = mesh
         # (P, S, W) int32 device buffer (numpy for the scalar twin),
         # allocated on first stage so a ring-enabled but idle fabric costs
         # nothing.
@@ -150,10 +155,38 @@ class PayloadRing:
             return
         if self.buf is None:
             zeros = np.zeros((self.P, self.S, self.W), np.int32)
-            self.buf = zeros if self.backend == "python" else jnp.asarray(zeros)
+            if self.backend == "python":
+                self.buf = zeros
+            elif self.mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                self.buf = jax.device_put(
+                    zeros, NamedSharding(self.mesh,
+                                         PartitionSpec("p", None, None)))
+            else:
+                self.buf = jnp.asarray(zeros)
         if self.backend == "python":
             for g, slot, words in self._pend:
                 self.buf[g, slot] = words
+        elif self.mesh is not None:
+            # Shard-local stage scatter: same last-writer dedup as the
+            # unsharded path, laid out per shard on the power-of-8 ladder.
+            from josefine_tpu.parallel.sharded import (
+                make_sharded_ring_scatter, mesh_shards, split_shard_rows)
+            final = {(g, slot): w for g, slot, w in self._pend}
+            Sh = mesh_shards(self.mesh)
+            L = self.P // Sh
+            gids = np.fromiter((g for g, _ in final), np.int64, len(final))
+            B, lgids, shard, pos = split_shard_rows(gids, Sh, L,
+                                                    cap=L * self.S)
+            slots = np.zeros((Sh, B), np.int32)
+            words = np.zeros((Sh, B, self.W), np.int32)
+            for i, ((g, slot), w) in enumerate(final.items()):
+                slots[shard[i], pos[i]] = slot
+                words[shard[i], pos[i]] = w
+            self.buf = make_sharded_ring_scatter(self.mesh, B)(
+                self.buf, jnp.asarray(lgids), jnp.asarray(slots),
+                jnp.asarray(words))
         else:
             # Last-writer-wins per (group, slot): a busy group can cycle
             # one slot several times between barriers (FIFO overwrite at
@@ -226,6 +259,22 @@ class PayloadRing:
         n = len(needs)
         if self.backend == "python":
             rows = [np.asarray(self.buf[g, e.slot]) for g, e in needs]
+        elif self.mesh is not None:
+            # Shard-local gather: per-shard slot reads come back (S, B, W)
+            # and the host picks each entry by its (shard, pos) coords.
+            from josefine_tpu.parallel.sharded import (
+                make_sharded_ring_gather, mesh_shards, split_shard_rows)
+            Sh = mesh_shards(self.mesh)
+            L = self.P // Sh
+            gids = np.fromiter((g for g, _ in needs), np.int64, n)
+            B, lgids, shard, pos = split_shard_rows(gids, Sh, L,
+                                                    cap=L * self.S)
+            slots = np.zeros((Sh, B), np.int32)
+            for i, (_, e) in enumerate(needs):
+                slots[shard[i], pos[i]] = e.slot
+            fetched = np.asarray(make_sharded_ring_gather(self.mesh, B)(
+                self.buf, jnp.asarray(lgids), jnp.asarray(slots)))
+            rows = fetched[shard, pos]
         else:
             B = ring_bucket(n, self.P * self.S)
             gids = np.full(B, self.P, np.int32)

@@ -53,9 +53,19 @@ is never inverted.
 
 The fabric is host-driver infrastructure, not wire transport: engines
 reached over TCP are simply never registered and keep the host path.
-Sharded (mesh) engines are rejected — scatter by arbitrary row ids across
-a sharded P axis is all-to-all traffic, the same reason active_set rejects
-the mesh.
+
+Sharded (mesh) engines route SHARD-LOCALLY (PR 14): every registered
+engine must share one 'p' mesh, the staged inbox planes and payload rings
+are co-sharded with the engines' state, and each push scatters through
+``parallel.sharded``'s per-shard programs — a routed row's source group
+and its destination plane row are the same group id, so nothing ever
+crosses a shard. Mesh pushes always take the host-vals form (``_push_vals``
+— tick_finish fetched the compact outbox anyway, and a 36-byte column
+upload beats resharding a device-resident source buffer across the
+scatter); everything else, decision table included, is identical to the
+unsharded fabric, and the twin differential in
+tests/test_sharded_active.py pins the combined plane byte-identical to
+host delivery.
 """
 
 from __future__ import annotations
@@ -138,6 +148,10 @@ class RouteFabric:
         self.P: int | None = None
         self.N: int | None = None
         self.backend: str | None = None
+        # The registered engines' shared 'p' mesh (None = unsharded
+        # fabric): planes and rings co-shard with the engine state, and
+        # pushes go through the shard-local scatter programs.
+        self.mesh = None
         # Per-receiver staged (accumulating this round) and ready
         # (consumable at the next tick_begin) planes, plus the host-side
         # kind mirrors that back occupancy checks, wake scheduling,
@@ -163,17 +177,27 @@ class RouteFabric:
     def register(self, engine) -> None:
         """Join an engine to the fabric (idempotent per slot; re-register
         on restart — staged traffic for the dead incarnation is dropped,
-        matching the loss of its in-process pending queues)."""
-        if engine._mesh is not None:
+        matching the loss of its in-process pending queues). Sharded
+        engines are welcome — they must all share ONE 'p' mesh, and the
+        fabric's planes/rings co-shard with their state (see module
+        docstring)."""
+        if engine._mesh is not None and "p" not in engine._mesh.shape:
+            # Validate BEFORE adopting any engine attribute: a rejected
+            # first registration must not poison the fabric's shape/mesh
+            # for a later valid one.
             raise ValueError(
-                "RouteFabric requires an unsharded engine (mesh=None): "
-                "routing scatters by arbitrary row ids, which is "
-                "all-to-all across a sharded P axis")
+                "RouteFabric on a sharded engine needs a 'p' mesh axis")
         if self.P is None:
             self.P, self.N = engine.P, engine.N
             self.backend = engine._backend
-        elif (engine.P, engine.N, engine._backend) != (self.P, self.N,
-                                                       self.backend):
+            self.mesh = engine._mesh
+        elif engine._mesh is not self.mesh and engine._mesh != self.mesh:
+            raise ValueError(
+                "fabric mesh mismatch: every registered engine must share "
+                "the fabric's mesh (mixing sharded and unsharded engines "
+                "would scatter across incompatible plane layouts)")
+        if (engine.P, engine.N, engine._backend) != (self.P, self.N,
+                                                     self.backend):
             raise ValueError(
                 f"fabric shape mismatch: engine (P={engine.P}, N={engine.N}, "
                 f"backend={engine._backend!r}) vs fabric (P={self.P}, "
@@ -193,9 +217,10 @@ class RouteFabric:
         if self.payload_ring:
             # Fresh ring per registration: a restarted engine's resident
             # payloads died with the process (same rule as the planes).
+            # Sharded fabrics co-shard the ring buffer with the plane.
             self.rings[slot] = PayloadRing(
                 self.P, slots=self.ring_slots, slot_bytes=self.ring_bytes,
-                backend=self.backend)
+                backend=self.backend, mesh=self.mesh)
         self._refresh_trace()
 
     def _refresh_trace(self) -> None:
@@ -333,15 +358,10 @@ class RouteFabric:
             rs = np.nonzero(col)[0]
             if not len(rs) and not capped:
                 continue
-            if src_ov is None and len(rs):
+            if src_ov is None and len(rs) and self.mesh is None:
                 src_ov = self._src_ov(h)
             if len(rs):
                 routed[rs, d] = True
-                # Source row indexing: the active-compact outbox is
-                # indexed by bucket position (rs); dense and sparse
-                # sources are the dense (9, P, N) device outbox, indexed
-                # by group id.
-                srows = rs if h["mode"] == "active" else gids[rs]
                 terms_col = ov[1][rs, d]
                 if engine._flight_wire:
                     # Wire trace: routed msg_sent, off the routed rows the
@@ -350,8 +370,23 @@ class RouteFabric:
                     engine.flight.emit_many(
                         engine._flight_tick(), "msg_sent", gids[rs],
                         terms_col, kind[rs, d], engine.me, d, "routed")
-                self._push(engine, d, src_ov, srows, gids[rs],
-                           kind[rs, d], terms_col, d)
+                if self.mesh is not None:
+                    # Sharded fabric: push the host-fetched value columns
+                    # through the shard-local scatter (see module
+                    # docstring — resharding a device source buffer would
+                    # cost more than the 36-byte rows).
+                    self._push_vals(
+                        engine, d,
+                        np.stack([ov[i][rs, d] for i in range(9)]
+                                 ).astype(np.int32), gids[rs])
+                else:
+                    # Source row indexing: the active-compact outbox is
+                    # indexed by bucket position (rs); dense and sparse
+                    # sources are the dense (9, P, N) device outbox,
+                    # indexed by group id.
+                    srows = rs if h["mode"] == "active" else gids[rs]
+                    self._push(engine, d, src_ov, srows, gids[rs],
+                               kind[rs, d], terms_col, d)
             if capped:
                 crs = np.asarray([r for r, _ in capped], np.intp)
                 routed[crs, d] = True
@@ -457,6 +492,21 @@ class RouteFabric:
             if plane is None:
                 plane = np.zeros((9, self.P, self.N), np.int32)
             plane[:, gs, sender.me] = vals
+        elif self.mesh is not None:
+            # Shard-local scatter into the co-sharded plane: per-shard
+            # local ids (pad = rows-per-shard, dropped) and value columns,
+            # bucketed on the per-shard power-of-8 ladder.
+            from josefine_tpu.parallel.sharded import (
+                make_sharded_route_scatter, mesh_shards, split_shard_rows)
+            S = mesh_shards(self.mesh)
+            B, lids, shard, pos = split_shard_rows(gs, S, self.P // S)
+            vals_sh = np.zeros((S, 9, B), np.int32)
+            vals_sh[shard, :, pos] = vals.T
+            args = (jnp.asarray(vals_sh), jnp.asarray(lids),
+                    jnp.asarray(int(sender.me), jnp.int32))
+            fn = make_sharded_route_scatter(self.mesh, B, self.P, self.N,
+                                            plane is None)
+            plane = fn(*args) if plane is None else fn(plane, *args)
         else:
             B = route_bucket(len(gs), self.P)
             vals_b = np.zeros((9, B), np.int32)
@@ -610,6 +660,13 @@ class RouteFabric:
             plane = planes[slot]
             if self.backend == "python":
                 plane[:, g, sel] = 0
+            elif self.mesh is not None:
+                # Elementwise masked purge: keeps the plane 'p'-sharded
+                # (a dynamic-index scatter could make GSPMD gather it).
+                from josefine_tpu.parallel.sharded import (
+                    purge_plane_row_masked)
+                planes[slot] = purge_plane_row_masked(
+                    plane, jnp.asarray(g, jnp.int32), jnp.asarray(~sel))
             else:
                 planes[slot] = _purge_plane_row_fn(
                     plane, jnp.asarray(g, jnp.int32), jnp.asarray(~sel))

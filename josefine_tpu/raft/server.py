@@ -90,7 +90,7 @@ class JosefineRaft:
             max_nodes=config.max_nodes,
             backend=backend,
             max_append_entries=config.max_append_entries,
-            active_set=config.active_set and mesh is None,
+            active_set=config.active_set,
             mesh=mesh,
             flight_ring=getattr(config, "flight_ring", 4096),
             flight_wire=getattr(config, "flight_wire", False),

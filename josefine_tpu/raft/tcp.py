@@ -98,12 +98,14 @@ class Transport:
         self._server: asyncio.Server | None = None
         self._started = False
         self.dropped = 0  # drop-on-full counter (observability)
-        # Peers whose outbound connection is currently up. Lockstep
-        # harnesses gate their first tick grant on full-mesh connectivity:
+        # Peers whose outbound connection is currently up. Observability
+        # plus the wire soak's deterministic-reporting gate (an un-meshed
+        # run would mis-report startup dial races as invariant trips);
         # consensus traffic minted while a dial is still in its reconnect
-        # backoff is lost to the newest-wins mailbox, and a lost FIRST
-        # block replication can wedge behind the (known, pre-existing)
-        # windowed nack-repair liveness bug.
+        # backoff is lost to the newest-wins mailbox, and the protocol
+        # repairs that on its own — the NACK'd span survives the window
+        # outbox merge (packed_step._merge_outbox), so harnesses no longer
+        # gate first tick grants on full-mesh connectivity.
         self.connected: set[int] = set()
 
     async def start(self) -> tuple[str, int]:

@@ -72,6 +72,22 @@ class GroupRetry(Exception):
     """A consumer-group dance must restart from JoinGroup."""
 
 
+class _Retry(Exception):
+    """Signal from a :meth:`WireDriver._with_retries` body: the RESPONSE
+    said try again (retryable error code). ``reroute=True`` counts the
+    re-route; loops that route by leadership pair it with a quiet
+    metadata refresh via the wrapper's ``refresh`` flag."""
+
+    def __init__(self, reason: str, reroute: bool = False):
+        super().__init__(reason)
+        self.reroute = reroute
+
+
+class _Exhausted(RuntimeError):
+    """A retry loop ran out of attempts (produce give-up accounting needs
+    to tell this apart from a hard response error)."""
+
+
 class RequestClock:
     """Wall-clock time source for the driver: deadlines and backoff are
     tick-denominated (so the chaos soak can substitute a virtual clock),
@@ -190,19 +206,49 @@ class WireDriver:
             cl.send(api_key, api_version, body, timeout=600.0),
             deadline_ticks or self.request_ticks)
 
-    async def refresh_metadata(self) -> None:
+    def _bootstrap_addr(self, attempt: int) -> tuple[str, int]:
+        return self.bootstrap[attempt % len(self.bootstrap)]
+
+    async def _with_retries(self, attempts: int, addr_for, body, fail,
+                            refresh: bool = False):
+        """The ONE pick-addr/try/drop/backoff loop behind every request
+        kind (the wire-chaos PR shipped five copies of it; this is the
+        recorded-debt collapse — behavior pinned by the existing
+        retry/reroute tests).
+
+        ``addr_for(attempt)`` picks the target (bootstrap rotation, or
+        the current leader for leadership-routed kinds). ``body(cl,
+        attempt)`` runs the request against a live client and either
+        returns the final value, raises :class:`_Retry` (response-level
+        retryable: back off on the seeded stream, count a re-route when
+        flagged, go around), or raises to abort the loop. Connection
+        failures drop the client before backing off. ``refresh=True``
+        quietly refreshes metadata after every backoff (the
+        leadership-routed kinds re-route off the freshest view; a failed
+        refresh is survivable — the next attempt re-routes stale).
+        ``fail(last)`` builds the exhaustion exception."""
         last: Exception | None = None
-        for attempt in range(self.max_attempts):
-            addr = self.bootstrap[attempt % len(self.bootstrap)]
+        for attempt in range(attempts):
+            addr = addr_for(attempt)
             try:
                 cl = await self._client(addr)
-                md = await self._send(cl, ApiKey.METADATA, 1, {
-                    "topics": [{"name": n} for n in self.model.topic_names]})
+                return await body(cl, attempt)
+            except _Retry as e:
+                last = e
+                if e.reroute:
+                    self.n_reroutes += 1
             except _CONN_ERRORS as e:
                 last = e
                 await self._drop_client(addr)
-                await self._backoff(attempt)
-                continue
+            await self._backoff(attempt)
+            if refresh:
+                await self._refresh_quietly()
+        raise fail(last)
+
+    async def refresh_metadata(self) -> None:
+        async def body(cl, attempt):
+            md = await self._send(cl, ApiKey.METADATA, 1, {
+                "topics": [{"name": n} for n in self.model.topic_names]})
             brokers = {b["node_id"]: (b["host"], b["port"])
                        for b in md["brokers"]}
             for t in md["topics"]:
@@ -212,35 +258,32 @@ class WireDriver:
                     addr2 = brokers.get(p["leader_id"])
                     if addr2 is not None:
                         self._leaders[(t["name"], p["partition_index"])] = addr2
-            return
-        raise ConnectionError(f"metadata refresh failed: {last!r}")
+
+        await self._with_retries(
+            self.max_attempts, self._bootstrap_addr, body,
+            lambda last: ConnectionError(f"metadata refresh failed: {last!r}"))
 
     # ------------------------------------------------------------ setup
 
     async def create_topics(self, timeout: float = 30.0) -> None:
-        for attempt in range(self.max_attempts):
-            addr = self.bootstrap[attempt % len(self.bootstrap)]
-            try:
-                cl = await self._client(addr)
-                resp = await self._send(cl, ApiKey.CREATE_TOPICS, 1, {
-                    "topics": [{"name": name,
-                                "num_partitions": self.spec.partitions_per_topic,
-                                "replication_factor": self.replication,
-                                "assignments": [], "configs": []}
-                               for name in self.model.topic_names],
-                    "timeout_ms": int(timeout * 1000), "validate_only": False,
-                }, deadline_ticks=self.join_ticks)
-            except _CONN_ERRORS:
-                await self._drop_client(addr)
-                await self._backoff(attempt)
-                continue
+        async def body(cl, attempt):
+            resp = await self._send(cl, ApiKey.CREATE_TOPICS, 1, {
+                "topics": [{"name": name,
+                            "num_partitions": self.spec.partitions_per_topic,
+                            "replication_factor": self.replication,
+                            "assignments": [], "configs": []}
+                           for name in self.model.topic_names],
+                "timeout_ms": int(timeout * 1000), "validate_only": False,
+            }, deadline_ticks=self.join_ticks)
             for t in resp["topics"]:
                 if t["error_code"] not in (int(ErrorCode.NONE),
                                            int(ErrorCode.TOPIC_ALREADY_EXISTS)):
                     raise RuntimeError(f"create_topics failed: {t}")
             await self.refresh_metadata()
-            return
-        raise ConnectionError("create_topics never reached a broker")
+
+        await self._with_retries(
+            self.max_attempts, self._bootstrap_addr, body,
+            lambda last: ConnectionError("create_topics never reached a broker"))
 
     # ---------------------------------------------------------- produce
 
@@ -273,22 +316,14 @@ class WireDriver:
         payload = arr.payload(self.spec)
         batch = records.build_batch(payload, self.spec.records_per_batch)
         key = (arr.topic, arr.partition)
-        for attempt in range(max_attempts):
-            addr = self._leaders.get(key) \
-                or self.bootstrap[attempt % len(self.bootstrap)]
-            try:
-                cl = await self._client(addr)
-                resp = await self._send(cl, ApiKey.PRODUCE, 3, {
-                    "transactional_id": None, "acks": -1,
-                    "timeout_ms": 5000,
-                    "topics": [{"name": arr.topic, "partitions": [
-                        {"index": arr.partition, "records": batch}]}],
-                })
-            except _CONN_ERRORS:
-                await self._drop_client(addr)
-                await self._backoff(attempt)
-                await self._refresh_quietly()
-                continue
+
+        async def body(cl, attempt):
+            resp = await self._send(cl, ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1,
+                "timeout_ms": 5000,
+                "topics": [{"name": arr.topic, "partitions": [
+                    {"index": arr.partition, "records": batch}]}],
+            })
             p = resp["responses"][0]["partitions"][0]
             code = int(p["error_code"])
             if code == int(ErrorCode.NONE):
@@ -296,16 +331,22 @@ class WireDriver:
                 self.n_produced += 1
                 return True
             if code in _RETRYABLE:
-                self.n_reroutes += 1
-                await self._backoff(attempt)
-                await self._refresh_quietly()
-                continue
+                raise _Retry(f"produce {key}: code {code}", reroute=True)
             raise RuntimeError(f"produce to {key} failed with code {code}")
-        if raise_on_fail:
-            raise RuntimeError(f"produce to {key} never accepted "
-                               f"({max_attempts} attempts)")
-        self.n_gave_up += 1
-        return False
+
+        try:
+            return await self._with_retries(
+                max_attempts,
+                lambda a: self._leaders.get(key) or self._bootstrap_addr(a),
+                body,
+                lambda last: _Exhausted(f"produce to {key} never accepted "
+                                        f"({max_attempts} attempts)"),
+                refresh=True)
+        except _Exhausted:
+            if raise_on_fail:
+                raise
+            self.n_gave_up += 1
+            return False
 
     async def _refresh_quietly(self) -> None:
         """Metadata refresh that must not abort a retry loop: under chaos
@@ -319,20 +360,16 @@ class WireDriver:
     # ----------------------------------------------------------- consume
 
     async def _coordinator_addr(self, group_id: str) -> tuple[str, int]:
-        for attempt in range(self.max_attempts * 2):
-            addr = self.bootstrap[attempt % len(self.bootstrap)]
-            try:
-                cl = await self._client(addr)
-                resp = await self._send(cl, ApiKey.FIND_COORDINATOR, 1,
-                                        {"key": group_id, "key_type": 0})
-            except _CONN_ERRORS:
-                await self._drop_client(addr)
-                await self._backoff(attempt)
-                continue
+        async def body(cl, attempt):
+            resp = await self._send(cl, ApiKey.FIND_COORDINATOR, 1,
+                                    {"key": group_id, "key_type": 0})
             if resp["error_code"] == ErrorCode.NONE:
                 return (resp["host"], resp["port"])
-            await self._backoff(attempt)
-        raise RuntimeError(f"no coordinator for {group_id}")
+            raise _Retry(f"find-coordinator: {resp['error_code']}")
+
+        return await self._with_retries(
+            self.max_attempts * 2, self._bootstrap_addr, body,
+            lambda last: RuntimeError(f"no coordinator for {group_id}"))
 
     async def consume_verify_tenant(self, tenant: int,
                                     max_group_attempts: int = 8) -> int:
@@ -447,34 +484,29 @@ class WireDriver:
     async def _fetch_one(self, topic: str, p: int) -> dict:
         """Fetch a whole partition from offset 0 off its current leader,
         with reconnect + reroute on connection failure."""
-        for attempt in range(self.max_attempts):
-            addr = self._leaders.get((topic, p)) \
-                or self.bootstrap[attempt % len(self.bootstrap)]
-            try:
-                cl = await self._client(addr)
-                resp = await self._send(cl, ApiKey.FETCH, 4, {
-                    "replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
-                    "max_bytes": 1 << 22, "isolation_level": 0,
-                    "topics": [{"topic": topic, "partitions": [
-                        {"partition": p, "fetch_offset": 0,
-                         "partition_max_bytes": 1 << 22}]}],
-                })
-            except _CONN_ERRORS:
-                await self._drop_client(addr)
-                await self._backoff(attempt)
-                await self._refresh_quietly()
-                continue
+        async def body(cl, attempt):
+            resp = await self._send(cl, ApiKey.FETCH, 4, {
+                "replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+                "max_bytes": 1 << 22, "isolation_level": 0,
+                "topics": [{"topic": topic, "partitions": [
+                    {"partition": p, "fetch_offset": 0,
+                     "partition_max_bytes": 1 << 22}]}],
+            })
             pr = resp["responses"][0]["partitions"][0]
             if int(pr["error_code"]) in _RETRYABLE:
-                self.n_reroutes += 1
-                await self._backoff(attempt)
-                await self._refresh_quietly()
-                continue
+                raise _Retry(f"fetch {topic}[{p}]: {pr['error_code']}",
+                             reroute=True)
             if pr["error_code"] != ErrorCode.NONE:
                 raise RuntimeError(
                     f"fetch {topic}[{p}] failed: {pr['error_code']}")
             return pr
-        raise ConnectionError(f"fetch {topic}[{p}] never served")
+
+        return await self._with_retries(
+            self.max_attempts,
+            lambda a: self._leaders.get((topic, p)) or self._bootstrap_addr(a),
+            body,
+            lambda last: ConnectionError(f"fetch {topic}[{p}] never served"),
+            refresh=True)
 
     async def _fetch_verify_commit(self, co, group_id: str, generation: int,
                                    mid: str, parts: list) -> int:

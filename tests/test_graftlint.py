@@ -164,6 +164,38 @@ def test_jit_builder_cache_and_bucket_discipline(tmp_path):
     assert [f.line for f in shapes] == [28, 31]
 
 
+def test_jit_bucket_tuple_unpack_and_bool_flags_approved(tmp_path):
+    """PR 14 checker growth for the sharded engine path: a tuple unpack
+    of an approved ladder call carries provenance to every unpacked name
+    (``B, lids, ... = split_shard_rows(...)``), and bool-valued
+    comparisons (``plane is None`` — two programs max) are not shapes.
+    A raw count in the same call still fails."""
+    _, fs = lint_source(tmp_path, """\
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        def split_shard_rows(gids, S, L):
+            return 64, gids, gids, gids
+
+        @functools.lru_cache(maxsize=None)
+        def _scatter_fn(B, new_plane):
+            def fn(x):
+                return jnp.zeros((B,)) + x
+            return jax.jit(fn)
+
+        def good(gids, plane):
+            B, lids, shard, pos = split_shard_rows(gids, 8, 64)
+            return _scatter_fn(B, plane is None)
+
+        def bad(gids, plane):
+            return _scatter_fn(len(gids), plane is None)
+        """)
+    shapes = by_rule(fs, "jit-unbucketed-shape")
+    assert [f.line for f in shapes] == [20]
+
+
 def test_jit_builder_registry_is_cross_module(tmp_path):
     """The builder registry spans the scanned set: a cached builder defined
     in one module (packed_step's role) is enforced at call sites in

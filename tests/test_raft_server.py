@@ -38,7 +38,7 @@ class ListFsm:
 from josefine_tpu.utils.net import bound_sockets  # noqa: E402
 
 
-def make_nodes(n=3, tick_ms=30, pacer=None, **cfg_extra):
+def make_nodes(n=3, tick_ms=30, pacer=None, intercept_send=None, **cfg_extra):
     socks, ports = bound_sockets(n)
     ids_ = list(range(1, n + 1))
     hb_ms = cfg_extra.pop("heartbeat_timeout_ms", tick_ms)
@@ -61,34 +61,23 @@ def make_nodes(n=3, tick_ms=30, pacer=None, **cfg_extra):
         )
         fsm = ListFsm()
         fsms.append(fsm)
-        nodes.append(JosefineRaft(cfg, MemKV(), {0: fsm}, shutdown=Shutdown(),
-                                  pacer=pacer, sock=socks[i]))
+        nodes.append(JosefineRaft(
+            cfg, MemKV(), {0: fsm}, shutdown=Shutdown(), pacer=pacer,
+            sock=socks[i],
+            intercept_send=intercept_send(nid) if intercept_send else None))
     return nodes, fsms
-
-
-async def wait_connected(nodes, timeout=10.0):
-    """Block (wall clock, zero ticks granted) until every node's outbound
-    mesh is up. Granting ticks while a dial is still inside its reconnect
-    backoff loses the first consensus batches to the newest-wins mailbox —
-    and a lost first block replication can wedge behind the pre-existing
-    windowed nack-repair liveness bug (see ROADMAP open items)."""
-    want = {n.config.id for n in nodes}
-    deadline = asyncio.get_running_loop().time() + timeout
-    while asyncio.get_running_loop().time() < deadline:
-        if all(n.transport.connected >= (want - {n.config.id})
-               for n in nodes):
-            return
-        await asyncio.sleep(0.02)
-    raise TimeoutError("transport mesh never fully connected")
 
 
 async def wait_for_leader(nodes, pacer, max_ticks=150, exclude=()):
     """Tick-bounded leader wait: election timeouts are 4-10 ticks, so 150
     granted ticks cover many retry rounds deterministically — no wall
-    deadline to blow on a starved box. Waits for full mesh connectivity
-    FIRST, so no election can outrun the startup dials."""
-    if len(nodes) > 1:
-        await wait_connected(nodes)
+    deadline to blow on a starved box. There is deliberately NO full-mesh
+    connectivity gate here: consensus batches minted while a startup dial
+    is still in its reconnect backoff are lost to the newest-wins mailbox,
+    and the protocol must repair that on its own — which it does, now that
+    a NACK'd span survives the window outbox merge (the gate existed only
+    to mask the windowed nack-repair wedge; see _merge_outbox and
+    test_windowed_nack_repair_over_sockets)."""
     for _ in range(max_ticks):
         leaders = [n for n in nodes if n not in exclude and n.engine.is_leader(0)]
         if len(leaders) == 1:
@@ -270,6 +259,77 @@ def test_windowed_server_loop_over_sockets():
                             for f in fsms))
             # No election churned terms while windows were folding.
             assert [int(n.engine.term(0)) for n in nodes] == terms0
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    asyncio.run(main())
+
+
+def test_windowed_nack_repair_over_sockets():
+    """Regression for the windowed nack-repair liveness wedge (ROADMAP
+    open item, found by the wire-plane chaos PR): with window folding on
+    (window_ticks=4, the production server loop shape), losing the
+    block-carrying AppendEntries batches to BOTH followers must not wedge
+    — each NACK re-roots the leader's send pointer AND the re-sent span
+    must survive the window outbox merge. Pre-fix, the leader's own
+    heartbeat firing at tick 2..4 of the same window erased the tick-1
+    repair frame (last-writer-wins froze only replies), and since both
+    the NACK round trip and the heartbeat phase repeat with the window,
+    commit stalled forever.
+
+    Deterministic by construction: lockstep clock, and the heartbeat
+    phase is steered to tick 3 of the window (hb_ticks == window_ticks,
+    so the phase locks) before the drops are armed — the exact alignment
+    that wedged."""
+
+    async def main():
+        pacer = LockstepPacer()
+        state = {"leader": None, "left": {}}
+
+        def mk_intercept(nid):
+            def intercept(peer_id, msg):
+                # Drop the first 2 block-bearing consensus batches from
+                # the (armed) leader to each follower — the reconnect-
+                # window loss shape, injected deterministically.
+                if state["leader"] != nid or not getattr(msg, "blocks", None):
+                    return True
+                if state["left"].get(peer_id, 0) > 0:
+                    state["left"][peer_id] -= 1
+                    return False
+                return True
+            return intercept
+
+        nodes, fsms = make_nodes(3, pacer=pacer, window_ticks=4,
+                                 heartbeat_timeout_ms=4 * 30,
+                                 intercept_send=mk_intercept)
+        for n in nodes:
+            await n.start()
+        try:
+            leader = await wait_for_leader(nodes, pacer)
+            import numpy as np
+            # Steer the heartbeat phase: advance single ticks until the
+            # leader's broadcast cadence sits 2 ticks from firing, so the
+            # first folded window fires it at tick 3 — and with
+            # hb_ticks == window_ticks the phase then repeats every
+            # window. (Phase 1 would fuse the heartbeat with the tick-1
+            # repair frame and never exercise the overwrite.)
+            await advance_until(
+                pacer,
+                lambda: int(np.asarray(leader.engine.state.hb_elapsed)[0]) == 2)
+            state["leader"] = leader.config.id
+            state["left"] = {n.config.id: 2 for n in nodes
+                             if n is not leader}
+            # step=4: grant whole windows so the loops genuinely fold.
+            result = await propose_ticked(leader, b"repair-me", pacer,
+                                          step=4, max_ticks=400)
+            assert result == b"ok:repair-me"
+            await advance_until(
+                pacer,
+                lambda: all(f.applied == [b"repair-me"] for f in fsms))
+            # The injection really fired: both followers lost their first
+            # two block-bearing batches and repaired through the NACK path.
+            assert all(v == 0 for v in state["left"].values()), state
         finally:
             for n in nodes:
                 await n.stop()

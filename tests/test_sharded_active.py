@@ -1,0 +1,407 @@
+"""Sharded active-set & routing: the shard-local twin suites (PR 14).
+
+`parallel/sharded.py`'s engine-path builders claim the sharded compact
+step is the SAME computation as the unsharded one, re-laid-out per 'p'
+shard — gather by local index, window-step, ``decay_idle``, scatter-back,
+with only the wake-row psum crossing ICI. These suites pin that claim:
+
+* twin differential — a 3-node cluster of sharded engines (8-virtual-
+  device 'p' mesh, active_set on, RouteFabric/payload-ring on or off)
+  driven through an identical schedule as an UNSHARDED cluster stays
+  equal on EVERY tick: device state, scalar + timer mirrors, chains,
+  commits, and byte-identical outbound wire traffic (the host residual,
+  when routed — both twins must route exactly the same rows); across
+  dense/sparse IO x window 1/8 x split-phase/pipelined, through a
+  15-tick partition of node 2 (mass wake-up on heal) and a mid-run
+  group recycle;
+* bucket-ladder discipline — ``shard_bucket`` is a power-of-8 ladder
+  clamped to the SHARD-LOCAL row count, ``ShardPlan`` only ever picks
+  ladder values, and compiled shard_map program count is bounded by the
+  ladder levels hit, never per-tick active-count fluctuation;
+* quiescent floor — an all-quiescent tick on the mesh runs the sharded
+  decay program alone (empty set, no gather, nothing fetched);
+* force-active propagation — an out-of-tick mutation (group recycle) on
+  a row owned by ANY shard lands in that shard's bucket at the next
+  schedule (the plan's split, not the mutation site, owns placement).
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from josefine_tpu.models.types import LEADER, step_params
+from josefine_tpu.parallel import sharded as sh
+from josefine_tpu.raft import rpc
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.route import RouteFabric
+from josefine_tpu.utils.kv import MemKV
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=8)
+P = 48  # 6 rows per shard on the 8-device mesh
+
+
+class ListFsm:
+    def __init__(self):
+        self.applied = []
+
+    def transition(self, data):
+        self.applied.append(bytes(data))
+        return b"ok:" + data
+
+
+def _mesh(k=8):
+    devs = jax.devices()
+    assert len(devs) >= k, f"conftest provides 8 virtual devices, saw {len(devs)}"
+    return Mesh(np.array(devs[:k]), ("p",))
+
+
+def _wire_key(m):
+    """Canonical bytes-comparable form of an outbound wire message."""
+    if isinstance(m, rpc.MsgBatch):
+        blocks = sorted(
+            (g, tuple((b.id, b.parent, b.term, bytes(b.data)) for b in blks))
+            for g, blks in m.blocks.items())
+        return ("batch", m.src, m.dst, m.group.tobytes(),
+                m.kind_col.tobytes(), m.term.tobytes(), m.x.tobytes(),
+                m.y.tobytes(), m.z.tobytes(), m.ok.tobytes(),
+                np.asarray(m.inc).tobytes(), tuple(blocks))
+    blocks = tuple((b.id, b.parent, b.term, bytes(b.data))
+                   for b in (m.blocks or ()))
+    return ("msg", m.kind, m.src, m.dst, m.group, m.term, m.x, m.y, m.z,
+            m.ok, m.inc, blocks)
+
+
+def _assert_engines_equal(ea: RaftEngine, er: RaftEngine, tag: str):
+    for la, lr in zip(jax.tree.leaves(ea.state), jax.tree.leaves(er.state)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lr),
+                                      err_msg=f"state {tag}")
+    for name in ("_h_term", "_h_voted", "_h_role", "_h_leader",
+                 "_h_head", "_h_commit", "_h_src_seen", "_h_last_seen"):
+        np.testing.assert_array_equal(getattr(ea, name), getattr(er, name),
+                                      err_msg=f"{name} {tag}")
+    for g, (cha, chr_) in enumerate(zip(ea.chains, er.chains)):
+        assert cha.head == chr_.head, f"chain head g={g} {tag}"
+        assert cha.committed == chr_.committed, f"chain commit g={g} {tag}"
+    # Timer mirrors exact against the engine's own device state — the
+    # wake-predicate soundness property — with the same two by-design
+    # staleness exemptions as the unsharded suite (post-fallback tick,
+    # outstanding pipelined dispatch).
+    if not ea._timers_stale and not ea._sched_pending:
+        for mn, leaf in (("_h_elapsed", ea.state.elapsed),
+                         ("_h_hb", ea.state.hb_elapsed),
+                         ("_h_timeout", ea.state.timeout)):
+            np.testing.assert_array_equal(
+                getattr(ea, mn), np.asarray(leaf),
+                err_msg=f"{mn} mirror {tag}")
+
+
+def _mk_cluster(mesh, sparse, route, ring, groups=P):
+    ids3 = [1, 2, 3]
+    cl = [RaftEngine(MemKV(), ids3, ids3[i], groups=groups,
+                     fsms={0: ListFsm(), 3: ListFsm()},
+                     params=PARAMS, base_seed=i, sparse_io=sparse,
+                     active_set=True, mesh=mesh)
+          for i in range(3)]
+    fab = None
+    if route:
+        fab = RouteFabric(payload_ring=ring)
+        for e in cl:
+            fab.register(e)
+    return cl, fab
+
+
+# The heavier matrix cases are `slow` (ci.sh full runs this file
+# unfiltered; podsim_smoke covers the routed mesh path in quick CI):
+# tier-1 keeps the plain sharded twin and the routed+ring one.
+@pytest.mark.parametrize("sparse,window,routed,ring,pipeline", [
+    (False, 1, False, False, False),
+    (False, 1, True, True, False),
+    pytest.param(True, 1, True, False, False, marks=pytest.mark.slow),
+    pytest.param(False, 8, True, True, False, marks=pytest.mark.slow),
+    pytest.param(True, 8, False, False, False, marks=pytest.mark.slow),
+    pytest.param(False, 1, True, True, True, marks=pytest.mark.slow),
+])
+def test_twin_differential_sharded_vs_unsharded(sparse, window, routed,
+                                                ring, pipeline):
+    """Twin 3-node clusters — 8-shard 'p' mesh vs unsharded, both with
+    active-set scheduling (and both with a RouteFabric when routed, so
+    the shard-local scatter is compared against the unsharded one) —
+    driven through an identical schedule stay bit-exact every tick:
+    device state, mirrors, chains, byte-identical outbound wire traffic,
+    and equal routed counts. The schedule covers cold-start elections, a
+    proposal drizzle, a 15-tick partition of node 2, and a t=40 recycle
+    (under the pipelined driver: while a dispatch is in flight)."""
+
+    async def main():
+        act, fab = _mk_cluster(_mesh(), sparse, routed, ring)
+        ref, rfab = _mk_cluster(None, sparse, routed, ring)
+        fabs = [f for f in (fab, rfab) if f is not None]
+        committed = [0, 0]
+        for t in range(75):
+            cur_part = 15 <= t < 30
+            link_ok = (lambda s, d, cp=cur_part:
+                       not (cp and (s == 2 or d == 2)))
+            for f in fabs:
+                f.link_filter = link_ok
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                e.propose(g, b"t%d-g%d" % (t, g))
+                                break
+                if t == 40:
+                    for e in cl:
+                        e.recycle_group(2)
+                        e.set_group_incarnation(2, 1)
+                for e in cl:
+                    w = e.suggest_window(window)
+                    res = e.tick_pipelined(w) if pipeline else e.tick(w)
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    if cur_part and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            for f in fabs:
+                f.flush()
+            assert ([_wire_key(m) for m in outs[0]]
+                    == [_wire_key(m) for m in outs[1]]), f"outbound tick {t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"t={t} n={i}")
+                # Per-shard wake telemetry is the schedule's own split.
+                if act[i]._last_wake_shard is not None \
+                        and not act[i]._sched_pending:
+                    assert int(act[i]._last_wake_shard.sum()) \
+                        == act[i]._last_wake_rows
+            await asyncio.sleep(0)
+        drain = [[], []]
+        for ci, cl in enumerate((act, ref)):
+            for e in cl:
+                if e.pipeline_window:
+                    drain[ci].extend(e.tick_drain().outbound)
+        assert ([_wire_key(m) for m in drain[0]]
+                == [_wire_key(m) for m in drain[1]]), "drain residual"
+        assert committed[0] == committed[1]
+        assert committed[0] > 0, "schedule must exercise real commits"
+        if routed:
+            assert fab.routed_total == rfab.routed_total > 0
+        assert sum(e.active_sched_ticks for e in act) > 0, \
+            "sharded twin never ran the compacted path"
+        for i in range(3):
+            _assert_engines_equal(act[i], ref[i], "final")
+
+    asyncio.run(main())
+
+
+def test_multi_axis_mesh_counts_p_shards_only():
+    """shard_map splits over 'p' ALONE and replicates other mesh axes, so
+    the plan/telemetry shard count must be the 'p' axis size, never the
+    device count — on a ('p','x') = (4,2) mesh a device-count split
+    would mis-bin every local id (silent state divergence). Pinned by a
+    short twin drive against the unsharded engine."""
+
+    async def main():
+        devs = jax.devices()
+        mesh = Mesh(np.array(devs[:8]).reshape(4, 2), ("p", "x"))
+        assert sh.mesh_shards(mesh) == 4
+        e = RaftEngine(MemKV(), [1], 1, groups=P, params=PARAMS,
+                       active_set=True, mesh=mesh)
+        assert e._shards == 4 and e._shard_rows == P // 4
+        ref = RaftEngine(MemKV(), [1], 1, groups=P, params=PARAMS,
+                         active_set=True)
+        for t in range(25):
+            e.tick()
+            ref.tick()
+            for la, lr in zip(jax.tree.leaves(e.state),
+                              jax.tree.leaves(ref.state)):
+                np.testing.assert_array_equal(
+                    np.asarray(la), np.asarray(lr),
+                    err_msg=f"multi-axis mesh diverged t={t}")
+            await asyncio.sleep(0)
+        assert e.active_sched_ticks > 0
+        assert e._last_wake_shard is None or len(e._last_wake_shard) == 4
+
+    asyncio.run(main())
+
+
+def test_member_stays_cosharded_after_claim_change():
+    """set_group_members / _member_mask rebuilds must re-place the (P, N)
+    membership mask co-sharded on mesh engines — a bare jnp.asarray
+    would force a full reshard on every subsequent dispatch."""
+    mesh = _mesh()
+    e = RaftEngine(MemKV(), [1, 2, 3], 1, groups=P, params=PARAMS,
+                   active_set=True, mesh=mesh)
+
+    def _p_sharded(arr):
+        spec = getattr(arr.sharding, "spec", None)
+        return spec is not None and spec[0] == "p"
+
+    assert _p_sharded(e.member), "init placement regressed"
+    e.set_group_members(5, {0, 1})
+    assert _p_sharded(e.member), "claim change dropped the 'p' sharding"
+    e.member = e._member_mask()
+    assert _p_sharded(e.member), "_member_mask dropped the 'p' sharding"
+
+
+# ------------------------------------------------------- bucket ladder
+
+
+def test_shard_bucket_ladder():
+    """Powers of 8 from a floor of 64, clamped to the shard-local row
+    count — and a sub-floor shard always compiles exactly one shape."""
+    assert sh.shard_bucket(0, 512) == 64
+    assert sh.shard_bucket(64, 512) == 64
+    assert sh.shard_bucket(65, 512) == 512
+    assert sh.shard_bucket(513, 4096) == 4096
+    assert sh.shard_bucket(400, 512) == 512      # clamp beats 8^k
+    # L < 64: every count maps to the one (L-sized) shape.
+    assert sh.shard_bucket(0, 6) == 6
+    assert sh.shard_bucket(5, 6) == 6
+
+
+def test_shard_plan_layout():
+    """ShardPlan splits a sorted global id vector into contiguous
+    per-shard runs, pads local buckets with L (the scatter's drop
+    sentinel), and round-trips compact host values shard-major."""
+    S, L = 4, 8  # P = 32
+    G = np.array([0, 1, 9, 10, 11, 31])
+    plan = sh.ShardPlan(G, 32, S)
+    assert plan.k == 3 or plan.k == L  # ladder value, clamped to L
+    assert plan.k == sh.shard_bucket(3, L)
+    np.testing.assert_array_equal(plan.counts, [2, 3, 0, 1])
+    # Local ids land at their shard's slots; pads are L.
+    assert list(plan.idx[0][:2]) == [0, 1] and (plan.idx[0][2:] == L).all()
+    assert list(plan.idx[1][:3]) == [1, 2, 3]
+    assert (plan.idx[2] == L).all()
+    assert list(plan.idx[3][:1]) == [7]
+    # scatter_vals: compact (rows, A, N) in G order -> shard-major.
+    vals = np.arange(10 * 6 * 3, dtype=np.int32).reshape(10, 6, 3)
+    out = plan.scatter_vals(vals)
+    assert out.shape == (S, 10, plan.k, 3)
+    np.testing.assert_array_equal(out[1, :, 1, :], vals[:, 3, :])  # g=10
+    np.testing.assert_array_equal(out[3, :, 0, :], vals[:, 5, :])  # g=31
+    assert (out[2] == 0).all()
+
+
+@pytest.mark.slow
+def test_sharded_recompile_discipline():
+    """Compiled shard_map program count is bounded by the per-shard
+    bucket ladder — as the active count fluctuates tick to tick, only a
+    new LADDER level may compile, never a per-tick shape (and the ladder
+    is the coarse power-of-8 one, independent of shard count)."""
+
+    async def main():
+        Pbig = 8 * 512  # L = 512: ladder levels are 64 and 512
+        mesh = _mesh()
+        e = RaftEngine(MemKV(), [1], 1, groups=Pbig,
+                       params=step_params(timeout_min=3, timeout_max=8,
+                                          hb_ticks=16),
+                       active_set=True, mesh=mesh)
+        e.active_fallback_frac = 1.0
+        for _ in range(20):  # settle: every single-node group self-elects
+            e.tick()
+        rng = np.random.default_rng(3)
+        before = sh.make_sharded_active_window.cache_info().currsize
+        ks = set()
+        for t in range(40):
+            # Alternate tiny and broad offered load so the fullest
+            # shard's count crosses the 64 -> 512 ladder boundary.
+            n = int(rng.integers(1, 40)) if t % 2 else \
+                int(rng.integers(600, 3000))
+            for g in rng.choice(Pbig, size=n, replace=False):
+                e.propose(int(g), b"x")
+            h = e.tick_begin()
+            assert h["mode"] == "active"
+            k = h["plan"].k
+            assert k == sh.shard_bucket(int(h["plan"].counts.max()), 512)
+            ks.add(k)
+            e.tick_finish(h)
+        grown = sh.make_sharded_active_window.cache_info().currsize - before
+        assert grown <= len(ks), \
+            f"{grown} new shard_map compiles for {len(ks)} ladder levels {ks}"
+        assert len(ks) >= 2, "load variation must span ladder levels"
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------- quiescent floor
+
+
+@pytest.mark.slow
+def test_all_quiescent_sharded_tick_is_decay_only():
+    """Once leaders settle on the mesh, a fully idle tick runs the
+    SHARDED decay program alone: empty active set, no gather, no
+    shard_map step, nothing fetched, zero transfer bytes."""
+
+    async def main():
+        cl, _ = _mk_cluster(_mesh(), False, False, False, groups=P)
+        for _ in range(40):  # settle elections
+            results = [e.tick() for e in cl]
+            for res in results:
+                for m in res.outbound:
+                    cl[m.dst].receive(m)
+        assert sum(int((e._h_role == LEADER).sum()) for e in cl) == P
+        saw_empty = 0
+        for _ in range(16):
+            handles = [e.tick_begin() for e in cl]
+            for e, h in zip(cl, handles):
+                if h["mode"] == "active" and len(h["G"]) == 0:
+                    saw_empty += 1
+                    assert h["flat"] is None
+                    assert h["upload_bytes"] == 0 and h["fetch_bytes"] == 0
+                res = e.tick_finish(h)
+                for m in res.outbound:
+                    cl[m.dst].receive(m)
+        assert saw_empty > 0, "no all-quiescent tick in 16 idle ticks"
+
+    asyncio.run(main())
+
+
+# ------------------------------------------- force-active propagation
+
+
+def test_force_active_reaches_remote_shard():
+    """An out-of-tick mutation (group recycle) on a row owned by the
+    LAST shard must wake that row at the next schedule, placed in its
+    owning shard's bucket by the plan — force-active propagation is
+    global-id based, never scoped to shard 0."""
+
+    async def main():
+        cl, _ = _mk_cluster(_mesh(), False, False, False, groups=P)
+        for _ in range(40):  # settle
+            results = [e.tick() for e in cl]
+            for res in results:
+                for m in res.outbound:
+                    cl[m.dst].receive(m)
+        g = P - 1                       # owned by shard 7 (L = 6, lid 5)
+        L = P // 8
+        for e in cl:
+            e.recycle_group(g)
+            e.set_group_incarnation(g, 1)
+        handles = [e.tick_begin() for e in cl]
+        for e, h in zip(cl, handles):
+            assert h["mode"] == "active"
+            assert g in h["G"], "recycled remote-shard row must wake"
+            plan = h["plan"]
+            assert plan is not None
+            assert (g % L) in plan.idx[g // L], \
+                "plan must place the row in its owning shard's bucket"
+            assert e._last_wake_shard is not None
+            assert e._last_wake_shard[g // L] >= 1
+            e.tick_finish(h)
+        # The woken row really steps: drive on, group g re-elects.
+        for _ in range(40):
+            results = [e.tick() for e in cl]
+            for res in results:
+                for m in res.outbound:
+                    cl[m.dst].receive(m)
+        assert sum(e.is_leader(g) for e in cl) == 1, \
+            "recycled row never recovered leadership on the mesh"
+
+    asyncio.run(main())

@@ -520,6 +520,42 @@ def test_wire_soak_stacked_leader_partition_zero_violations():
 
 
 @pytest.mark.slow
+def test_wire_soak_reconnect_loss_liveness():
+    """The reconnect-window block-batch loss class (the searched
+    neighborhood of the windowed nack-repair wedge, fixed engine-side in
+    packed_step._merge_outbox): five leader cut/heal rounds at
+    fold-window cadence, each heal a fresh dial whose backoff swallows
+    block batches. Against the FIXED engine, liveness holds — commits
+    resume inside the probe window after every heal — and every acked
+    produce is durable. Pre-fix, this class starves commits forever."""
+    from josefine_tpu.chaos.wire_soak import run_wire_soak
+
+    r = run_wire_soak(7, "wire-reconnect-loss", n_nodes=3, tenants=1,
+                      commitless_limit=120)
+    assert r["invariants"] == "ok", r["violation"]
+    assert r["produced"] > 0 and r["consumed"] == r["produced"]
+    assert r["max_commitless_window"] <= 120
+    fates = {k for v in r["fate_log"].values() for k in v}
+    assert "conn_reset" in fates
+
+
+def test_wire_reconnect_loss_schedule_in_search_catalog():
+    """The class is drawable by wire-mode search (catalog membership and
+    DSL validity at the harness's node count)."""
+    from josefine_tpu.chaos.nemesis import WIRE_SCHEDULES, wire_reconnect_loss
+    from josefine_tpu.chaos.search import ChaosSearch, Corpus
+
+    sched = wire_reconnect_loss(3)
+    sched.validate()
+    assert any(s.op == "isolate" for s in sched.steps), \
+        "the class must cut the raft plane (that's the loss it targets)"
+    assert any(s.op == "conn_reset" for s in sched.steps)
+    assert "wire-reconnect-loss" in WIRE_SCHEDULES
+    s = ChaosSearch(3, Corpus(None), n_nodes=3, wire=True)
+    assert "wire-reconnect-loss" in s.schedules
+
+
+@pytest.mark.slow
 def test_wire_search_admits_novel_wire_coverage():
     """Wire-mode chaos search: a short seeded run from the bundled wire
     baseline must admit at least one schedule covering a NOVEL wire-class

@@ -235,6 +235,17 @@ print("traffic chaos ok:", ws["acked"], "acked across",
 PYEOF
 }
 
+podsim_smoke() {
+    # The sharded engine path's quick parity gate (PR 14): twin 3-node
+    # clusters — 8-virtual-device 'p' mesh vs unsharded, both active-set +
+    # device-route + payload-ring — byte-identical through elections, a
+    # partition window, and a mid-run recycle, with non-zero compacted
+    # ticks and routed rows (the full matrix lives in
+    # tests/test_sharded_active.py; this is its quick-CI slice).
+    echo "== podsim smoke =="
+    python tools/podsim_smoke.py
+}
+
 obs_smoke() {
     # Observability end-to-end: boot an engine to an election + commits,
     # start a MetricsServer, and assert over real HTTP that /metrics
@@ -264,6 +275,7 @@ if [[ "${1:-}" == "quick" ]]; then
     chaos_search_smoke
     wire_chaos_smoke
     traffic_smoke
+    podsim_smoke
     obs_smoke
     perf_smoke
 else
@@ -297,6 +309,9 @@ else
     python -m pytest tests/test_active_set.py -q
     # Device-routing twin differential (PR 6) — same heavyweight shape.
     python -m pytest tests/test_device_route.py -q
+    # Sharded active-set + routing twin differential (PR 14) — the mesh
+    # variant of the two above, run unfiltered (slow matrix included).
+    python -m pytest tests/test_sharded_active.py -q
     python -m pytest tests/test_chaos.py tests/test_node_chaos.py \
         tests/test_fault_hooks.py tests/test_chaos_determinism.py \
         tests/test_flight.py tests/test_flight_merge.py \
@@ -311,6 +326,7 @@ else
     wire_chaos_smoke
     traffic_smoke
     traffic_chaos_smoke
+    podsim_smoke
     obs_smoke
     perf_smoke
 fi

@@ -21,7 +21,12 @@ Three rows are checked:
 * a product-path traffic row (``traffic: true`` — tools/traffic_soak.py,
   the in-process workload driver) — catches regressions of the SERVE
   path (broker handlers → propose_local → per-partition FSM apply →
-  fetch), which the bench rows never touch.
+  fetch), which the bench rows never touch;
+* a sharded active-set row (``podsim: true`` — bench_podsim.py
+  ``--engine`` on the 8-virtual-device mesh, PR 14) — catches
+  regressions of the shard-local scheduler (ShardPlan split, per-shard
+  gather/step/decay/scatter shard_map program, compact reassembly),
+  which every unsharded row bypasses.
 
 The floor ratio is deliberately loose (2x by default): CI boxes vary, and
 the stage exists to catch order-of-magnitude structural regressions, not
@@ -62,6 +67,9 @@ FLOOR_ROWS = [
      "device_route": True, "payload_ring": True},
     {"traffic": True, "tenants": 16, "partitions": 64, "ticks": 60,
      "load": 16, "max_regression": 3.0},
+    {"podsim": True, "per_device": 2048, "devices": 8, "ticks": 10,
+     "warmup": 5, "tenants": 50, "offered": 64, "hb_ticks": 64,
+     "max_regression": 3.0},
 ]
 
 
@@ -95,9 +103,46 @@ def run_traffic(floor: dict) -> dict:
     return row
 
 
+def run_podsim(floor: dict) -> dict:
+    """Sharded engine-path row: bench_podsim.py --engine on the virtual
+    mesh — ms_per_tick of the shard-local compacted loop."""
+    out = os.path.join(tempfile.gettempdir(),
+                       "josefine_perf_smoke_podsim_%d.json" % os.getpid())
+    cmd = [
+        sys.executable, os.path.join(ROOT, "bench_podsim.py"), "--engine",
+        "--per-device", str(floor["per_device"]),
+        "--devices", str(floor["devices"]),
+        "--ticks", str(floor.get("ticks", 10)),
+        "--warmup", str(floor.get("warmup", 5)),
+        "--tenants", str(floor.get("tenants", 50)),
+        "--offered", str(floor.get("offered", 64)),
+        "--hb-ticks", str(floor.get("hb_ticks", 64)),
+        "--out", out,
+    ]
+    env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
+    subprocess.run(cmd, check=True, cwd=ROOT, env=env,
+                   stdout=subprocess.DEVNULL,
+                   timeout=floor.get("timeout_s", 600))
+    try:
+        with open(out) as f:
+            row = json.load(f)["results"][0]
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    if not row.get("sched_ticks"):
+        raise RuntimeError(
+            "podsim perf row never ran a compacted tick — the floor would "
+            "be measuring the dense fallback, not the sharded scheduler")
+    return row
+
+
 def run_bench(floor: dict) -> dict:
     if floor.get("traffic"):
         return run_traffic(floor)
+    if floor.get("podsim"):
+        return run_podsim(floor)
     out = os.path.join(tempfile.gettempdir(),
                        "josefine_perf_smoke_%d.json" % os.getpid())
     cmd = [
@@ -135,6 +180,9 @@ def _row_name(floor: dict) -> str:
     if floor.get("traffic"):
         return (f"traffic {floor['tenants']}x{floor['partitions']} "
                 f"(load {floor.get('load', 16)}/tick)")
+    if floor.get("podsim"):
+        return (f"podsim sharded P={floor['per_device'] * floor['devices']} "
+                f"({floor['devices']}-device mesh, active-set)")
     if floor.get("active_set"):
         return (f"P={floor['P']} active-set "
                 f"(active-frac {floor.get('active_frac')})")
