@@ -107,6 +107,10 @@ class DeterminismChecker(Checker):
         "josefine_tpu/workload/",
         "josefine_tpu/utils/flight.py",
         "josefine_tpu/utils/coverage.py",
+        # The span plane journals per-request phase trees with the same
+        # byte-identity contract as the flight journal; its emit sites
+        # (raft/, broker/, workload/) are already in scope above.
+        "josefine_tpu/utils/spans.py",
     )
     rules = {
         "det-wallclock":

@@ -38,6 +38,7 @@ from josefine_tpu.config import BrokerConfig
 from josefine_tpu.kafka import codec
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.shutdown import Shutdown
+from josefine_tpu.utils.spans import bind_span
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("broker.server")
@@ -67,6 +68,14 @@ _CONCURRENT_APIS = frozenset((
 ))
 
 
+def _api_kind(api_key: int) -> str:
+    """Span-kind label for an API key (``produce``, ``fetch``, ...)."""
+    try:
+        return codec.ApiKey(api_key).name.lower()
+    except ValueError:
+        return f"api_{api_key}"
+
+
 class _Evict(Exception):
     """Raised on the write path when a slow client misses its deadline."""
 
@@ -83,7 +92,11 @@ class JosefineBroker:
     ``label_server(writer, client_id)`` (see
     :class:`josefine_tpu.chaos.wire.WirePlane`). ``flight_hook(kind,
     detail)`` journals connection-plane events (evictions) into the
-    node's flight recorder.
+    node's flight recorder. ``span_recorder`` (``raft.request_spans``,
+    wired by Node) mints one request span at each frame decode — the
+    wire-path trace context (utils/spans.py): admission runs decode →
+    propose-submit (serial-lane waits included), serve closes when the
+    response frame is encoded for the ordered writer.
     """
 
     def __init__(
@@ -96,6 +109,7 @@ class JosefineBroker:
         is_controller=None,
         conn_shim=None,
         flight_hook=None,
+        span_recorder=None,
     ):
         self.config = config
         self.shutdown = shutdown or Shutdown()
@@ -103,6 +117,7 @@ class JosefineBroker:
                              is_controller=is_controller)
         self.conn_shim = conn_shim
         self.flight_hook = flight_hook
+        self.span_recorder = span_recorder
         self._server: asyncio.Server | None = None
         self._conn_tasks: set[asyncio.Task] = set()
         self._active = 0
@@ -190,7 +205,15 @@ class JosefineBroker:
         inflight: set[asyncio.Task] = set()
         serial_tail: asyncio.Task | None = None
 
-        async def handle(req: dict, after: asyncio.Task | None = None):
+        rec = self.span_recorder
+
+        async def handle(req: dict, after: asyncio.Task | None = None,
+                         span=None):
+            if span is not None:
+                # The request's trace context (minted at frame decode):
+                # bound task-locally so the propose() this request reaches
+                # can stamp its queue/consensus/apply rungs on the span.
+                bind_span(span)
             if after is not None and not after.done():
                 # Serial lane: state-mutating requests preserve arrival
                 # order; a predecessor's failure only matters to its own
@@ -202,14 +225,24 @@ class JosefineBroker:
                 client_host=str(peer[0]) if peer else "",
             )
             if body is None:
+                if span is not None:
+                    rec.finish(span, status="closed")
                 return _EOF  # unroutable: close (the reference panics here)
             if body.pop("__no_response__", False):
+                if span is not None:
+                    rec.finish(span, status="no_response")
                 return None  # acks=0 produce
             api_version = req["api_version"] if req["body"] is not None else 0
             resp = codec.encode_response(
                 req["api_key"], api_version, req["correlation_id"], body
             )
-            return codec.frame(resp)
+            frame = codec.frame(resp)
+            if span is not None:
+                # Serve closes here — the frame is handed to the ordered
+                # writer. Failure/cancellation paths close through the
+                # done-callback guard below (finish is idempotent).
+                rec.finish(span, status="ok")
+            return frame
 
         reset = False
         evicted = False
@@ -288,13 +321,29 @@ class JosefineBroker:
                         break
                     self._by_client[client_key] = \
                         self._by_client.get(client_key, 0) + 1
+                span = None
+                if rec is not None:
+                    # Wire-path trace context: minted at FRAME DECODE, so
+                    # the admission phase covers everything between the
+                    # byte arriving and the proposal entering the engine.
+                    span = rec.begin(_api_kind(req["api_key"]),
+                                     tenant=req.get("client_id") or "")
                 if req["api_key"] in _CONCURRENT_APIS:
-                    ht = asyncio.create_task(handle(req))
+                    ht = asyncio.create_task(handle(req, span=span))
                 else:
-                    ht = asyncio.create_task(handle(req, after=serial_tail))
+                    ht = asyncio.create_task(
+                        handle(req, after=serial_tail, span=span))
                     serial_tail = ht
                 inflight.add(ht)
                 ht.add_done_callback(inflight.discard)
+                if span is not None:
+                    # Completion guard: a task cancelled BEFORE its first
+                    # step never enters the coroutine body (connection
+                    # teardown racing a just-decoded frame), so the span
+                    # must close from the task side; finish is idempotent,
+                    # a handler-finished span makes this a no-op.
+                    ht.add_done_callback(
+                        lambda _t, _s=span: rec.finish(_s, status="error"))
                 await queue.put(ht)
             # EOF (or a broken frame): let the writer flush what is owed.
             await queue.put(_EOF)

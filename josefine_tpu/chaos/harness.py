@@ -205,7 +205,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                  active_set: bool = False, device_route: bool = False,
                  payload_ring: bool = False,
                  flight_wire: bool = False, workload=None,
-                 flight_ring: int = 4096):
+                 flight_ring: int = 4096, request_spans: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -231,6 +231,13 @@ class ChaosCluster(_PlaneDrivenCluster):
         # truncates the timeline the coverage scorer depends on — the soak
         # sizes it (run_soak flight_ring=) and warns on wraparound.
         self.flight_ring = int(flight_ring)
+        # Request-scoped spans under chaos (raft.request_spans): engines
+        # accept the ambient trace context at propose(); the workload
+        # adapter mints one span per produce request, clocked on the
+        # cluster's virtual tick so driver-side marks stay deterministic
+        # through crash/restart engine rebuilds (engine-side rungs are
+        # clamped into [begin, end] by the span ladder either way).
+        self.request_spans = bool(request_spans)
         self.propose_rate = propose_rate
         self.max_proposals = max_proposals
         # Product-load source (workload.chaos_traffic.ChaosTraffic): when
@@ -286,6 +293,7 @@ class ChaosCluster(_PlaneDrivenCluster):
             active_set=self.active_set,
             flight_wire=self.flight_wire,
             flight_ring=self.flight_ring,
+            request_spans=self.request_spans,
         )
         if self.k_out is not None:
             e._k_out = self.k_out

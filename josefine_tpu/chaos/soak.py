@@ -54,7 +54,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          workload: dict | None = None,
                          artifact_path: str | None = None,
                          flight_ring: int | None = None,
-                         commitless_limit: int | None = None) -> dict:
+                         commitless_limit: int | None = None,
+                         request_spans: bool = False) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -113,6 +114,16 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     plane = FaultPlane(seed, n_nodes, net=net)
     params = DEFAULT_PARAMS if hb_ticks is None else step_params(
         timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
+    spans_rec = None
+    if request_spans and workload:
+        # Request spans under chaos (utils/spans.py): one recorder on the
+        # soak's virtual clock; the workload adapter mints/finishes the
+        # spans and the span-enabled engines stamp the consensus rungs.
+        # The clock closure late-binds `cluster` (created below) — it is
+        # only ever read from drive/harvest, after construction.
+        from josefine_tpu.utils.spans import SpanRecorder
+
+        spans_rec = SpanRecorder(clock=lambda: cluster.tick_no)
     traffic = None
     if workload:
         # Product load under the nemesis (workload.chaos_traffic): the
@@ -123,14 +134,15 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         from josefine_tpu.workload.model import WorkloadSpec
 
         spec = WorkloadSpec(**workload).validate()
-        traffic = ChaosTraffic(spec, seed, groups)
+        traffic = ChaosTraffic(spec, seed, groups, spans=spans_rec)
     cluster = ChaosCluster(seed, n_nodes=n_nodes, groups=groups,
                            window=window, plane=plane, params=params,
                            auto_crash=auto_faults, auto_links=auto_faults,
                            active_set=active_set, device_route=device_route,
                            payload_ring=payload_ring and device_route,
                            flight_wire=flight_wire, workload=traffic,
-                           flight_ring=flight_ring or 4096)
+                           flight_ring=flight_ring or 4096,
+                           request_spans=request_spans)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -142,6 +154,11 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     last_progress = 0   # last chaotic tick where the acked total grew
     max_stall = 0       # longest commitless window seen (search telemetry)
     prev_acked = 0
+    if spans_rec is not None:
+        # The whole chaotic phase counts as an armed-fault window: every
+        # request in flight under the schedule is retained, not just the
+        # tail sample (the sampling rule's fault arm).
+        spans_rec.fault_active = bool(sched.steps)
     try:
         for _ in range(ticks):
             cluster.step(nemesis=nemesis)
@@ -168,11 +185,24 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                     f"availability: no ack committed for {stall} ticks "
                     f"(> commitless_limit {commitless_limit}) at tick "
                     f"{cluster.tick_no}")
+        if spans_rec is not None:
+            spans_rec.fault_active = False
         cluster.heal(sched.heal_ticks)
         cluster.harvest_traffic()
         cluster.assert_converged_and_linearizable()
     except InvariantViolation as e:
         violation = str(e)
+    span_dump = None
+    span_summary = None
+    if spans_rec is not None:
+        # Requests the faults stranded (unresolved futures, retries still
+        # delayed at the horizon) close as "aborted" so the artifact
+        # carries them — they are the fault arm's whole point. Serialize
+        # ONCE; the artifact and the result share the strings.
+        traffic.close_spans()
+        spans_rec.seal()
+        span_dump = spans_rec.dump_jsonl()
+        span_summary = spans_rec.summary(table=True)
 
     journals = cluster.flight_journals_jsonl()
     # Cluster-scope observability: merge the per-node journals into ONE
@@ -203,6 +233,11 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                     "registry": REGISTRY.dump(),
                     "event_log": plane.event_log_jsonl(),
                     "schedule_json": sched.to_json(),
+                    # Replayable request-span trees (request_spans on):
+                    # the violation's per-request phase story, next to
+                    # the journals it joins against on (tick, group).
+                    "spans": span_dump,
+                    "span_summary": span_summary,
                 }, fh, indent=1)
         except OSError:
             artifact = None
@@ -259,6 +294,13 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # per-tenant latency view of THIS run (the registry histogram
         # accumulates across soaks in one process; these are run-local).
         "workload_stats": traffic.stats() if traffic is not None else None,
+        # Request-span epilogue (request_spans on, workload-driven):
+        # request counts, sampling stats, aggregate phase attribution,
+        # and the retained span log (byte-identical across same-seed
+        # runs — the flight-journal contract).
+        "request_spans": request_spans,
+        "span_summary": span_summary,
+        "spans": span_dump,
         # Dynamic-target steps that resolved to nothing (e.g. "leader"
         # during a leaderless window): skipped-and-recorded per the
         # nemesis contract; a search scorer reads this as wasted genome.

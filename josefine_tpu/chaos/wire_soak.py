@@ -114,7 +114,8 @@ class WireCluster:
     connection shim, ticks come from a lockstep pacer."""
 
     def __init__(self, n_nodes: int, partitions: int, tmpdir: str,
-                 plane: FaultPlane, pacer, tick_ms: int = 20):
+                 plane: FaultPlane, pacer, tick_ms: int = 20,
+                 request_spans: bool = False):
         from josefine_tpu.config import (
             BrokerConfig,
             EngineConfig,
@@ -139,6 +140,10 @@ class WireCluster:
                                 heartbeat_timeout_ms=tick_ms,
                                 election_timeout_min_ms=3 * tick_ms,
                                 election_timeout_max_ms=8 * tick_ms,
+                                # Wire-path request spans: each broker
+                                # mints a trace context per decoded frame
+                                # (utils/spans.py, Node wiring).
+                                request_spans=request_spans,
                                 data_directory=os.path.join(
                                     tmpdir, f"node-{node_id}/raft")),
                 broker=BrokerConfig(id=node_id, ip="127.0.0.1",
@@ -212,7 +217,8 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
                               settle_s: float = 0.015,
                               request_ticks: int = 30,
                               join_ticks: int = 120,
-                              artifact_path: str | None = None) -> dict:
+                              artifact_path: str | None = None,
+                              request_spans: bool = False) -> dict:
     """One wire chaos soak (see module docstring). Produces one offered
     batch every ``produce_every`` virtual ticks across the schedule's
     horizon, heals, then runs the full consumer-group verification."""
@@ -232,7 +238,7 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
     partitions = 1 + spec.total_partitions
     tmpdir = tempfile.mkdtemp(prefix="wire_soak_")
     cluster = WireCluster(n_nodes, partitions, tmpdir, plane, pacer,
-                          tick_ms=tick_ms)
+                          tick_ms=tick_ms, request_spans=request_spans)
     nemesis = Nemesis(sched, plane, cluster)
 
     async def advance() -> None:
@@ -254,6 +260,16 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
     consumed = 0
     offered = 0
     max_stall = 0
+    span_summaries = None
+    span_dumps = None
+
+    def _set_fault_windows(active: bool) -> None:
+        # Broker-side span recorders: the chaotic phase is one armed-fault
+        # window, so every request served under the schedule is retained
+        # (the sampling rule's fault arm), not just the per-window tail.
+        for n in cluster.nodes:
+            if n.spans is not None:
+                n.spans.fault_active = active
     try:
         await cluster.start()
         for _ in range(600):
@@ -271,6 +287,7 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
         clock._advance = advance
 
         # ---- chaotic phase: offered load under the schedule ----
+        _set_fault_windows(bool(sched.steps))
         last_ack_tick = plane.tick
         prev_acked = driver.n_produced
         while plane.tick < sched.horizon:
@@ -298,6 +315,7 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
         # epilogue's journal stamps (conn_open of the verification
         # consumers) byte-identical across same-seed runs.
         plane.heal_all()
+        _set_fault_windows(False)
         clock._advance = setup_advance
         for _ in range(sched.heal_ticks):
             await setup_advance()
@@ -321,6 +339,15 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
             await driver.close()
         except Exception:
             pass
+        if request_spans:
+            # Harvest before stop() — the recorders live on the nodes.
+            span_summaries, span_dumps = {}, {}
+            for n in cluster.nodes:
+                if n.spans is not None:
+                    nid = str(n.config.raft.id)
+                    n.spans.seal()  # summary and dump must agree
+                    span_summaries[nid] = n.spans.summary(table=True)
+                    span_dumps[nid] = n.spans.dump_jsonl()
         await cluster.stop()
         await asyncio.to_thread(shutil.rmtree, tmpdir, ignore_errors=True)
 
@@ -340,6 +367,10 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
             "fault_event_log": plane.event_log_jsonl(),
             "schedule_json": sched.to_json(),
             "driver": driver.summary(),
+            # Replayable per-node span trees (request_spans on): the
+            # violation's request-phase story beside the wire journals.
+            "spans": span_dumps,
+            "span_summary": span_summaries,
         }
 
         def dump_artifact(path: str) -> bool:
@@ -375,6 +406,11 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
         "artifact": artifact,
         "coverage": coverage.to_dict(),
         "coverage_signature": coverage.signature(),
+        # Broker-side request spans (request_spans on): per-node request
+        # counts + phase attribution, and the retained span logs.
+        "request_spans": request_spans,
+        "span_summary": span_summaries,
+        "spans": span_dumps,
         "schedule_json": sched.to_json(),
     }
 

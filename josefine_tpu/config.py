@@ -112,6 +112,16 @@ class RaftConfig:
     # captures, not for the bench hot path (bench_engine --flight-wire
     # quotes the measured cost in extra.flight_wire_overhead).
     flight_wire: bool = False
+    # Request-scoped causal tracing (utils/spans.py): mint a trace context
+    # at the broker's frame decode (and the workload drivers' submit) and
+    # stamp tick-denominated phase spans — admission / queue / consensus /
+    # apply / serve — through propose() and the commit/apply sites, served
+    # at the MetricsServer /traces route and rendered by
+    # tools/request_report.py. Off by default: the off path is a single
+    # bool per site; the on cost at the 1000×10k traffic shape is quoted
+    # in BENCH_traffic.json extra.request_spans_overhead (the flight_wire
+    # discipline — measure, don't guess).
+    request_spans: bool = False
     # ring_spill trace events in the flight journal: one event per payload
     # AppendEntries the device payload ring could NOT serve (span not
     # resident -> host path). Off by default, same reasoning as

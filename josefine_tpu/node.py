@@ -84,6 +84,16 @@ class Node:
             sock=raft_sock,
         )
         self.client = RaftClient(self.raft)
+        # Request-scoped spans (raft.request_spans): one recorder per
+        # node, ticking on the engine's own tick axis; the broker mints a
+        # trace context at each frame decode and the engine stamps the
+        # consensus rungs (utils/spans.py).
+        self.spans = None
+        if config.raft.request_spans:
+            from josefine_tpu.utils.spans import SpanRecorder
+
+            self.spans = SpanRecorder(
+                clock=self.raft.engine._flight_tick)
         self.broker = JosefineBroker(
             config.broker,
             self.store,
@@ -99,7 +109,16 @@ class Node:
             # other recorded event, so /events and merged timelines see
             # them.
             flight_hook=self._conn_flight_event,
+            span_recorder=self.spans,
         )
+        # WARNING+ josefine log records also journal as tick-stamped
+        # log_event flight entries (utils/tracing.attach_flight_journal),
+        # so merged timelines capture broker-side errors; detached at
+        # stop().
+        from josefine_tpu.utils.tracing import attach_flight_journal
+
+        self._flight_log_handler = attach_flight_journal(
+            self.raft.engine.flight.emit, self.raft.engine._flight_tick)
         # Committed DeleteTopic reaches every node through the FSM; each
         # drops its own on-disk replica logs. Deregistration is synchronous
         # (later requests must see the topic gone); the rmtree runs in an
@@ -141,6 +160,10 @@ class Node:
                 # (node-scoped by construction — each endpoint serves its
                 # own engine's ring).
                 events_fn=lambda: self.raft.engine.flight.events(),
+                # /traces: retained request span trees (empty route when
+                # raft.request_spans is off).
+                traces_fn=(self.spans.traces if self.spans is not None
+                           else None),
             )
 
     def _conn_flight_event(self, kind: str, detail: dict) -> None:
@@ -345,6 +368,9 @@ class Node:
         await self.broker.stop()
         if self.metrics_server is not None:
             await self.metrics_server.stop()
+        from josefine_tpu.utils.tracing import detach_flight_journal
+
+        detach_flight_journal(self._flight_log_handler)
         self.kv.close()
 
 

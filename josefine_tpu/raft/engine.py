@@ -97,6 +97,7 @@ from josefine_tpu.raft.snap_transfer import SnapshotTransfer, _SnapStream
 from josefine_tpu.utils.flight import FlightRecorder
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.metrics import REGISTRY
+from josefine_tpu.utils.spans import current_span
 from josefine_tpu.utils.profiling import NULL_PROFILER, PhaseProfiler
 from josefine_tpu.utils.tracing import get_logger
 
@@ -214,6 +215,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         flight_ring: int = 4096,
         flight_wire: bool = False,
         flight_ring_spill: bool = False,
+        request_spans: bool = False,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -542,9 +544,14 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
 
         self._pending_msgs: list[rpc.WireMsg] = []
         self._pending_batches: list[rpc.MsgBatch] = []
-        # (payload, future, submit device tick) triples — the tick stamp
-        # feeds the proposal→commit latency histogram at mint time.
-        self._proposals: dict[int, list[tuple[bytes, asyncio.Future | None, int]]] = {}
+        # (payload, future, submit device tick, request span) — the tick
+        # stamp feeds the proposal→commit latency histogram at mint time;
+        # the span (None unless raft.request_spans minted a trace context
+        # for this request, utils/spans.py) rides the queue so tick_finish
+        # can stamp the minted/committed/applied rungs on the engine's
+        # tick axis without any per-request lookups.
+        self._proposals: dict[
+            int, list[tuple[bytes, asyncio.Future | None, int, object]]] = {}
         # Groups with a non-empty proposal queue. Kept in lockstep with
         # _proposals (propose() adds; tick_begin takes the whole set into
         # the tick handle; _recycle drops) so the per-tick builders touch
@@ -611,6 +618,11 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         self._routed_blocks: dict[int, list] | None = None
         self._ring_stage_decode: list[tuple[int, object]] = []
         self._flight_ring_spill = bool(flight_ring_spill)
+        # Request-scoped spans (raft.request_spans, default off): when on,
+        # propose() reads the ambient trace context (utils/spans.py
+        # contextvar) and the mint/commit/apply sites stamp the span's
+        # phase rungs. The off path is this single bool in propose().
+        self._request_spans = bool(request_spans)
         # Pipelined-tick state: the in-flight tick handle (tick_pipelined's
         # double buffer), the dispatch-in-flight flag (True from tick_begin
         # until the tick's device fetch materializes), and host-side
@@ -885,9 +897,19 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         if is_conf(payload) and group != 0:
             fut.set_exception(ValueError("conf changes must go through group 0"))
             return fut
+        span = None
+        if self._request_spans:
+            # Trace context (utils/spans.py): the broker's frame decode or
+            # the driver's submit bound a RequestSpan on this task; submit
+            # time closes its admission phase and opens the queue phase.
+            span = current_span()
+            if span is not None:
+                span.mark("admitted", self._ticks)
+                span.group = group
         # The third slot is the submit device tick — tick_finish stamps it
         # onto the minted block for the proposal→commit latency histogram.
-        self._proposals.setdefault(group, []).append((payload, fut, self._ticks))
+        self._proposals.setdefault(group, []).append(
+            (payload, fut, self._ticks, span))
         self._prop_groups.add(group)
         return fut
 
@@ -1703,7 +1725,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 # self._proposals, so nothing else will ever resolve them
                 # and a produce awaiting one would hang forever (found by
                 # the workload driver's delete-under-live-traffic soak).
-                for _payload, fut, _t_sub in props.pop(g, ()):
+                for _payload, fut, _t_sub, _span in props.pop(g, ()):
                     if fut is not None and not fut.done():
                         fut.set_exception(NotLeader(g, -1))
                 continue
@@ -1747,7 +1769,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                         f"device minted {minted[pos]} blocks but host holds "
                         f"{len(queue)} payloads (group {g})"
                     )
-                for payload, fut, t_sub in queue:
+                for payload, fut, t_sub, span in queue:
                     conf_err = None
                     if is_conf(payload):
                         # Leader-side conf admission: assign the slot, and
@@ -1765,6 +1787,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     blk = ch.append(int(n_term[pos]), payload)
                     if ring is not None:
                         ring_pend.setdefault(g, []).append(blk)
+                    if span is not None:
+                        # Queue phase closes at mint; the span rides the
+                        # latency deque to the commit site. A retried
+                        # request re-marks (last mint wins) — the phases
+                        # describe the attempt that finally commits.
+                        span.mark("minted", t_now)
+                        span.leader = self.self_id
                     # Open a commit-latency entry for the minted block
                     # (block ids are appended in mint order, so the deque
                     # stays id-sorted; commit advancement below resolves or
@@ -1772,7 +1801,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     lat_q = self._lat_open.get(g)
                     if lat_q is None:
                         lat_q = self._lat_open[g] = deque(maxlen=4096)
-                    lat_q.append((blk.id, t_sub))
+                    lat_q.append((blk.id, t_sub, span))
                     drv = self.drivers.get(g)
                     if is_conf(payload):
                         self._conf_pending = blk.id
@@ -1787,7 +1816,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                             fut.set_result(b"")
                 props.pop(g, None)
             elif queue:
-                for _, fut, _ in queue:
+                for _, fut, _, _ in queue:
                     if fut is not None and not fut.done():
                         fut.set_exception(NotLeader(g, int(n_leader[pos])))
                 props.pop(g, None)
@@ -1827,6 +1856,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                 blocks = ch.commit(new_commit)
                 res.committed[g] = new_commit
                 _m_committed.inc(len(blocks), node=self.self_id)
+                committed_spans = []
                 lat_q = self._lat_open.get(g)
                 if lat_q:
                     # Leader-side commit latency: every open mint entry the
@@ -1836,9 +1866,15 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                     cids = {b.id for b in blocks}
                     tag = self._group_tags.get(g)
                     while lat_q and lat_q[0][0] <= new_commit:
-                        bid, t_sub = lat_q.popleft()
+                        bid, t_sub, span = lat_q.popleft()
                         if bid in cids:
                             self._h_commit_lat.observe(t_now - t_sub)
+                            if span is not None:
+                                # Consensus phase closes here; the apply
+                                # rung lands after drv.apply below (same
+                                # t_now — apply runs inside this finish).
+                                span.mark("committed", t_now)
+                                committed_spans.append(span)
                             if tag is not None:
                                 _m_commit_lat_tenant.observe(
                                     t_now - t_sub, node=self.self_id,
@@ -1871,6 +1907,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
                         self._h_commit[g] = GENESIS
                         reset_rows.add(g)
                         continue
+                for span in committed_spans:
+                    span.mark("applied", t_now)
 
             # Refresh the chain mirrors for this group (the active-row
             # selector above diffs against these next tick).

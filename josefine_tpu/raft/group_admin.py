@@ -149,7 +149,7 @@ class GroupAdmin:
         # fail their futures (NotLeader — the client re-routes/retries)
         # instead of dropping them silently, which left produce awaits
         # hanging until their transport timeout.
-        for _payload, fut, _t_sub in self._proposals.pop(g, ()):
+        for _payload, fut, _t_sub, _span in self._proposals.pop(g, ()):
             if fut is not None and not fut.done():
                 fut.set_exception(NotLeader(g, -1))
         self._prop_groups.discard(g)

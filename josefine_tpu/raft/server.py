@@ -95,6 +95,7 @@ class JosefineRaft:
             flight_ring=getattr(config, "flight_ring", 4096),
             flight_wire=getattr(config, "flight_wire", False),
             flight_ring_spill=getattr(config, "flight_ring_spill", False),
+            request_spans=getattr(config, "request_spans", False),
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

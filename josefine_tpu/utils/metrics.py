@@ -53,6 +53,18 @@ log = get_logger("metrics")
 OVERFLOW = "_other"
 
 
+def _esc_label(v) -> str:
+    """Prometheus label-value escaping: ``\\`` → ``\\\\``, ``"`` → ``\\"``,
+    newline → ``\\n`` (the text-exposition rules). Tenant/topic labels are
+    CLIENT-DRIVEN strings — an unescaped quote or newline in one label
+    value corrupts the whole exposition for every scraper."""
+    s = str(v)
+    if "\\" in s or '"' in s or "\n" in s:
+        s = (s.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+    return s
+
+
 def _capped_key(labels: dict, values: dict, max_series: int | None) -> tuple:
     """THE cardinality-cap rule, shared by every metric type: a new label
     set that would overrun ``max_series`` folds into the overflow series
@@ -289,7 +301,7 @@ class Histogram:
             if not Registry._visible(key, node):
                 continue
             emitted = True
-            base = ",".join(f'{k}="{v}"' for k, v in key)
+            base = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
             sep = "," if base else ""
             cum = 0
             for i, c in enumerate(s.buckets):
@@ -459,7 +471,7 @@ class Registry:
                     continue
                 emitted = True
                 if key:
-                    lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                    lbl = ",".join(f'{k}="{_esc_label(v)}"' for k, v in key)
                     lines.append(f"{name}{{{lbl}}} {val}")
                 else:
                     lines.append(f"{name} {val}")
@@ -488,18 +500,23 @@ class MetricsServer:
     consensus flight-recorder journal from ``events_fn``; supports
     ``?limit=N``, ``?kind=K``, ``?group=G`` filters and a ``?since=SEQ``
     cursor — events strictly after that seq, so pollers resume instead of
-    re-downloading the ring), ``/healthz``.
+    re-downloading the ring), ``/traces`` (retained request span trees
+    from ``traces_fn`` — utils/spans.py, ``raft.request_spans``; supports
+    ``?tenant=T``, ``?phase=P`` (dominant phase), ``?limit=N`` and a
+    ``?since=RID`` cursor), ``/healthz``.
     """
 
     def __init__(self, host: str, port: int,
                  state_fn: Callable[[], dict] | None = None,
                  registry: Registry | None = None,
                  node: int | None = None,
-                 events_fn: Callable[[], list] | None = None):
+                 events_fn: Callable[[], list] | None = None,
+                 traces_fn: Callable[[], list] | None = None):
         self.host = host
         self.port = port
         self.state_fn = state_fn
         self.events_fn = events_fn
+        self.traces_fn = traces_fn
         self.registry = registry or REGISTRY
         # Scope the exposition to this node's series (multi-node-per-process
         # deployments share the module-global registry).
@@ -518,32 +535,55 @@ class MetricsServer:
             self._server.close()
             await self._server.wait_closed()
 
-    def _events_body(self, query: str) -> bytes:
-        from josefine_tpu.utils.flight import filter_events
-
-        events = list(self.events_fn()) if self.events_fn else []
+    @staticmethod
+    def _query_params(query: str) -> dict:
+        """One parser for every filtered route (/events, /traces)."""
         params = {}
         for part in query.split("&"):
             if "=" in part:
                 k, _, v = part.partition("=")
                 params[k] = v
-        def _int(v):
-            # Malformed numeric params (e.g. group=--5) ignore the filter
-            # instead of unwinding through _serve with no response bytes.
-            try:
-                return int(v)
-            except (TypeError, ValueError):
-                return None
+        return params
 
-        limit = _int(params.get("limit"))
+    @staticmethod
+    def _qint(v):
+        """Malformed numeric params (e.g. group=--5) ignore the filter
+        instead of unwinding through _serve with no response bytes — the
+        shared rule for every filtered route."""
+        try:
+            return int(v)
+        except (TypeError, ValueError):
+            return None
+
+    def _events_body(self, query: str) -> bytes:
+        from josefine_tpu.utils.flight import filter_events
+
+        events = list(self.events_fn()) if self.events_fn else []
+        params = self._query_params(query)
+        limit = self._qint(params.get("limit"))
         events = filter_events(
             events,
             kind=params.get("kind") or None,
-            group=_int(params.get("group")),
+            group=self._qint(params.get("group")),
             limit=limit if limit is not None and limit >= 0 else None,
-            since=_int(params.get("since")),
+            since=self._qint(params.get("since")),
         )
         return json.dumps({"node": self.node, "events": events}).encode()
+
+    def _traces_body(self, query: str) -> bytes:
+        from josefine_tpu.utils.spans import filter_traces
+
+        traces = list(self.traces_fn()) if self.traces_fn else []
+        params = self._query_params(query)
+        limit = self._qint(params.get("limit"))
+        traces = filter_traces(
+            traces,
+            tenant=params.get("tenant") or None,
+            phase=params.get("phase") or None,
+            since=self._qint(params.get("since")),
+            limit=limit if limit is not None and limit >= 0 else None,
+        )
+        return json.dumps({"node": self.node, "traces": traces}).encode()
 
     async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
@@ -566,6 +606,10 @@ class MetricsServer:
                 status = "200 OK"
             elif path == "/events":
                 body = self._events_body(query)
+                ctype = "application/json"
+                status = "200 OK"
+            elif path == "/traces":
+                body = self._traces_body(query)
                 ctype = "application/json"
                 status = "200 OK"
             elif path == "/healthz":

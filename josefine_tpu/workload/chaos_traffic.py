@@ -28,6 +28,7 @@ is simply never acked, which the checkers allow.
 from __future__ import annotations
 
 from josefine_tpu.utils.metrics import REGISTRY
+from josefine_tpu.utils.spans import SpanLedger, bind_span, unbind_span
 from josefine_tpu.workload.model import TenantModel, WorkloadSpec
 from josefine_tpu.workload.schedule import (
     AdmissionState,
@@ -43,11 +44,22 @@ _m_retries = REGISTRY.counter("workload_retries_total")
 class ChaosTraffic:
     """Drives workload arrivals as proposals inside a ChaosCluster."""
 
-    def __init__(self, spec: WorkloadSpec, seed: int, groups: int):
+    def __init__(self, spec: WorkloadSpec, seed: int, groups: int,
+                 spans=None):
         self.spec = spec.validate()
         self.model = TenantModel(spec)
         self.sched = ArrivalSchedule(spec, seed)
         self.groups = groups
+        # Request-span recorder (utils/spans.py), chaos flavor: one span
+        # per produce REQUEST (not per attempt), minted at first enqueue,
+        # bound around the leader's propose() so the engine stamps the
+        # consensus rungs, finished at harvest. The soak holds
+        # spans.fault_active True for the chaotic phase, so every request
+        # the nemesis touched is retained alongside the tail sample. The
+        # bookkeeping is the shared SpanLedger — the same one-span-per-
+        # (tenant, seq) invariant the in-process driver maintains.
+        self.spans = spans
+        self._ledger = SpanLedger(spans)
         # Partition -> chaos group: global partition index modulo G (the
         # harness's groups are all data groups; no metadata row here).
         self._ppt = spec.partitions_per_topic
@@ -93,8 +105,14 @@ class ChaosTraffic:
 
     def _enqueue(self, arr: ProduceArrival, attempt: int,
                  first: int) -> None:
+        if self._ledger and attempt == 0:
+            self._ledger.open(
+                (arr.tenant, arr.seq), "produce",
+                tenant=TenantModel.tenant_label(arr.tenant),
+                topic=arr.topic, partition=arr.partition)
         if not self._adm.enqueue(arr, attempt, first):
             self.n_shed += 1
+            self._ledger.finish((arr.tenant, arr.seq), "shed")
 
     def _admit(self, cluster, t: int, arr: ProduceArrival, attempt: int,
                first: int) -> None:
@@ -111,7 +129,17 @@ class ChaosTraffic:
             self._retry(t, arr, attempt, first)
             return
         payload = self._payload(arr, attempt)
-        fut = leader.propose(g, payload)
+        span = self._ledger.get((arr.tenant, arr.seq))
+        if span is not None:
+            # Synchronous bind/unbind around the propose — the adapter
+            # runs on the soak loop, not in a per-request task.
+            tok = bind_span(span)
+            try:
+                fut = leader.propose(g, payload)
+            finally:
+                unbind_span(tok)
+        else:
+            fut = leader.propose(g, payload)
         cluster.submit_tick[payload] = t
         cluster.proposed += 1
         self.n_admitted += 1
@@ -122,6 +150,7 @@ class ChaosTraffic:
         if not self._adm.schedule_retry(t, arr, attempt, first,
                                         self.sched.retry_delay):
             self.n_gave_up += 1
+            self._ledger.finish((arr.tenant, arr.seq), "gave_up")
             return
         self.n_retries += 1
         _m_retries.inc()
@@ -146,9 +175,18 @@ class ChaosTraffic:
             self.n_acked += 1
             lat = t - first
             self.latencies.append((arr.tenant, lat))
+            self._ledger.finish((arr.tenant, arr.seq), "ok")
             _m_lat.observe(lat,
                            tenant=TenantModel.tenant_label(arr.tenant))
         self.pending = still
+
+    def close_spans(self, status: str = "aborted") -> None:
+        """End-of-soak epilogue: finish every span still open — requests
+        the fault plane stranded (futures that never resolve) or retries
+        still delayed when the horizon ran out. These are exactly the
+        requests the fault-arm sampling exists to retain, so they must
+        land in the artifact, not leak as open entries."""
+        self._ledger.close_all(status)
 
     # ----------------------------------------------------------- summary
 

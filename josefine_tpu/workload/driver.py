@@ -52,6 +52,7 @@ from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import NotLeader, RaftEngine
 from josefine_tpu.utils.kv import MemKV
 from josefine_tpu.utils.metrics import REGISTRY, Histogram, Registry
+from josefine_tpu.utils.spans import SpanLedger, SpanRecorder, bind_span
 from josefine_tpu.utils.tracing import get_logger
 from josefine_tpu.workload.model import TenantModel, WorkloadSpec
 from josefine_tpu.workload.schedule import (
@@ -198,7 +199,9 @@ class TrafficEngine:
                  hb_ticks: int = 1, backend: str = "jax",
                  max_group_inflight: int | None = None,
                  replication: int = 1, device_route: bool = False,
-                 payload_ring: bool = False):
+                 payload_ring: bool = False,
+                 request_spans: bool = False,
+                 span_capacity: int = 4096):
         self.spec = spec.validate()
         self.seed = seed
         self.model = TenantModel(spec)
@@ -227,7 +230,20 @@ class TrafficEngine:
             self.kv, node_ids, 1, groups=P, fsms={0: self.fsm},
             params=step_params(timeout_min=3, timeout_max=8,
                                hb_ticks=hb_ticks),
-            base_seed=seed, backend=backend, active_set=active_set)
+            base_seed=seed, backend=backend, active_set=active_set,
+            request_spans=request_spans)
+        # Request spans (in-process trace context: minted at first
+        # enqueue — the "driver submit" of the wire path's frame decode —
+        # finished at response harvest; every mark rides the ENGINE tick
+        # axis via _flight_tick so phases are device-tick-denominated and
+        # a tree's phases sum to its observed latency by construction).
+        self.spans = (SpanRecorder(capacity=span_capacity,
+                                   clock=self.engine._flight_tick)
+                      if request_spans else None)
+        # One-span-per-request bookkeeping, shared with the chaos
+        # traffic adapter (utils/spans.SpanLedger — inert when spans
+        # are off).
+        self._ledger = SpanLedger(self.spans)
         self.peers = [
             RaftEngine(MemKV(), node_ids, nid, groups=P,
                        params=step_params(timeout_min=1 << 20,
@@ -480,6 +496,14 @@ class TrafficEngine:
             self._ack_tasks = []
             self._adm.clear()
             self.trace.emit(self.tick, "drain_aborted", pending=aborted)
+        if self._ledger:
+            # Anything still open after the drain epilogue was aborted
+            # with its task — close the spans so the recorder's open
+            # count drains to zero and the dump covers them; then seal
+            # the sampling window (end of run = end of measurement), so
+            # summary()/dump describe the same retained set.
+            self._ledger.close_all()
+            self.spans.seal()
 
     async def _tick_once(self, offer: bool = True) -> None:
         t = self.tick
@@ -515,11 +539,20 @@ class TrafficEngine:
 
     def _enqueue(self, arr: ProduceArrival, attempt: int,
                  first_tick: int) -> None:
+        if self._ledger and attempt == 0:
+            # In-process trace context, one per REQUEST (not per attempt):
+            # admission stretches over every backpressure refusal and
+            # retry backoff until the attempt that finally submits.
+            self._ledger.open(
+                (arr.tenant, arr.seq), "produce",
+                tenant=TenantModel.tenant_label(arr.tenant),
+                topic=arr.topic, partition=arr.partition)
         if not self._adm.enqueue(arr, attempt, first_tick):
             self.n_shed += 1
             _m_shed.inc()
             self.trace.emit(self.tick, "shed", tenant=arr.tenant,
                             seq=arr.seq)
+            self._ledger.finish((arr.tenant, arr.seq), "shed")
 
     def _admit(self, arr: ProduceArrival, attempt: int,
                first_tick: int) -> None:
@@ -532,6 +565,12 @@ class TrafficEngine:
         self._inflight.append(_Flight(task, arr, attempt, first_tick))
 
     async def _produce(self, arr: ProduceArrival) -> tuple[int, int]:
+        if self._ledger:
+            # Task-local bind: the engine's propose() (reached through the
+            # real broker handler stack) stamps the span's rungs.
+            span = self._ledger.get((arr.tenant, arr.seq))
+            if span is not None:
+                bind_span(span)
         batch = records.build_batch(arr.payload(self.spec),
                                     self.spec.records_per_batch)
         resp = await self.broker.produce(3, {
@@ -569,10 +608,12 @@ class TrafficEngine:
                 else:
                     self.trace.emit(t, "dropped", tenant=arr.tenant,
                                     seq=arr.seq, reason="topic_gone")
+                    self._ledger.finish((arr.tenant, arr.seq), "dropped")
             else:
                 self.n_errors += 1
                 self.trace.emit(t, "produce_err", tenant=arr.tenant,
                                 seq=arr.seq, code=code)
+                self._ledger.finish((arr.tenant, arr.seq), "error")
         self._inflight = still
 
         still_c = []
@@ -610,6 +651,7 @@ class TrafficEngine:
             self.n_direct += 1
         self.trace.emit(t, "produce_ok", tenant=arr.tenant, seq=arr.seq,
                         base=base, lat=lat)
+        self._ledger.finish((arr.tenant, arr.seq), "ok")
 
     def _schedule_retry(self, t: int, f: _Flight) -> None:
         if not self._adm.schedule_retry(t, f.arr, f.attempt, f.first_tick,
@@ -617,6 +659,7 @@ class TrafficEngine:
             self.n_gave_up += 1
             self.trace.emit(t, "gave_up", tenant=f.arr.tenant,
                             seq=f.arr.seq)
+            self._ledger.finish((f.arr.tenant, f.arr.seq), "gave_up")
             return
         self.n_retries += 1
         _m_retries.inc()
@@ -682,6 +725,13 @@ class TrafficEngine:
         parts = self._assignment(c.tenant, c)
         if not parts:
             return
+        span = None
+        if self.spans is not None:
+            # Read-path span: the fetch never reaches propose(), so the
+            # middle rungs collapse and serve carries the whole latency —
+            # closing the read path the flight plane never sees.
+            span = self.spans.begin(
+                "fetch", tenant=TenantModel.tenant_label(c.tenant))
         by_topic: dict[str, list[dict]] = {}
         for topic, p in parts:
             by_topic.setdefault(topic, []).append({
@@ -721,6 +771,8 @@ class TrafficEngine:
             _m_fetched.inc(total)
             self.trace.emit(t, "fetch", tenant=c.tenant, consumer=c.idx,
                             parts=n_parts, bytes=total)
+        if span is not None:
+            self.spans.finish(span, status="ok")
 
     def _commit_offsets(self, c: _Consumer) -> None:
         if not c.offsets:
@@ -730,13 +782,32 @@ class TrafficEngine:
             by_topic.setdefault(topic, []).append(
                 {"partition_index": p, "committed_offset": off,
                  "committed_metadata": None})
-        task = asyncio.ensure_future(self.broker.offset_commit(1, {
+        coro = self.broker.offset_commit(1, {
             "group_id": f"cg-{TenantModel.tenant_label(c.tenant)}",
             "generation_id": -1, "member_id": "", "retention_time_ms": -1,
             "topics": [{"name": name, "partitions": plist}
                        for name, plist in sorted(by_topic.items())],
-        }))
+        })
+        if self.spans is not None:
+            # Consumer-group write path: offset commits replicate through
+            # the metadata group, so their spans traverse the full ladder.
+            coro = self._spanned(coro, self.spans.begin(
+                "offset_commit",
+                tenant=TenantModel.tenant_label(c.tenant)))
+        task = asyncio.ensure_future(coro)
         self._commit_tasks.append((c.tenant, task))
+
+    async def _spanned(self, coro, span):
+        """Run ``coro`` with ``span`` as the task's trace context and
+        finish it on completion (idempotent-finish makes the error arm a
+        no-op after a clean close)."""
+        bind_span(span)
+        try:
+            r = await coro
+            self.spans.finish(span, status="ok")
+            return r
+        finally:
+            self.spans.finish(span, status="error")
 
     # --------------------------------------------------------- recycling
 
@@ -851,4 +922,10 @@ class TrafficEngine:
             "recycle_acks": self.n_recycle_acks,
             "trace_events": len(self.trace.events),
             "trace_sha256": self.trace.sha256(),
+            # Request-span epilogue (raft.request_spans): request counts,
+            # sampling stats, and the aggregate where-did-the-ticks-go
+            # split; the full per-tenant table rides the --spans-out
+            # artifact (tools/traffic_soak.py), not every bench row.
+            "span_summary": (self.spans.summary()
+                             if self.spans is not None else None),
         }

@@ -233,7 +233,7 @@ def test_engine_latency_not_observed_for_uncommitted(tmp_path):
             e.tick()
         before = hist.count(node=43)
         # Open an entry by hand, then recycle the row out from under it.
-        e._lat_open[1] = __import__("collections").deque([(123, 0)])
+        e._lat_open[1] = __import__("collections").deque([(123, 0, None)])
         e.recycle_group(1)
         assert 1 not in e._lat_open
         for _ in range(5):

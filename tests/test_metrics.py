@@ -43,6 +43,33 @@ def test_counter_get_or_create_is_idempotent():
     assert a is b
 
 
+def test_prometheus_label_values_escaped():
+    """Tenant/topic labels are CLIENT-DRIVEN strings: a quote, backslash,
+    or newline in a label value must render escaped per the Prometheus
+    text-exposition rules, not corrupt the whole exposition."""
+    reg = Registry()
+    c = reg.counter("evil_total")
+    c.inc(tenant='he said "hi"')
+    c.inc(tenant="back\\slash")
+    c.inc(tenant="two\nlines")
+    h = reg.histogram("evil_lat")
+    h.observe(3, topic='q"t')
+    text = reg.render_prometheus()
+    assert 'evil_total{tenant="he said \\"hi\\""} 1' in text
+    assert 'evil_total{tenant="back\\\\slash"} 1' in text
+    assert 'evil_total{tenant="two\\nlines"} 1' in text
+    # Histogram series go through the same escaping.
+    assert 'evil_lat_bucket{topic="q\\"t",le="4"} 1' in text
+    # No raw newline may survive inside a sample: each evil_total series
+    # renders as exactly ONE exposition line (a raw newline in the
+    # two\nlines value would split its sample across two lines).
+    assert len([ln for ln in text.splitlines()
+                if ln.startswith("evil_total{")]) == 3
+    # Benign values render unescaped, byte-for-byte as before.
+    c.inc(tenant="t0001")
+    assert 'evil_total{tenant="t0001"} 1' in reg.render_prometheus()
+
+
 def test_engine_increments_metrics():
     kv = MemKV()
     e = RaftEngine(kv, [99], 99, groups=2, params=PARAMS)

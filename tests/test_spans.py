@@ -1,0 +1,488 @@
+"""Request-scoped span layer (utils/spans.py + the engine/broker/driver
+threading): ladder arithmetic, deterministic tail sampling, the /traces
+endpoint, the tracing->flight bridge, and the zero-emission gating
+contract (mirroring test_flight_merge's flight_wire gating test)."""
+
+import asyncio
+import json
+import logging
+
+import pytest
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.utils.flight import FlightRecorder
+from josefine_tpu.utils.kv import MemKV
+from josefine_tpu.utils.metrics import MetricsServer
+from josefine_tpu.utils.spans import (
+    PHASES,
+    SpanRecorder,
+    bind_span,
+    current_span,
+    filter_traces,
+    unbind_span,
+)
+from josefine_tpu.utils.tracing import (
+    attach_flight_journal,
+    detach_flight_journal,
+    get_logger,
+)
+
+PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
+
+
+class _Fsm:
+    def transition(self, data: bytes) -> bytes:
+        return b"ok"
+
+
+# ------------------------------------------------------------ ladder math
+
+
+def test_phases_telescope_to_latency():
+    rec = SpanRecorder()
+    s = rec.begin("produce", tenant="t0001", tick=10)
+    s.mark("admitted", 12)
+    s.mark("minted", 15)
+    s.mark("committed", 19)
+    s.mark("applied", 19)
+    rec.finish(s, tick=21)
+    ph = s.phases()
+    assert ph == {"admission": 2, "queue": 3, "consensus": 4, "apply": 0,
+                  "serve": 2}
+    assert sum(ph.values()) == s.latency == 11
+
+
+def test_missing_rungs_collapse_and_still_sum():
+    """A read-path span (fetch) never traverses the middle rungs: they
+    collapse to zero at the previous boundary and serve carries all."""
+    rec = SpanRecorder()
+    s = rec.begin("fetch", tick=5)
+    rec.finish(s, tick=9)
+    ph = s.phases()
+    assert ph["serve"] == 4 and sum(ph.values()) == 4
+    assert all(ph[p] == 0 for p in PHASES[:-1])
+
+
+def test_out_of_range_marks_clamp_never_negative():
+    """A rung outside [begin, end] (an engine whose tick counter restarted
+    mid-request under chaos) clamps — phases stay non-negative and still
+    telescope to the observed latency."""
+    rec = SpanRecorder()
+    s = rec.begin("produce", tick=100)
+    s.mark("admitted", 3)      # below begin
+    s.mark("minted", 9999)     # above end
+    s.mark("committed", 104)
+    rec.finish(s, tick=106)
+    ph = s.phases()
+    assert all(v >= 0 for v in ph.values())
+    assert sum(ph.values()) == s.latency == 6
+
+
+def test_finish_is_idempotent():
+    rec = SpanRecorder()
+    s = rec.begin("produce", tick=0)
+    rec.finish(s, tick=4, status="ok")
+    rec.finish(s, tick=9, status="error")  # must not re-count or restamp
+    assert s.status == "ok" and s.end == 4
+    assert rec.finished == 1
+
+
+# ------------------------------------------------------- tail sampling
+
+
+def test_tail_sampling_keeps_slowest_k_per_window():
+    rec = SpanRecorder(sample_top_k=2, window_ticks=100)
+    lats = [3, 9, 1, 9, 5]  # two 9s: tie breaks by rid (first wins a slot)
+    for i, lat in enumerate(lats):
+        s = rec.begin("produce", tenant=f"t{i}", tick=0)
+        rec.finish(s, tick=lat)
+    # Crossing the window boundary seals window 0.
+    s = rec.begin("produce", tick=100)
+    rec.finish(s, tick=101)
+    sealed = [t for t in rec.traces() if t["end"] <= 100 and t["begin"] == 0]
+    assert [t["lat"] for t in sealed] == [9, 9]
+    assert all(t["sampled"] == "tail" for t in sealed)
+    assert rec.finished == 6
+
+
+def test_fault_window_and_errors_retained_beyond_top_k():
+    rec = SpanRecorder(sample_top_k=1, window_ticks=50)
+    rec.fault_active = True
+    fast = rec.begin("produce", tick=0)
+    rec.finish(fast, tick=1)
+    rec.fault_active = False
+    slow = rec.begin("produce", tick=0)
+    rec.finish(slow, tick=30)
+    err = rec.begin("produce", tick=0)
+    rec.finish(err, tick=2, status="gave_up")
+    rec.seal()
+    by_rid = {t["rid"]: t for t in rec.traces()}
+    assert by_rid[fast.rid]["sampled"] == "fault"  # armed-fault retention
+    assert by_rid[slow.rid]["sampled"] == "tail"
+    assert by_rid[err.rid]["sampled"] == "error"
+
+
+def test_benign_statuses_do_not_flood_retention():
+    """Routine non-ok outcomes (acks=0 'no_response', client-asked
+    'closed') must NOT ride the failure-retention arm — a sustained
+    acks=0 producer would otherwise wrap the ring and evict the tail and
+    fault samples the recorder exists to keep."""
+    rec = SpanRecorder(sample_top_k=1, window_ticks=10)
+    slow = rec.begin("produce", tick=0)
+    rec.finish(slow, tick=9)
+    for _ in range(20):
+        s = rec.begin("produce", tick=0)
+        rec.finish(s, tick=1, status="no_response")
+    s = rec.begin("fetch", tick=0)
+    rec.finish(s, tick=1, status="closed")
+    rec.seal()
+    kept = rec.traces()
+    assert [t["rid"] for t in kept] == [slow.rid]  # only the tail winner
+    # Benign spans still count in the aggregate — nothing is dropped.
+    assert rec.phase_totals()["count"] == 22
+
+
+def test_dump_jsonl_deterministic_and_sealing():
+    def run():
+        rec = SpanRecorder(sample_top_k=2, window_ticks=10)
+        for i in range(25):
+            s = rec.begin("produce", tenant=f"t{i % 3}", tick=i)
+            s.mark("admitted", i)
+            rec.finish(s, tick=i + (i * 7) % 5)
+        return rec
+    a, b = run(), run()
+    assert a.dump_jsonl() == b.dump_jsonl() != ""
+    # dump seals the open window: every retained line is a sealed trace.
+    for line in a.dump_jsonl().splitlines():
+        assert json.loads(line)["sampled"] is not None
+
+
+def test_aggregate_folds_past_series_cap():
+    rec = SpanRecorder(agg_series=3)
+    for i in range(6):
+        s = rec.begin("produce", tenant=f"t{i:04d}", tick=0)
+        rec.finish(s, tick=2)
+    table = rec.phase_table()
+    assert len(table) <= 3 and "_other/produce" in table
+    # Totals stay exact: nothing dropped by the fold.
+    assert sum(r["count"] for r in table.values()) == 6
+    assert rec.phase_totals()["count"] == 6
+
+
+def test_aggregate_bounded_under_hostile_kinds():
+    """The span KIND is client-controlled too (the broker labels unknown
+    api keys 'api_<n>'): a client cycling arbitrary kinds past the cap
+    must not mint one overflow row per kind — everything folds into ONE
+    (_other, _other) row and the table stays bounded."""
+    rec = SpanRecorder(agg_series=4)
+    for i in range(50):
+        s = rec.begin(f"api_{i}", tenant=f"evil{i}", tick=0)
+        rec.finish(s, tick=1)
+    table = rec.phase_table()
+    assert len(table) <= 5, sorted(table)  # cap + the terminal fold row
+    assert "_other/_other" in table
+    assert rec.phase_totals()["count"] == 50  # totals still exact
+
+
+# ----------------------------------------------------------- filtering
+
+
+def _mk_traces():
+    rec = SpanRecorder(window_ticks=1000, sample_top_k=10)
+    specs = [("t0", 0, 5, {"admitted": 1, "minted": 4}),    # consensus-ish
+             ("t1", 0, 8, {}),                               # serve-heavy
+             ("t0", 0, 2, {"admitted": 2, "minted": 2,
+                           "committed": 2, "applied": 2})]   # admission
+    for tenant, b, e, marks in specs:
+        s = rec.begin("produce", tenant=tenant, tick=b)
+        for k, v in marks.items():
+            s.mark(k, v)
+        rec.finish(s, tick=e)
+    rec.seal()
+    return rec
+
+
+def test_filter_traces_by_tenant_phase_since_limit():
+    rec = _mk_traces()
+    all_t = rec.traces()
+    assert len(all_t) == 3
+    assert [t["tenant"] for t in rec.traces(tenant="t0")] == ["t0", "t0"]
+    # Dominant-phase filter: trace 1 has everything in serve.
+    serve = rec.traces(phase="serve")
+    assert [t["rid"] for t in serve] == [1]
+    # since is a rid cursor, strictly after.
+    assert [t["rid"] for t in rec.traces(since=0)] == [1, 2]
+    assert rec.traces(limit=0) == []
+    assert [t["rid"] for t in rec.traces(limit=1)] == [2]
+    # Shared implementation sanity: filter_traces on raw dicts.
+    assert filter_traces(all_t, tenant="t1")[0]["rid"] == 1
+
+
+# ----------------------------------------------- engine mark threading
+
+
+def test_engine_marks_rungs_with_spans_on():
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=2, fsms={0: _Fsm()},
+                       params=PARAMS, request_spans=True)
+        rec = SpanRecorder(clock=e._flight_tick)
+        span = None
+        for i in range(20):
+            if span is None and e.is_leader(0):
+                span = rec.begin("produce", tenant="t0001")
+                tok = bind_span(span)
+                fut = e.propose(0, b"payload")
+                unbind_span(tok)
+            e.tick()
+            await asyncio.sleep(0)
+        assert span is not None and fut.done() and not fut.exception()
+        rec.finish(span, status="ok")
+        ev = span.to_event()
+        assert {"admitted", "minted", "committed", "applied"} <= set(
+            ev["marks"])
+        assert ev["group"] == 0 and ev["leader"] == 1
+        assert sum(ev["phases"].values()) == ev["lat"]
+        # current_span is task-local and unbound after the propose.
+        assert current_span() is None
+    asyncio.run(main())
+
+
+def test_engine_ignores_span_context_when_off():
+    """Zero-emission gating, engine side: with request_spans off the
+    ambient context is never read — a bound span stays unmarked."""
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=1, fsms={0: _Fsm()},
+                       params=PARAMS)  # request_spans defaults off
+        rec = SpanRecorder(clock=e._flight_tick)
+        for _ in range(12):
+            e.tick()
+            await asyncio.sleep(0)
+        s = rec.begin("produce")
+        tok = bind_span(s)
+        fut = e.propose(0, b"x")
+        unbind_span(tok)
+        for _ in range(5):
+            e.tick()
+            await asyncio.sleep(0)
+        assert fut.done() and not fut.exception()
+        assert s.marks == {}, "spans-off engine must not touch the context"
+    asyncio.run(main())
+
+
+def test_recycle_drops_open_span_entries():
+    """A recycled row's queued proposals fail NotLeader; their spans'
+    latency entries are purged with the queue (no applied mark ever)."""
+    async def main():
+        e = RaftEngine(MemKV(), [1], 1, groups=2, fsms={0: _Fsm()},
+                       params=PARAMS, request_spans=True)
+        rec = SpanRecorder(clock=e._flight_tick)
+        for _ in range(12):
+            e.tick()
+            await asyncio.sleep(0)
+        s = rec.begin("produce")
+        tok = bind_span(s)
+        fut = e.propose(1, b"x")
+        unbind_span(tok)
+        e.recycle_group(1)
+        await asyncio.sleep(0)
+        assert fut.done() and fut.exception() is not None
+        assert "committed" not in s.marks
+    asyncio.run(main())
+
+
+# ------------------------------------------------- driver zero-emission
+
+
+def _small_spec():
+    from josefine_tpu.workload.model import WorkloadSpec
+
+    return WorkloadSpec.from_axes(2, 4, 1.1, 3.0)
+
+
+def _run_driver(request_spans: bool):
+    from josefine_tpu.workload.driver import TrafficEngine
+
+    drv = TrafficEngine(_small_spec(), seed=13,
+                        request_spans=request_spans)
+    asyncio.run(drv.run(25))
+    return drv
+
+
+def test_spans_off_traffic_soak_emits_nothing_and_matches_on():
+    """The overhead contract's zero side (mirror of test_flight_merge's
+    flight_wire gating test): with raft.request_spans off a steady-state
+    traffic soak mints no recorder and adds no per-request work — and the
+    spans-ON twin of the same (spec, seed) produces a byte-identical
+    workload trace, so the span plane provably never perturbs the run."""
+    off = _run_driver(False)
+    assert off.spans is None
+    assert not off._ledger and off._ledger._by == {}
+    on = _run_driver(True)
+    assert off.trace.jsonl() == on.trace.jsonl()
+    assert off.summary()["span_summary"] is None
+    s = on.summary()["span_summary"]
+    assert s["requests"] > 0 and s["open"] == 0
+    assert s["phase_totals"]["count"] == s["requests"]
+
+
+def test_same_seed_span_logs_byte_identical():
+    a = _run_driver(True)
+    b = _run_driver(True)
+    dump_a, dump_b = a.spans.dump_jsonl(), b.spans.dump_jsonl()
+    assert dump_a == dump_b != ""
+    # Every retained tree's phases sum to its observed latency — the
+    # acceptance property request_report re-checks per tree.
+    for line in dump_a.splitlines():
+        t = json.loads(line)
+        assert sum(t["phases"].values()) == t["lat"]
+    # A committed produce carries the full ladder + join keys.
+    ok = [json.loads(l) for l in dump_a.splitlines()
+          if json.loads(l)["status"] == "ok"
+          and json.loads(l)["kind"] == "produce"]
+    assert ok, "no committed produce retained"
+    assert ok[0]["group"] >= 1 and ok[0]["leader"] == 1
+
+
+# --------------------------------------------------- /traces endpoint
+
+
+def test_traces_endpoint_filters_over_http():
+    async def main():
+        rec = _mk_traces()
+        srv = MetricsServer("127.0.0.1", 0, node=1, traces_fn=rec.traces)
+        port = await srv.start()
+
+        async def get(path):
+            r, w = await asyncio.open_connection("127.0.0.1", port)
+            w.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await w.drain()
+            raw = await r.read()
+            w.close()
+            return json.loads(raw.partition(b"\r\n\r\n")[2])
+
+        try:
+            body = await get("/traces")
+            assert body["node"] == 1 and len(body["traces"]) == 3
+            assert [t["tenant"] for t in
+                    (await get("/traces?tenant=t0"))["traces"]] == \
+                ["t0", "t0"]
+            assert [t["rid"] for t in
+                    (await get("/traces?phase=serve"))["traces"]] == [1]
+            assert [t["rid"] for t in
+                    (await get("/traces?since=0&limit=1"))["traces"]] == [2]
+            # Malformed numeric params ignore the filter, not the request.
+            assert len((await get("/traces?since=--3"))["traces"]) == 3
+            # No traces_fn wired: the route answers an empty list.
+            srv2 = MetricsServer("127.0.0.1", 0, node=2)
+            p2 = await srv2.start()
+            r, w = await asyncio.open_connection("127.0.0.1", p2)
+            w.write(b"GET /traces HTTP/1.0\r\n\r\n")
+            await w.drain()
+            raw = await r.read()
+            w.close()
+            assert json.loads(raw.partition(b"\r\n\r\n")[2])["traces"] == []
+            await srv2.stop()
+        finally:
+            await srv.stop()
+    asyncio.run(main())
+
+
+# ---------------------------------------------- tracing->flight bridge
+
+
+def test_warning_logs_journal_as_flight_events():
+    flight = FlightRecorder(capacity=64)
+    tick = {"now": 7}
+    handler = attach_flight_journal(flight.emit, lambda: tick["now"])
+    try:
+        lg = get_logger("spans_test")
+        lg.info("steady-state info stays out of the journal")
+        assert len(flight) == 0
+        lg.warning("slow client %s evicted", "t0001")
+        tick["now"] = 9
+        lg.error("handler crashed")
+        evs = flight.events(kind="log_event")
+        assert [e["tick"] for e in evs] == [7, 9]
+        assert evs[0]["detail"]["level"] == "WARNING"
+        assert "t0001" in evs[0]["detail"]["msg"]
+        assert evs[1]["detail"]["level"] == "ERROR"
+        assert evs[0]["detail"]["logger"] == "josefine.spans_test"
+    finally:
+        detach_flight_journal(handler)
+    # Detached: further warnings journal nothing.
+    get_logger("spans_test").warning("after detach")
+    assert len(flight.events(kind="log_event")) == 2
+
+
+def test_bridge_emit_failure_never_raises():
+    def boom(*a, **k):
+        raise RuntimeError("journal full")
+    handler = attach_flight_journal(boom, lambda: 0)
+    handler.handleError = lambda record: None  # silence stderr
+    try:
+        get_logger("spans_test2").warning("must not raise")
+    finally:
+        detach_flight_journal(handler)
+
+
+def test_chaos_traffic_closes_stranded_spans():
+    """Requests the fault plane strands (futures that never resolve)
+    must still land in the span artifact: close_spans finishes every
+    open entry as 'aborted' — they are the fault arm's whole point."""
+    from josefine_tpu.workload.chaos_traffic import ChaosTraffic
+    from josefine_tpu.workload.model import WorkloadSpec
+
+    spec = WorkloadSpec(tenants=1, produce_per_tick=1.0).validate()
+    rec = SpanRecorder()
+    tr = ChaosTraffic(spec, seed=3, groups=2, spans=rec)
+    span = rec.begin("produce", tenant="t0000", tick=0)
+    tr._ledger._by[(0, 0)] = span
+    tr.close_spans()
+    assert rec.open == 0 and span.status == "aborted"
+    assert tr._ledger._by == {}
+    # Without a recorder the epilogue is a no-op.
+    ChaosTraffic(spec, seed=3, groups=2).close_spans()
+
+
+def test_request_report_accepts_header_only_artifact(tmp_path):
+    """A spans artifact with zero retained trees (header line alone) is
+    valid --spans-out output: the report must render the empty table,
+    not exit 2."""
+    import subprocess
+    import sys as _sys
+
+    art = tmp_path / "spans.jsonl"
+    art.write_text(json.dumps(
+        {"span_summary": {"requests": 0, "phase_attribution": {}}},
+        sort_keys=True, separators=(",", ":")) + "\n")
+    proc = subprocess.run(
+        [_sys.executable, "tools/request_report.py", str(art)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "0 trees checked" in proc.stdout
+
+
+# --------------------------------------------------- chaos integration
+
+
+@pytest.mark.slow
+def test_chaos_soak_spans_deterministic_and_fault_retained():
+    from josefine_tpu.chaos.soak import run_soak
+
+    kw = dict(horizon=100, workload={"tenants": 3, "produce_per_tick": 2.0})
+    a = run_soak(9, "leader-partition", request_spans=True, **kw)
+    b = run_soak(9, "leader-partition", request_spans=True, **kw)
+    off = run_soak(9, "leader-partition", request_spans=False, **kw)
+    assert a["invariants"] == "ok"
+    assert a["spans"] == b["spans"] != ""
+    # Non-perturbation: the span plane changes nothing the determinism
+    # contract pins.
+    assert a["event_log"] == off["event_log"]
+    assert a["state_digest"] == off["state_digest"]
+    assert a["journals"] == off["journals"]
+    assert off["span_summary"] is None
+    # Chaotic-phase requests are fault-retained, not just the tail.
+    sampled = {json.loads(l)["sampled"] for l in a["spans"].splitlines()}
+    assert "fault" in sampled
+    assert a["span_summary"]["requests"] > 0

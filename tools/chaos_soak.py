@@ -83,6 +83,13 @@ def main() -> int:
                          "msg_delivered, path-tagged routed vs host) so the "
                          "merged timeline carries the message path — the "
                          "input tools/trace_report.py follows across nodes")
+    ap.add_argument("--request-spans", action="store_true",
+                    help="record request-scoped phase spans (admission/"
+                         "queue/consensus/apply/serve, utils/spans.py) for "
+                         "the workload's produces (in-process: needs "
+                         "--workload-tenants) or every broker request "
+                         "(--wire); the summary embeds span_summary and a "
+                         "violation artifact carries the span trees")
     ap.add_argument("--coverage-out", default=None,
                     help="write the run's journal-derived coverage map "
                          "(features, class counts, signature) here as JSON "
@@ -190,7 +197,8 @@ def main() -> int:
                 tenants=args.wire_tenants,
                 produce_every=args.wire_produce_every,
                 commitless_limit=args.commitless_limit,
-                artifact_path=args.artifact)
+                artifact_path=args.artifact,
+                request_spans=args.request_spans)
         except ValueError as e:
             print(f"invalid schedule: {e}", file=sys.stderr)
             return 2
@@ -215,6 +223,8 @@ def main() -> int:
         summary["wire"] = True
         summary["fate_log"] = result["fate_log"]
         summary["coverage_classes"] = result["coverage"]["class_counts"]
+        if result.get("span_summary"):
+            summary["span_summary"] = result["span_summary"]
         print(json.dumps(summary))
         return 0 if result["invariants"] == "ok" else 1
 
@@ -234,7 +244,8 @@ def main() -> int:
             payload_ring=args.payload_ring,
             flight_wire=args.flight_wire, workload=workload,
             artifact_path=args.artifact, flight_ring=args.flight_ring,
-            commitless_limit=args.commitless_limit)
+            commitless_limit=args.commitless_limit,
+            request_spans=args.request_spans)
     except ValueError as e:
         # The DSL boundary rejected the schedule (unknown op, negative at,
         # malformed args — it names the step). Usage error, not a crash.
@@ -279,6 +290,8 @@ def main() -> int:
         summary["active_set_stats"] = result["active_set_stats"]
     if result.get("workload_stats"):
         summary["workload_stats"] = result["workload_stats"]
+    if result.get("span_summary"):
+        summary["span_summary"] = result["span_summary"]
     if result.get("device_route_stats"):
         summary["device_route_stats"] = result["device_route_stats"]
     # Observability epilogue: the full registry dump (counters, gauges,

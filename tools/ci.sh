@@ -210,6 +210,51 @@ print("traffic ok:", row["committed"], "committed,",
 PYEOF
 }
 
+traffic_smoke_spans() {
+    # Request-span plane end to end (tools/traffic_soak.py
+    # --request-spans): a span-enabled soak must (a) produce
+    # byte-identical span logs across two same-seed runs (the span twin
+    # of the workload trace contract), (b) leave the workload trace
+    # byte-identical to the spans-OFF baseline traffic_smoke just wrote
+    # with the same axes+seed (zero perturbation — the gating test's CI
+    # face), and (c) feed tools/request_report.py a per-tenant phase
+    # table plus at least one complete produce span tree whose phases
+    # sum to its observed latency (request_report exits 1 on any
+    # mismatched tree).
+    echo "== traffic smoke (request spans) =="
+    python tools/traffic_soak.py --tenants 8 --partitions 24 --ticks 50 \
+        --load 10 --seed 11 --churn 10 --request-spans \
+        --spans-out /tmp/ci_spans_a.jsonl --out /tmp/ci_traffic_sa.json \
+        --no-merge --trace-out /tmp/ci_traffic_sa.jsonl > /dev/null
+    python tools/traffic_soak.py --tenants 8 --partitions 24 --ticks 50 \
+        --load 10 --seed 11 --churn 10 --request-spans \
+        --spans-out /tmp/ci_spans_b.jsonl --out /tmp/ci_traffic_sb.json \
+        --no-merge > /dev/null
+    cmp /tmp/ci_spans_a.jsonl /tmp/ci_spans_b.jsonl
+    cmp /tmp/ci_traffic_a.jsonl /tmp/ci_traffic_sa.jsonl
+    python tools/request_report.py /tmp/ci_spans_a.jsonl > /tmp/ci_rr.txt
+    python - <<'PYEOF'
+import json
+row = json.load(open("/tmp/ci_traffic_sa.json"))["results"][0]
+assert row["request_spans"] is True, row
+ss = row["extra"]["span_summary"]
+assert ss["requests"] > 0 and ss["open"] == 0, ss
+lines = open("/tmp/ci_spans_a.jsonl").read().splitlines()
+header = json.loads(lines[0])["span_summary"]
+assert header["phase_attribution"], "no per-tenant phase table"
+trees = [json.loads(l) for l in lines[1:]]
+ok_produce = [t for t in trees
+              if t["kind"] == "produce" and t["status"] == "ok"]
+assert ok_produce, "no complete produce span tree retained"
+for t in trees:
+    assert sum(t["phases"].values()) == t["lat"], t
+report = open("/tmp/ci_rr.txt").read()
+assert "phase attribution" in report and "0 mismatched" in report
+print("traffic spans ok:", ss["requests"], "requests,",
+      len(trees), "trees retained,", len(ok_produce), "committed produce")
+PYEOF
+}
+
 traffic_chaos_smoke() {
     # The leader-partition nemesis under REAL produce traffic: the
     # workload model drives the proposal plane, every safety invariant
@@ -275,6 +320,7 @@ if [[ "${1:-}" == "quick" ]]; then
     chaos_search_smoke
     wire_chaos_smoke
     traffic_smoke
+    traffic_smoke_spans
     podsim_smoke
     obs_smoke
     perf_smoke
@@ -301,7 +347,7 @@ else
         tests/test_log.py tests/test_durability.py \
         tests/test_idempotent_produce.py tests/test_metrics.py \
         tests/test_histogram.py tests/test_events_endpoint.py \
-        tests/test_workload.py -q
+        tests/test_workload.py tests/test_spans.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
     # The active-set differential suite in its own chunk: the twin-cluster
@@ -325,6 +371,7 @@ else
     chaos_search_repros
     wire_chaos_smoke
     traffic_smoke
+    traffic_smoke_spans
     traffic_chaos_smoke
     podsim_smoke
     obs_smoke

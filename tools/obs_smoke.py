@@ -11,6 +11,10 @@ HTTP GETs:
 * ``/events`` serves the flight-recorder journal and it contains the
   election the engine just ran, and the ``?since=<seq>`` cursor resumes a
   poller strictly after that seq instead of re-serving the ring;
+* ``/traces`` serves recorded request span trees (utils/spans.py) — a
+  produce span with the full admitted/minted/committed/applied ladder
+  whose phases sum to its latency — and honors the ``?tenant=`` /
+  ``?phase=`` / ``?since=`` / ``?limit=`` filters;
 * the journal-derived coverage gauges
   (``chaos_coverage_features{class=...}``, utils/coverage.py) expose
   node-scoped after a publish;
@@ -37,6 +41,7 @@ from josefine_tpu.models.types import step_params
 from josefine_tpu.raft.engine import RaftEngine
 from josefine_tpu.utils.kv import MemKV
 from josefine_tpu.utils.metrics import MetricsServer
+from josefine_tpu.utils.spans import SpanRecorder, bind_span, unbind_span
 from josefine_tpu.utils.tracing import get_logger
 
 log = get_logger("obs_smoke")
@@ -61,18 +66,32 @@ async def main() -> int:
     engine = RaftEngine(
         MemKV(), [1], 1, groups=2,
         fsms={0: _Fsm(), 1: _Fsm()},
-        params=step_params(timeout_min=3, timeout_max=8, hb_ticks=1))
+        params=step_params(timeout_min=3, timeout_max=8, hb_ticks=1),
+        request_spans=True)
+    spans = SpanRecorder(clock=engine._flight_tick, window_ticks=8,
+                         sample_top_k=2)
     futs = []
     for i in range(20):
         engine.tick()
         if engine.is_leader(0):
-            futs.append(engine.propose(0, b"smoke%d" % i))
+            # A spanned produce: the engine stamps the consensus rungs.
+            span = spans.begin("produce", tenant="t%04d" % (i % 2))
+            tok = bind_span(span)
+            futs.append((engine.propose(0, b"smoke%d" % i), span))
+            unbind_span(tok)
         await asyncio.sleep(0)
-    committed = sum(1 for f in futs if f.done() and not f.exception())
+    committed = 0
+    for fut, span in futs:
+        if fut.done() and not fut.exception():
+            committed += 1
+            spans.finish(span, status="ok")
+        else:
+            spans.finish(span, status="error")
     assert committed > 0, "no proposal committed in 20 ticks"
 
     srv = MetricsServer("127.0.0.1", 0, state_fn=engine.debug_state, node=1,
-                        events_fn=lambda: engine.flight.events())
+                        events_fn=lambda: engine.flight.events(),
+                        traces_fn=spans.traces)
     port = await srv.start()
     try:
         status, body = await _get(port, "/metrics")
@@ -127,6 +146,33 @@ async def main() -> int:
         assert 'chaos_coverage_features{class="ev",node="1"}' in text, \
             "coverage gauges missing from /metrics"
 
+        # /traces: a recorded produce span tree over real HTTP, with the
+        # full consensus ladder, phases summing to latency, and filters.
+        status, body = await _get(port, "/traces")
+        assert status.endswith("200 OK"), status
+        traces = json.loads(body)["traces"]
+        assert traces, "no span trees retained"
+        produce = [t for t in traces
+                   if t["kind"] == "produce" and t["status"] == "ok"]
+        assert produce, "no committed produce span tree on /traces"
+        t0 = produce[0]
+        assert {"admitted", "minted", "committed", "applied"} <= set(
+            t0["marks"]), t0["marks"]
+        assert sum(t0["phases"].values()) == t0["lat"], t0
+        assert t0["group"] == 0 and t0["leader"] == 1
+        status, body = await _get(port, "/traces?tenant=t0001")
+        sub = json.loads(body)["traces"]
+        assert sub and all(t["tenant"] == "t0001" for t in sub)
+        cut = traces[len(traces) // 2]["rid"]
+        status, body = await _get(port, f"/traces?since={cut}&limit=2")
+        after = json.loads(body)["traces"]
+        assert len(after) <= 2 and all(t["rid"] > cut for t in after)
+        dom = t0["phases"]
+        dom_phase = max(dom, key=lambda p: (dom[p], ""))
+        status, body = await _get(port, f"/traces?phase={dom_phase}")
+        assert any(t["rid"] == t0["rid"]
+                   for t in json.loads(body)["traces"])
+
         status, body = await _get(port, "/state")
         assert json.loads(body)["groups_led"] == 2
 
@@ -139,6 +185,8 @@ async def main() -> int:
     print(json.dumps({"ok": True, "committed": committed,
                       "journal_events": len(engine.flight),
                       "coverage_signature": cov.signature(),
+                      "span_requests": spans.finished,
+                      "span_retained": len(spans.traces()),
                       "commit_latency": lat}))
     return 0
 

@@ -1,0 +1,305 @@
+#!/usr/bin/env python
+"""Render one request's causal story — and the fleet's phase bill.
+
+The span layer (utils/spans.py, ``raft.request_spans``) retains
+tick-denominated span trees for the slowest requests per window plus
+everything an armed fault touched. This tool turns a span artifact into
+the two things an operator actually asks:
+
+1. **Per-tenant phase attribution** — a table of where each tenant's
+   ticks went (admission / queue / consensus / apply / serve), from the
+   recorder's always-on aggregate (every finished request, not just the
+   retained sample).
+2. **One request's story** — the chosen span tree (``--rid``, or the
+   slowest retained produce): its phases, group, leader at submit, and —
+   when flight journals ride along (a chaos artifact, or ``--journals``)
+   — the wire hops under its consensus phase, joined against the journal
+   on (tick window, group) and split routed vs host.
+
+Inputs:
+    python tools/request_report.py spans.jsonl            # traffic_soak --spans-out
+    python tools/request_report.py chaos_artifact_*.json  # soak violation artifact
+    python tools/request_report.py spans.jsonl --journals journals.json
+    python tools/request_report.py spans.jsonl --rid 1234 --json out.json
+
+The spans-JSONL form is what ``tools/traffic_soak.py --request-spans
+--spans-out`` writes: a ``span_summary`` header line (the phase table),
+then one retained span tree per line. The artifact form is what the
+chaos soaks auto-dump on an invariant trip (``spans`` + ``journals``
+embedded). Every tree's phases are checked to sum to its observed
+latency (the span ladder guarantees it; the report re-verifies).
+
+Exit 0 with a report; 2 on unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from josefine_tpu.utils.spans import PHASES  # noqa: E402
+
+# Device message-kind names (models/types.py values), for readable hops.
+MSG_NAMES = {1: "VOTE_REQ", 2: "VOTE_RESP", 3: "APPEND", 4: "APPEND_RESP",
+             5: "PREVOTE_REQ", 6: "PREVOTE_RESP"}
+
+
+def load_spans(source: str) -> tuple[list[dict], dict, dict]:
+    """Load (traces, summary, journals) from a spans JSONL artifact or a
+    chaos/wire soak artifact JSON."""
+    with open(source) as fh:
+        text = fh.read()
+    if text.lstrip()[:1] == "{":
+        # Could be JSONL (header + traces — possibly the header ALONE,
+        # when a soak finished no requests) or a single JSON artifact
+        # document; JSONL lines each parse alone, a pretty-printed JSON
+        # does not, and a one-line doc is an artifact only if it carries
+        # the artifact's "spans" key rather than the header's marker.
+        try:
+            lines = [json.loads(ln) for ln in text.splitlines() if ln]
+        except json.JSONDecodeError:
+            lines = None
+        if lines and all(isinstance(d, dict) for d in lines) \
+                and not (len(lines) == 1 and "spans" in lines[0]):
+            summary = {}
+            traces = []
+            for d in lines:
+                if "span_summary" in d and "rid" not in d:
+                    summary = d["span_summary"]
+                else:
+                    traces.append(d)
+            return traces, summary, {}
+    doc = json.loads(text)
+    if not isinstance(doc, dict):
+        raise ValueError("unrecognized spans input")
+    spans = doc.get("spans")
+    summary = doc.get("span_summary") or {}
+    journals = doc.get("journals") or {}
+    traces: list[dict] = []
+    if isinstance(spans, str):
+        traces = [json.loads(ln) for ln in spans.splitlines() if ln]
+    elif isinstance(spans, dict):
+        # Wire-soak form: node -> JSONL. Merge, keeping the node id.
+        for node in sorted(spans):
+            for ln in (spans[node] or "").splitlines():
+                if ln:
+                    t = json.loads(ln)
+                    t.setdefault("node", node)
+                    traces.append(t)
+        # Per-node summaries: fold the tables under node-prefixed keys.
+        if summary and all(isinstance(v, dict) for v in summary.values()) \
+                and "phase_attribution" not in summary:
+            folded: dict = {"phase_attribution": {}}
+            for node in sorted(summary):
+                for key, row in (summary[node].get("phase_attribution")
+                                 or {}).items():
+                    folded["phase_attribution"][f"n{node}:{key}"] = row
+            summary = folded
+    elif spans is None:
+        raise ValueError("artifact has no spans (was the soak run with "
+                         "request spans on?)")
+    return traces, summary, journals
+
+
+def load_extra_journals(path: str) -> dict:
+    """--journals: the soak --journals JSON (node -> JSONL) or a
+    directory of <node>.jsonl files (the trace_report conventions)."""
+    if os.path.isdir(path):
+        out = {}
+        for name in sorted(os.listdir(path)):
+            if name.endswith(".jsonl"):
+                with open(os.path.join(path, name)) as fh:
+                    out[name[:-len(".jsonl")]] = fh.read()
+        return out
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def phase_attribution_table(summary: dict, traces: list[dict]) -> dict:
+    """The per-tenant table: from the artifact's aggregate when present
+    (covers EVERY finished request), else recomputed from the retained
+    traces (labelled as the sampled view)."""
+    table = summary.get("phase_attribution") if summary else None
+    if table:
+        return {"source": "aggregate", "rows": table}
+    rows: dict[str, dict] = {}
+    for t in traces:
+        key = f"{t.get('tenant', '')}/{t.get('kind', '')}"
+        row = rows.setdefault(key, {"count": 0, "lat_sum": 0, "lat_max": 0,
+                                    **{p: 0 for p in PHASES}})
+        row["count"] += 1
+        row["lat_sum"] += t.get("lat", 0)
+        row["lat_max"] = max(row["lat_max"], t.get("lat", 0))
+        for p in PHASES:
+            row[p] += (t.get("phases") or {}).get(p, 0)
+    return {"source": "retained-sample", "rows": rows}
+
+
+def pick_trace(traces: list[dict], rid: int | None,
+               tenant: str | None) -> dict | None:
+    """--rid wins; else the slowest retained trace that reached
+    consensus (kind produce/offset_commit preferred), ties by rid."""
+    if rid is not None:
+        for t in traces:
+            if t.get("rid") == rid:
+                return t
+        return None
+    pool = [t for t in traces if tenant is None or t.get("tenant") == tenant]
+    writes = [t for t in pool if (t.get("marks") or {}).get("minted")
+              is not None]
+    pool = writes or pool
+    if not pool:
+        return None
+    return sorted(pool, key=lambda t: (-t.get("lat", 0),
+                                       t.get("rid", 0)))[0]
+
+
+def join_hops(trace: dict, journals: dict) -> list[dict]:
+    """Wire hops under the span's consensus window: flight msg_sent /
+    msg_delivered events with the span's group whose tick falls inside
+    [minted, committed + 1] — the replication round-trips that consensus
+    phase paid for, path-tagged routed vs host."""
+    marks = trace.get("marks") or {}
+    lo = marks.get("minted")
+    hi = marks.get("committed")
+    g = trace.get("group", -1)
+    if lo is None or g < 0 or not journals:
+        return []
+    hi = (hi if hi is not None else lo) + 1
+    hops = []
+    for node in sorted(journals):
+        evs = journals[node]
+        if isinstance(evs, str):
+            evs = [json.loads(ln) for ln in evs.splitlines() if ln]
+        for ev in evs:
+            if ev.get("kind") not in ("msg_sent", "msg_delivered"):
+                continue
+            if ev.get("group") != g or not (lo <= ev.get("tick", -1) <= hi):
+                continue
+            d = ev.get("detail") or {}
+            hops.append({
+                "node": str(node), "tick": ev.get("tick"),
+                "edge": ev["kind"],
+                "msg": MSG_NAMES.get(d.get("kind"), str(d.get("kind"))),
+                "src": d.get("src"), "dst": d.get("dst"),
+                "path": d.get("path"),
+            })
+    hops.sort(key=lambda h: (h["tick"], h["node"], h["edge"]))
+    return hops
+
+
+def render_text(table: dict, trace: dict | None, hops: list[dict],
+                checked: int, bad: int) -> str:
+    out = []
+    out.append("== per-tenant phase attribution "
+               f"({table['source']}; ticks) ==")
+    hdr = (f"{'tenant/kind':28s} {'n':>6s} {'lat':>8s} "
+           + " ".join(f"{p:>9s}" for p in PHASES))
+    out.append(hdr)
+    rows = table["rows"]
+    order = sorted(rows, key=lambda k: (-rows[k]["lat_sum"], k))
+    for key in order[:40]:
+        r = rows[key]
+        out.append(f"{key:28s} {r['count']:6d} {r['lat_sum']:8d} "
+                   + " ".join(f"{r[p]:9d}" for p in PHASES))
+    if len(order) > 40:
+        out.append(f"... {len(order) - 40} more rows (use --json)")
+    out.append("")
+    out.append(f"phase-sum check: {checked} trees checked, "
+               f"{bad} mismatched"
+               + (" <-- BROKEN LADDER" if bad else ""))
+    out.append("")
+    if trace is None:
+        out.append("no retained span tree matched the selection")
+        return "\n".join(out) + "\n"
+    ph = trace.get("phases") or {}
+    marks = trace.get("marks") or {}
+    out.append(f"== request rid={trace.get('rid')} "
+               f"({trace.get('kind')}, tenant {trace.get('tenant')}) ==")
+    out.append(f"  topic={trace.get('topic')} part={trace.get('part')} "
+               f"group={trace.get('group')} "
+               f"leader_at_mint={trace.get('leader')} "
+               f"status={trace.get('status')} "
+               f"sampled={trace.get('sampled')}"
+               + (" [fault-window]" if trace.get("fault") else ""))
+    out.append(f"  ticks [{trace.get('begin')} .. {trace.get('end')}]  "
+               f"latency {trace.get('lat')} "
+               f"(phases sum {sum(ph.values())})")
+    t = trace.get("begin", 0)
+    for p in PHASES:
+        width = ph.get(p, 0)
+        bar = "#" * min(40, width)
+        out.append(f"    {p:10s} {width:6d}  "
+                   f"[t{t:>6d} -> t{t + width:>6d}] {bar}")
+        t += width
+    for rung in ("admitted", "minted", "committed", "applied"):
+        if rung in marks:
+            out.append(f"    mark {rung:10s} @ t{marks[rung]}")
+    if hops:
+        routed = sum(1 for h in hops if h.get("path") == "routed")
+        out.append(f"  consensus hops (flight-journal join on "
+                   f"(tick, group)): {len(hops)} events, "
+                   f"{routed} routed / {len(hops) - routed} host")
+        for h in hops[:24]:
+            out.append(f"    t{h['tick']:>6d} n{h['node']} "
+                       f"{h['edge']:13s} {h['msg']:12s} "
+                       f"{h['src']}->{h['dst']} [{h['path']}]")
+        if len(hops) > 24:
+            out.append(f"    ... {len(hops) - 24} more")
+    else:
+        out.append("  consensus hops: no flight journal available "
+                   "(run the soak with --flight-wire, or pass --journals)")
+    return "\n".join(out) + "\n"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("artifact", help="spans JSONL (traffic_soak "
+                    "--spans-out) or a soak violation artifact JSON")
+    ap.add_argument("--journals", default=None,
+                    help="flight journals to join hops from (soak "
+                         "--journals JSON or a directory of <node>.jsonl)")
+    ap.add_argument("--rid", type=int, default=None,
+                    help="render this request id (default: slowest "
+                         "retained write)")
+    ap.add_argument("--tenant", default=None,
+                    help="restrict the story pick to one tenant")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the full report as JSON here")
+    args = ap.parse_args()
+
+    try:
+        traces, summary, journals = load_spans(args.artifact)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"unusable input: {e}", file=sys.stderr)
+        return 2
+    if args.journals:
+        try:
+            journals = load_extra_journals(args.journals)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"unusable --journals: {e}", file=sys.stderr)
+            return 2
+
+    # The ladder's contract, re-verified: every retained tree's phases
+    # sum to its observed latency.
+    bad = sum(1 for t in traces
+              if sum((t.get("phases") or {}).values()) != t.get("lat", 0))
+    table = phase_attribution_table(summary, traces)
+    trace = pick_trace(traces, args.rid, args.tenant)
+    hops = join_hops(trace, journals) if trace is not None else []
+    print(render_text(table, trace, hops, len(traces), bad), end="")
+    if args.json_out:
+        with open(args.json_out, "w") as fh:
+            json.dump({"phase_attribution": table, "trace": trace,
+                       "hops": hops, "trees_checked": len(traces),
+                       "phase_sum_mismatches": bad}, fh, indent=1)
+        print(f"-> {args.json_out}")
+    return 0 if not bad else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,9 +22,17 @@ commit really replicates; --device-route/--payload-ring run that
 replication leg through the RouteFabric's device payload ring (the
 serve-path row the PR 12 tentpole records).
 
+--request-spans records tick-denominated request spans (utils/spans.py:
+admission/queue/consensus/apply/serve per produce, fetch, and offset
+commit); --spans-out writes the span artifact (summary header + one
+retained tree per line, byte-identical across same-seed runs — the
+input for tools/request_report.py), --spans-overhead measures the on
+cost against an adjacent spans-off baseline, and a p99 outlier
+(p99 > --outlier-mult * p50) auto-dumps the span trees even unasked.
+
 Rows merge into BENCH_traffic.json keyed on the workload axes
 (tenants, partitions, skew, offered load, active_set, replication,
-device_route, payload_ring); per-tenant
+device_route, payload_ring, request_spans); per-tenant
 p50/p99 commit-latency quantiles, throughput split by path
 (replicated vs legacy-direct), and backpressure/retry counters land in
 every row.
@@ -58,11 +66,11 @@ DEFAULT_OUT = os.path.join(ROOT, "BENCH_traffic.json")
 
 def _row_key(r: dict) -> tuple:
     # replication/device_route/payload_ring joined the key in PR 12;
-    # legacy rows normalize to the single-node defaults.
+    # request_spans in the span PR; legacy rows normalize to defaults.
     return (r["tenants"], r["partitions"], float(r["skew"]),
             float(r["offered_per_tick"]), bool(r.get("active_set")),
             int(r.get("replication", 1)), bool(r.get("device_route")),
-            bool(r.get("payload_ring")))
+            bool(r.get("payload_ring")), bool(r.get("request_spans")))
 
 
 def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
@@ -83,7 +91,7 @@ def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
         f.write("\n")
 
 
-async def run_soak(args) -> dict:
+async def _run_driver(args, request_spans: bool):
     from josefine_tpu.workload.driver import TrafficEngine
     from josefine_tpu.workload.model import WorkloadSpec
 
@@ -98,13 +106,27 @@ async def run_soak(args) -> dict:
                         window=args.window, hb_ticks=args.hb_ticks,
                         replication=args.replication,
                         device_route=args.device_route,
-                        payload_ring=args.payload_ring)
+                        payload_ring=args.payload_ring,
+                        request_spans=request_spans)
     t0 = time.perf_counter()
     await drv.start()
     t_boot = time.perf_counter() - t0
     t1 = time.perf_counter()
     await drv.run_ticks(args.ticks)
     wall = time.perf_counter() - t1
+    return drv, spec, t_boot, wall
+
+
+async def run_soak(args) -> dict:
+    baseline_ms = None
+    if args.request_spans and args.spans_overhead:
+        # Measured overhead, the flight_wire discipline: a spans-OFF
+        # soak of the identical (spec, seed) first, so the spans-on
+        # row quotes its own delta instead of a guess. Adjacent runs —
+        # same process, same warmed jit caches.
+        _bdrv, _bspec, _bboot, bwall = await _run_driver(args, False)
+        baseline_ms = round(1000.0 * bwall / max(1, _bdrv.tick), 3)
+    drv, spec, t_boot, wall = await _run_driver(args, args.request_spans)
     s = drv.summary()
     ran = drv.tick  # soak ticks incl. the drain epilogue
     row = {
@@ -119,6 +141,7 @@ async def run_soak(args) -> dict:
         "replication": int(args.replication),
         "device_route": bool(args.device_route),
         "payload_ring": bool(args.payload_ring),
+        "request_spans": bool(args.request_spans),
         "route_stats": s["route_stats"],
         "window": args.window,
         "bootstrap_s": round(t_boot, 3),
@@ -143,6 +166,39 @@ async def run_soak(args) -> dict:
             "spec": s["spec"],
         },
     }
+    if args.request_spans:
+        # Span epilogue: compact summary in the row; the full per-tenant
+        # phase table + retained trees ride the --spans-out artifact
+        # (a span_summary header line, then one trace per line —
+        # byte-identical across same-seed runs).
+        row["extra"]["span_summary"] = s["span_summary"]
+        if baseline_ms is not None:
+            delta = row["ms_per_tick"] - baseline_ms
+            row["extra"]["request_spans_overhead"] = {
+                "baseline_ms_per_tick": baseline_ms,
+                "ms_per_tick": row["ms_per_tick"],
+                "delta_ms": round(delta, 3),
+                "delta_pct": round(100.0 * delta / max(baseline_ms, 1e-9),
+                                   2),
+            }
+        spans_out = args.spans_out
+        if spans_out is None and row["p99_ticks"] > args.outlier_mult * max(
+                row["p50_ticks"], 1.0):
+            # p99 outlier auto-dump: the span trees ARE the explanation
+            # of where the tail went — write them next to the results
+            # even when nobody asked (the invariant-trip discipline).
+            spans_out = os.path.abspath(
+                f"traffic_spans_{spec.tenants}x{spec.total_partitions}"
+                f"_{args.seed}.jsonl")
+            row["extra"]["span_outlier_dump"] = spans_out
+        if spans_out:
+            header = json.dumps(
+                {"span_summary": drv.spans.summary(table=True)},
+                sort_keys=True, separators=(",", ":"))
+            with open(spans_out, "w") as f:
+                f.write(header + "\n")
+                f.write(drv.spans.dump_jsonl())
+            row["extra"]["spans_out"] = os.path.abspath(spans_out)
     if args.trace_out:
         drv.trace.dump(args.trace_out)
         row["extra"]["trace_out"] = os.path.abspath(args.trace_out)
@@ -188,6 +244,26 @@ def main() -> int:
                     help="with --device-route: AppendEntries payloads "
                          "serve from the device payload ring, so the "
                          "produce path's replication leg routes on-chip")
+    ap.add_argument("--request-spans", action="store_true",
+                    help="record request-scoped phase spans (admission/"
+                         "queue/consensus/apply/serve on the engine tick "
+                         "axis, utils/spans.py); the row embeds the "
+                         "compact span summary")
+    ap.add_argument("--spans-out", default=None,
+                    help="with --request-spans: write the span artifact "
+                         "here (JSONL: a span_summary header line with "
+                         "the per-tenant phase table, then one retained "
+                         "span tree per line — byte-identical across "
+                         "same-seed runs; tools/request_report.py input)")
+    ap.add_argument("--spans-overhead", action="store_true",
+                    help="with --request-spans: run a spans-off baseline "
+                         "of the identical (spec, seed) first and record "
+                         "the measured delta in "
+                         "extra.request_spans_overhead")
+    ap.add_argument("--outlier-mult", type=float, default=8.0,
+                    help="with --request-spans and no --spans-out: auto-"
+                         "dump the span artifact when p99 > MULT * p50 "
+                         "(the tail the spans exist to explain)")
     ap.add_argument("--trace-out", default=None,
                     help="write the byte-stable workload event trace "
                          "(JSONL) here")
