@@ -63,6 +63,10 @@ _WRITE_ALLOWLIST = {
     ("group_admin.py", "set_group_incarnation"),
     ("group_admin.py", "recycle_group"),
     ("group_admin.py", "_reset_group"),
+    # migration handoff installs the carried prefix into the target row:
+    # recycle-then-restore, device head/commit/term re-pointed with the
+    # _h_* mirrors refreshed in the same breath (PR 16 review)
+    ("group_admin.py", "migrate_adopt_row"),
     ("snap_transfer.py", "_adopt_snapshot"),
     ("hostio.py", "_drain_nxt_fixups"),
     # builder-side intake stamps (tick path, split into mixin helpers)

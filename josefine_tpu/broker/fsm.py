@@ -13,6 +13,7 @@ from __future__ import annotations
 from josefine_tpu.broker.state import (
     Broker,
     Group,
+    Migration,
     OffsetCommit,
     OffsetCommitBatch,
     Partition,
@@ -34,6 +35,7 @@ _COMMIT_OFFSETS = 7
 _GROUP_RELEASED = 8
 _ALLOC_PID = 9
 _ENSURE_PARTITIONS = 10
+_MIGRATION = 11
 
 _KINDS = {
     _ENSURE_TOPIC: Topic,
@@ -46,6 +48,7 @@ _KINDS = {
     _GROUP_RELEASED: GroupReleased,
     _ALLOC_PID: PidAlloc,
     _ENSURE_PARTITIONS: PartitionBatch,
+    _MIGRATION: Migration,
 }
 _TAGS = {v: k for k, v in _KINDS.items()}
 
@@ -99,6 +102,31 @@ class Transition:
                                 inc=inc).encode())
 
     @staticmethod
+    def migrate_partition(topic: str, idx: int) -> bytes:
+        """Begin a live reassignment of one partition's consensus row (the
+        Kafka AlterPartitionReassignments analog): the FSM claims the
+        target row deterministically at apply time."""
+        return (bytes([_MIGRATION])
+                + Migration(topic=topic, idx=idx, phase="begin").encode())
+
+    @staticmethod
+    def migration_ack(topic: str, idx: int, dst_group: int,
+                      broker_id: int) -> bytes:
+        """One replica host's ack that it installed the carried prefix
+        into the target row; the last ack cuts the partition over."""
+        return (bytes([_MIGRATION])
+                + Migration(topic=topic, idx=idx, phase="ack",
+                            dst_group=dst_group,
+                            broker_id=broker_id).encode())
+
+    @staticmethod
+    def migration_abort(topic: str, idx: int) -> bytes:
+        """Abort an in-flight reassignment: the source row stays the
+        single owner; the claimed target row drains back to the pool."""
+        return (bytes([_MIGRATION])
+                + Migration(topic=topic, idx=idx, phase="abort").encode())
+
+    @staticmethod
     def decode(data: bytes):
         if not data:
             raise ValueError("empty transition")
@@ -123,6 +151,13 @@ class JosefineFsm:
         self.on_delete_topic = on_delete_topic
         self.on_partition_assigned = None
         self.on_partition_released = None
+        # Live-migration hooks (same contract as the partition hooks:
+        # fired at commit time on every node, node-local effects only —
+        # freeze the source row at begin, install the carried prefix and
+        # ack, purge the source at cutover, unwind at abort).
+        self.on_migration_begin = None
+        self.on_migration_cutover = None
+        self.on_migration_abort = None
         # Consensus-group rows available on the device tensor (engine P);
         # pool <= 1 means only the metadata group exists and partitions run
         # in legacy (group-less) mode.
@@ -143,6 +178,76 @@ class JosefineFsm:
         if self.on_partition_assigned is not None:
             self.on_partition_assigned(applied)
         return applied
+
+    def _apply_migration(self, m: Migration) -> Migration:
+        """One replicated migration verb (begin / ack / abort) against the
+        partition's migration record. Every branch is a pure function of
+        store state, so all nodes applying the same committed sequence
+        agree on the claimed target row, the ack set, and the cutover
+        point; invalid or stale verbs degrade to an inert ``phase`` the
+        proposer can read back (never an exception — a committed poison
+        transition must not crash the apply loop)."""
+        p = self.store.get_partition(m.topic, m.idx)
+        cur = self.store.get_migration(m.topic, m.idx)
+        if m.phase == "begin":
+            if p is None or p.group < 1 or cur is not None:
+                m.phase = "rejected"
+                return m
+            dst = self.store.claim_group(self.group_pool)
+            if dst < 0 or dst == p.group:
+                # Pool exhausted (or degenerately re-claimed the same row
+                # — impossible while the source is live, but cheap to
+                # refuse): nothing to migrate into.
+                m.phase = "rejected"
+                return m
+            m.src_group = p.group
+            m.dst_group = dst
+            m.inc = self.store.group_incarnation(dst)
+            m.phase = "handoff"
+            m.acks = []
+            self.store.put_migration(m)
+            if self.on_migration_begin is not None:
+                self.on_migration_begin(m, p)
+            return m
+        if cur is None or p is None:
+            m.phase = "stale"
+            return m
+        if m.phase == "ack":
+            if m.dst_group != cur.dst_group:
+                m.phase = "stale"  # ack for a superseded attempt
+                return m
+            if m.broker_id not in cur.acks:
+                cur.acks.append(int(m.broker_id))
+                cur.acks.sort()
+            hosts = sorted({int(b) for b in p.assigned_replicas})
+            if set(cur.acks) >= set(hosts):
+                # Cutover: the partition re-points at the target row; the
+                # source row drains through the existing release barrier
+                # (each host resets its local source-row state and acks
+                # GroupReleased before the row re-enters the pool).
+                p.group = cur.dst_group
+                self.store.create_partition(p)
+                self.store.release_group(cur.src_group, hosts)
+                self.store.clear_migration(m.topic, m.idx)
+                cur.phase = "cutover"
+                if self.on_migration_cutover is not None:
+                    self.on_migration_cutover(cur, p)
+            else:
+                cur.phase = "acked"
+                self.store.put_migration(cur)
+            return cur
+        if m.phase == "abort":
+            hosts = sorted({int(b) for b in p.assigned_replicas})
+            # The target row was claimed at begin; hosts that already
+            # adopted must reset it, so it drains like a released row.
+            self.store.release_group(cur.dst_group, hosts)
+            self.store.clear_migration(m.topic, m.idx)
+            cur.phase = "aborted"
+            if self.on_migration_abort is not None:
+                self.on_migration_abort(cur, p)
+            return cur
+        m.phase = "stale"
+        return m
 
     def transition(self, data: bytes) -> bytes:
         entity = Transition.decode(data)
@@ -167,6 +272,8 @@ class JosefineFsm:
         elif isinstance(entity, PidAlloc):
             entity.id = self.store.alloc_pid()
             applied = entity
+        elif isinstance(entity, Migration):
+            applied = self._apply_migration(entity)
         elif isinstance(entity, GroupReleased):
             # One replica host reset its local row state; when the last ack
             # lands the row re-enters the claimable pool (claim_group).
@@ -214,9 +321,11 @@ class JosefineFsm:
         before_topics = {t.name for t in self.store.get_topics()}
         before_parts = {(p.topic, p.idx): p
                         for p in self.store.get_all_partitions() if p.group >= 1}
+        before_migs = {(m.topic, m.idx): m for m in self.store.get_migrations()}
         self.store.load(data)
         after_parts = {(p.topic, p.idx): p
                        for p in self.store.get_all_partitions() if p.group >= 1}
+        after_migs = {(m.topic, m.idx): m for m in self.store.get_migrations()}
         if self.on_partition_released is not None:
             for key, p in before_parts.items():
                 if key not in after_parts:
@@ -230,6 +339,28 @@ class JosefineFsm:
         if self.on_partition_assigned is not None:
             for p in after_parts.values():
                 self.on_partition_assigned(p)
+        # Migrations resolved while we were behind: the surviving partition
+        # record tells the outcome — re-pointed at the target row means the
+        # cutover happened, anything else is an abort's rollback. Still
+        # in-flight ones re-fire begin (idempotent: freeze + re-arm).
+        # sorted(): commit-time hook order must not depend on set hashing.
+        for key in sorted(set(before_migs) - set(after_migs)):
+            old = before_migs[key]
+            p = after_parts.get(key)
+            if p is None:
+                continue  # topic died with the migration: release hooks ran
+            if p.group == old.dst_group:
+                if self.on_migration_cutover is not None:
+                    old.phase = "cutover"
+                    self.on_migration_cutover(old, p)
+            elif self.on_migration_abort is not None:
+                old.phase = "aborted"
+                self.on_migration_abort(old, p)
+        if self.on_migration_begin is not None:
+            for key in sorted(after_migs):
+                p = after_parts.get(key)
+                if p is not None:
+                    self.on_migration_begin(after_migs[key], p)
 
 
 def decode_result(data: bytes):

@@ -45,6 +45,7 @@ import struct
 from josefine_tpu.broker import records
 from josefine_tpu.broker.log import Log
 from josefine_tpu.raft.fsm import ReplicaDiverged
+from josefine_tpu.raft.migration import is_migration_fence
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.tracing import get_logger
 
@@ -80,6 +81,10 @@ class PartitionFsm:
         # wakeup (consumers blocked in Fetch re-check instead of sleeping
         # out their max_wait_ms).
         self.on_append = on_append
+        # Fired when a migration fence commits on this row (the handoff
+        # point of a live reassignment — raft/migration.py). Wired by the
+        # migration driver on source-row FSMs only; None everywhere else.
+        self.on_fence = None
         self._key = b"pfsm:%d" % group
         self._rkey = b"pfsm:r:%d" % group
         self._applied = 0
@@ -177,6 +182,17 @@ class PartitionFsm:
         if blk.id <= self._applied:
             return b""  # duplicate delivery (defensive; replay is exact)
         batch = blk.data
+        if is_migration_fence(batch):
+            # Migration fence: a consensus marker, not a record batch —
+            # it must never reach the seglog. Advancing the position
+            # record IS the handoff point: with the source row frozen,
+            # nothing but fences can mint after it, so applied == this
+            # block id bounds exactly the prefix the target row adopts.
+            self._applied = blk.id
+            self.kv.put(self._key, self._record())
+            if self.on_fence is not None:
+                self.on_fence(blk.id)
+            return b""
         pid, epoch, base_seq, count = records.blob_producer_info(batch)
         # Idempotent-producer dedup, decided deterministically at APPLY time
         # (every replica holds the same pid state at the same commit point,

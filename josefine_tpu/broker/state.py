@@ -197,6 +197,36 @@ class PidAlloc:
 
 
 @dataclass
+class Migration:
+    """One live partition reassignment (Kafka-style), replicated through
+    the metadata FSM. ``phase`` doubles as the transition verb: a proposer
+    sends phase ``begin``/``ack``/``abort`` and the FSM applies it against
+    the replicated migration record (``migr:{topic}:{idx}``), filling in
+    the deterministic fields (src/dst rows, dst incarnation) at apply time
+    exactly like EnsurePartition's group claim. ``acks`` is the handoff
+    barrier: each replica host that installed the carried prefix into the
+    target row appends itself; the last ack IS the cutover (partition
+    re-pointed at ``dst_group``, source row released through the existing
+    drain barrier)."""
+
+    topic: str
+    idx: int
+    phase: str = "begin"
+    src_group: int = -1
+    dst_group: int = -1
+    inc: int = -1            # dst row incarnation pinned at claim time
+    broker_id: int = -1      # ack sender (phase == "ack")
+    acks: list[int] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        return _dumps(asdict(self))
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "Migration":
+        return cls(**json.loads(raw))
+
+
+@dataclass
 class GroupReleased:
     """One replica host's ack that it reset its local state for a released
     consensus-group row (chain, device row, partition-FSM records). The row
@@ -384,6 +414,26 @@ class Store:
         self._kv.delete(key)
         self._kv.put(self._pfx + b"galloc:free:%d" % g, b"1")
         return True
+
+    # ------------------------------------------------------- migrations
+
+    def _migration_key(self, topic: str, idx: int) -> bytes:
+        return self._pfx + b"migr:" + topic.encode() + b":%08d" % idx
+
+    def put_migration(self, m) -> None:
+        self._kv.put(self._migration_key(m.topic, m.idx), m.encode())
+
+    def get_migration(self, topic: str, idx: int) -> "Migration | None":
+        raw = self._kv.get(self._migration_key(topic, idx))
+        return None if raw is None else Migration.decode(raw)
+
+    def get_migrations(self) -> "list[Migration]":
+        """Every in-flight migration record (restart re-arm scan)."""
+        return [Migration.decode(v)
+                for _, v in self._kv.scan_prefix(self._pfx + b"migr:")]
+
+    def clear_migration(self, topic: str, idx: int) -> None:
+        self._kv.delete(self._migration_key(topic, idx))
 
     def alloc_pid(self) -> int:
         """Next producer id from the replicated counter (deterministic)."""

@@ -205,11 +205,22 @@ class ChaosCluster(_PlaneDrivenCluster):
                  active_set: bool = False, device_route: bool = False,
                  payload_ring: bool = False,
                  flight_wire: bool = False, workload=None,
-                 flight_ring: int = 4096, request_spans: bool = False):
+                 flight_ring: int = 4096, request_spans: bool = False,
+                 migration: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
         self.G = groups
+        # Live migration (raft.migration): engines carry one SPARE row
+        # beyond the logical streams, and the stream -> row mapping is
+        # indirect — a cutover flips it and the freed source row becomes
+        # the new spare. Without the flag R == G, the mapping is the
+        # identity forever, and every artifact is byte-identical to the
+        # pre-migration harness.
+        self.migration = bool(migration)
+        self.R = groups + (1 if migration else 0)  # engine rows
+        self.stream_row = list(range(groups))
+        self.spare_row = groups if migration else -1
         self.window = window
         self.params = params
         self.sparse = sparse
@@ -247,8 +258,9 @@ class ChaosCluster(_PlaneDrivenCluster):
         self.workload = workload
         self.ids = list(range(1, n_nodes + 1))
         self.kvs = [MemKV() for _ in range(n_nodes)]
-        # One FSM per (node, group): apply order is only defined per group.
-        self.fsms = [[SnapFsm() for _ in range(groups)] for _ in range(n_nodes)]
+        # One FSM per (node, row): apply order is only defined per row.
+        self.fsms = [[SnapFsm() for _ in range(self.R)]
+                     for _ in range(n_nodes)]
         # Per-node flight-journal archive: restart churn rebuilds engines,
         # and each rebuild banks the dead engine's journal here. Bounded
         # (a few rings deep) so a crash-loop soak's memory and artifact
@@ -273,6 +285,15 @@ class ChaosCluster(_PlaneDrivenCluster):
             self.fabric = RouteFabric(link_filter=self.plane.link_routable,
                                       payload_ring=payload_ring)
         self.engines = [self._make(i) for i in range(n_nodes)]
+        # The migration controller is cluster-held host state (it models
+        # the reliable reassignment driver; the product plane's controller
+        # is the replicated metadata FSM) — created AFTER the engines so
+        # the rebuild hook in _make sees it only on actual restarts.
+        self.migrator = None
+        if migration:
+            from josefine_tpu.raft.migration import MigrationCoordinator
+
+            self.migrator = MigrationCoordinator(self)
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.ledger = invariants.ElectionSafetyLedger()
         self.acked: dict[int, list[bytes]] = {g: [] for g in range(groups)}
@@ -283,10 +304,10 @@ class ChaosCluster(_PlaneDrivenCluster):
 
     def _make(self, i: int) -> RaftEngine:
         self._archive_flight(i)
-        self.fsms[i] = [SnapFsm() for _ in range(self.G)]
+        self.fsms[i] = [SnapFsm() for _ in range(self.R)]
         e = RaftEngine(
-            self.kvs[i], self.ids, self.ids[i], groups=self.G,
-            fsms={g: self.fsms[i][g] for g in range(self.G)},
+            self.kvs[i], self.ids, self.ids[i], groups=self.R,
+            fsms={g: self.fsms[i][g] for g in range(self.R)},
             params=self.params, base_seed=100 + i,
             snapshot_threshold=6,
             sparse_io=True if self.sparse else None,
@@ -302,16 +323,33 @@ class ChaosCluster(_PlaneDrivenCluster):
             # fresh — staged routed traffic for the dead incarnation is
             # dropped, like the pending queues inside the dead process.
             self.fabric.register(e)
+        mig = getattr(self, "migrator", None)
+        if mig is not None:
+            # Revived engines come back with volatile migration state
+            # reset (incarnations at 0, freeze lifted): re-anchor to the
+            # controller's ledger, purging rows whose durable life is
+            # stale — engines list first, the hook reads through it.
+            self.engines[i] = e
+            mig.on_engine_rebuilt(i)
         return e
 
     # ------------------------------------------------------ nemesis queries
+
+    def row_of(self, stream: int) -> int:
+        """The engine row currently owning a logical stream (identity
+        unless a migration cut over)."""
+        return self.stream_row[stream]
 
     def live_nodes(self) -> list[int]:
         return [i for i in range(self.N) if not self.plane.is_down(i)]
 
     def leader_node(self, group: int = 0) -> int | None:
+        # Nemesis dynamic targets name STREAMS, so "shoot the leader of
+        # group 1" keeps tracking a stream across its migrations (identity
+        # mapping when the migration plane is off).
+        row = self.row_of(group) if group < self.G else group
         for i in self.live_nodes():
-            if self.engines[i].is_leader(group):
+            if self.engines[i].is_leader(row):
                 return i
         return None
 
@@ -321,11 +359,17 @@ class ChaosCluster(_PlaneDrivenCluster):
         return [(i, self.engines[i]) for i in self.live_nodes()]
 
     def check_election_safety(self):
-        self.ledger.check(self._live_engines(), self.G)
+        # All R rows, not just stream-owned ones: a spare row's elections
+        # still must never produce two leaders in one term.
+        self.ledger.check(self._live_engines(), self.R)
 
     def check_log_matching(self):
+        # Keyed by STREAM through the row mapping: during a handoff the
+        # target row's adopters carry the source prefix (truncated at the
+        # first fence), so prefix-compatibility must hold on whichever row
+        # currently owns the stream.
         invariants.check_log_matching({
-            g: [self.fsms[i][g].applied for i in range(self.N)]
+            g: [self.fsms[i][self.row_of(g)].applied for i in range(self.N)]
             for g in range(self.G)
         })
 
@@ -342,6 +386,11 @@ class ChaosCluster(_PlaneDrivenCluster):
             self.engines[i] = self._make(i)
         if nemesis is not None:
             nemesis.apply()
+        if self.migrator is not None:
+            # The controller round runs right after faults land: re-arm
+            # freezes, drive the fence, adopt fenced nodes, cut over at
+            # quorum (raft.migration.MigrationCoordinator.step).
+            self.migrator.step()
 
         # Background faults (the fuzz mode): maybe crash one node (only if
         # everyone else is up — keep quorum), maybe block one directed link
@@ -373,6 +422,8 @@ class ChaosCluster(_PlaneDrivenCluster):
                 self.fabric.flush()
 
         self.check_election_safety()
+        if self.migrator is not None:
+            invariants.check_migration_state(self)
         if self.tick_no % 10 == 0:
             self.check_log_matching()
 
@@ -393,15 +444,19 @@ class ChaosCluster(_PlaneDrivenCluster):
         if self.rng.random() > self.propose_rate or self.proposed >= self.max_proposals:
             return
         g = self.rng.randrange(self.G)
+        row = self.row_of(g)
         # Propose on the node that believes it leads (if any); chaos means
         # it may be deposed — failures are fine, only acks must be durable.
+        # Acks are keyed by STREAM, proposals target the owning ROW (a
+        # frozen source refuses with NotLeader, exactly like a deposed
+        # leader — the retry lands after the cutover re-route).
         for i in self.live_nodes():
             e = self.engines[i]
-            if e.is_leader(g):
+            if e.is_leader(row):
                 payload = b"p%d" % self.proposed
                 self.proposed += 1
                 self.submit_tick[payload] = self.tick_no
-                self.pending.append((g, payload, e.propose(g, payload)))
+                self.pending.append((g, payload, e.propose(row, payload)))
                 return
 
     def heal(self, ticks: int = 120):
@@ -417,6 +472,12 @@ class ChaosCluster(_PlaneDrivenCluster):
         # the fault-event log a pure record of the chaotic phase.
         for _ in range(ticks):
             self.plane.advance(1)
+            if self.migrator is not None:
+                # An in-flight migration ROLLS FORWARD through healing:
+                # the fence commits on the clean network, adoption
+                # completes, and the cutover resolves to a single owner
+                # before the convergence epilogue checks it.
+                self.migrator.step()
             for _, dst, m in self.delayed:
                 self.engines[dst].receive(m)
                 self.host_delivered += 1
@@ -432,28 +493,42 @@ class ChaosCluster(_PlaneDrivenCluster):
                 if self.fabric is not None:
                     self.fabric.flush()
             self.check_election_safety()
+            if self.migrator is not None:
+                invariants.check_migration_state(self)
 
     def assert_converged_and_linearizable(self):
         """Single agreed leader per group; identical chains and FSM logs;
         every acked write durable, exactly-once, in real-time order."""
+        if self.migrator is not None:
+            # A migration must have resolved (cutover or abort) before the
+            # epilogue checks ownership — heal() drives the coordinator, so
+            # an unresolved one here is a roll-forward bug, not a timeout.
+            invariants.check_migration_resolved(self.migrator)
         for g in range(self.G):
+            r = self.row_of(g)
             invariants.check_converged(
                 [(i, self.engines[i]) for i in range(self.N)],
-                [self.fsms[i][g].applied for i in range(self.N)],
-                self.acked[g], self.submit_tick, self.ack_tick, g)
+                [self.fsms[i][r].applied for i in range(self.N)],
+                self.acked[g], self.submit_tick, self.ack_tick, r)
         self.check_log_matching()
 
     def state_digest(self) -> dict:
         """A JSON-safe fingerprint of the converged cluster: per-group
         (head, committed, term) plus every node's applied FSM sequence.
-        Two same-seed runs must produce identical digests."""
-        return {
+        Two same-seed runs must produce identical digests. Streams are
+        read through their OWNING row, so a digest is placement-invariant
+        modulo the explicit ``migration`` block (present only when the
+        migration plane is armed, keeping legacy digests byte-identical)."""
+        digest = {
             "groups": {
                 str(g): {
-                    "head": int(self.engines[0].chains[g].head),
-                    "committed": int(self.engines[0].chains[g].committed),
-                    "terms": [int(self.engines[i].term(g)) for i in range(self.N)],
-                    "logs": [[p.decode("latin1") for p in self.fsms[i][g].applied]
+                    "head": int(self.engines[0].chains[self.row_of(g)].head),
+                    "committed": int(
+                        self.engines[0].chains[self.row_of(g)].committed),
+                    "terms": [int(self.engines[i].term(self.row_of(g)))
+                              for i in range(self.N)],
+                    "logs": [[p.decode("latin1")
+                              for p in self.fsms[i][self.row_of(g)].applied]
                              for i in range(self.N)],
                 }
                 for g in range(self.G)
@@ -461,6 +536,23 @@ class ChaosCluster(_PlaneDrivenCluster):
             "acked": {str(g): [p.decode("latin1") for p in self.acked[g]]
                       for g in range(self.G)},
         }
+        if self.migration:
+            digest["migration"] = {
+                "stream_row": list(self.stream_row),
+                "spare_row": self.spare_row,
+                "row_inc": {str(r): self.migrator.row_inc[r]
+                            for r in sorted(self.migrator.row_inc)},
+            }
+        return digest
+
+    def migration_summary(self) -> dict | None:
+        """Coordinator outcome telemetry for the soak result (None when
+        the migration plane is off, keeping legacy artifacts unchanged)."""
+        if self.migrator is None:
+            return None
+        return {**self.migrator.summary(),
+                "stream_row": list(self.stream_row),
+                "spare_row": self.spare_row}
 
 
 class MembershipChaosCluster(_PlaneDrivenCluster):

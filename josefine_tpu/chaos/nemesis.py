@@ -55,8 +55,15 @@ from josefine_tpu.chaos.faults import FaultPlane
 #: WirePlane (chaos/wire.py) instead of touching the message plane.
 WIRE_OPS = ("conn_reset", "conn_stall", "torn_frames", "accept_refuse")
 
+#: Migration ops: they drive the cluster's MigrationCoordinator (live
+#: group handoff between engine rows) instead of the fault plane. On a
+#: cluster without the migration plane armed — or when the coordinator
+#: declines (migration already in flight, stream out of range, nothing to
+#: abort) — they are skipped-and-recorded like an unresolvable target.
+MIGRATION_OPS = ("migrate", "migrate_abort")
+
 _OPS = ("block_link", "heal_link", "partition", "isolate", "heal_all",
-        "crash", "restart", "disk", "skew") + WIRE_OPS
+        "crash", "restart", "disk", "skew") + WIRE_OPS + MIGRATION_OPS
 
 #: Connection roles a wire op may scope to.
 ROLES = ("client", "broker", "any")
@@ -93,6 +100,8 @@ OP_ARGS: dict[str, dict[str, tuple[str, ...]]] = {
     "conn_stall":    {"required": ("for",), "optional": ("role",)},
     "torn_frames":   {"required": ("for",), "optional": ("role", "p")},
     "accept_refuse": {"required": ("for",), "optional": ()},
+    "migrate":       {"required": (), "optional": ("stream",)},
+    "migrate_abort": {"required": (), "optional": ()},
 }
 
 
@@ -102,7 +111,7 @@ def _is_int(v) -> bool:
 
 def _check_arg(name: str, v) -> str | None:
     """One argument's domain check; returns an error string or None."""
-    if name in ("src", "dst", "node", "group"):
+    if name in ("src", "dst", "node", "group", "stream"):
         if not _is_int(v) or v < 0:
             return f"{name}={v!r} must be a node/group index >= 0"
     elif name in ("a", "b"):
@@ -299,6 +308,23 @@ class Nemesis:
 
     def _apply(self, step: Step) -> None:
         p, a = self.plane, step.args
+        if step.op in MIGRATION_OPS:
+            coord = getattr(self.cluster, "migrator", None)
+            ok = False
+            if coord is not None:
+                if step.op == "migrate":
+                    ok = coord.begin(int(a.get("stream", 1)))
+                else:
+                    ok = coord.abort()
+            if not ok:
+                # No migration plane on this cluster, or the coordinator
+                # declined (one-in-flight rule / pinned stream / nothing
+                # to abort): skip-and-record so a mutated genome carrying
+                # migration ops stays runnable everywhere.
+                p._event("nemesis_skipped", op=step.op, at=step.at)
+                self.skipped.append({"at": step.at, "op": step.op,
+                                     "target": "migration"})
+            return
         if step.op in WIRE_OPS:
             wire = getattr(p, "wire", None)
             if wire is None:
@@ -514,4 +540,68 @@ WIRE_SCHEDULES = {
     "wire-stall": wire_stall,
     "wire-leader-partition": wire_leader_partition,
     "wire-reconnect-loss": wire_reconnect_loss,
+}
+
+
+# ----------------------------------------------- bundled migration schedules
+#
+# Kept OUT of SCHEDULES for the same determinism reason as the wire
+# catalog: the search bootstraps from sorted(SCHEDULES), and growing that
+# dict would shift every committed corpus's seeded parent draws. Migration
+# search mode merges this catalog in explicitly (chaos/search.py), and the
+# soak CLIs resolve these names only alongside --migration. Stream 0 is
+# never migrated (pinned to the metadata row — the coordinator refuses it),
+# so the builders target stream 1, the first migratable stream on the
+# default 2-stream soak shape.
+
+def migrate_leader_partition(n_nodes: int = 3) -> Schedule:
+    """The tentpole race: a live migration begins, then the SOURCE row's
+    leader is cut off mid-handoff — the fence must re-propose on the new
+    leader and the cutover roll forward; a second migration after heal
+    moves the stream again (the freed source is the new spare), proving
+    the row pool stays coherent across repeated handoffs."""
+    steps = [
+        Step(at=40, op="migrate", args={"stream": 1}),
+        Step(at=55, op="isolate", args={"target": "leader", "group": 1,
+                                        "for": 40}),
+        Step(at=180, op="migrate", args={"stream": 1}),
+    ]
+    return Schedule("migrate-leader-partition", steps, horizon=320)
+
+
+def migrate_under_election(n_nodes: int = 3) -> Schedule:
+    """Leader crash right as the migration freezes the source: the fence
+    must commit through the ensuing election, and a repeat round crashes
+    the leader again mid-adoption — both resolve to a single owner."""
+    steps = [
+        Step(at=40, op="migrate", args={"stream": 1}),
+        Step(at=42, op="crash", args={"target": "leader", "group": 1,
+                                      "for": 25}),
+        Step(at=170, op="migrate", args={"stream": 1}),
+        Step(at=172, op="crash", args={"target": "leader", "group": 1,
+                                       "for": 25}),
+    ]
+    return Schedule("migrate-under-election", steps, horizon=300)
+
+
+def migrate_abort(n_nodes: int = 3) -> Schedule:
+    """Abort path: a migration is rolled BACK mid-handoff (source stays
+    the single owner, the adopted target rows recycle), then a fresh
+    migration of the same stream runs to cutover — the aborted target
+    row's stale life must be invisible to the new one."""
+    steps = [
+        Step(at=40, op="migrate", args={"stream": 1}),
+        # Two ticks in: the fence is proposed but the handoff has not
+        # reached quorum adoption — the abort lands mid-flight, not on an
+        # already-resolved migration.
+        Step(at=42, op="migrate_abort", args={}),
+        Step(at=120, op="migrate", args={"stream": 1}),
+    ]
+    return Schedule("migrate-abort", steps, horizon=300)
+
+
+MIGRATION_SCHEDULES = {
+    "migrate-leader-partition": migrate_leader_partition,
+    "migrate-under-election": migrate_under_election,
+    "migrate-abort": migrate_abort,
 }

@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from josefine_tpu.chaos.faults import NetFaults
 from josefine_tpu.chaos.nemesis import (
     DISK_FAULTS,
+    MIGRATION_SCHEDULES,
     ROLES,
     SCHEDULES,
     TARGETS,
@@ -168,13 +169,21 @@ class Mutator:
 
     def __init__(self, rng: random.Random, n_nodes: int,
                  limits: SearchLimits, workload_genome: bool = False,
-                 wire: bool = False):
+                 wire: bool = False, migration: bool = False,
+                 n_streams: int = 0):
         self.rng = rng
         self.n_nodes = n_nodes
+        self.n_streams = n_streams
         self.limits = limits
         # Wire mode mutates over the socket-fate op catalog (plus the
         # raft-plane partitions the wire soak's interceptors honor).
         self.insert_ops = _WIRE_INSERT_OPS if wire else _INSERT_OPS
+        if migration:
+            # Migration ops join the draw ONLY when the soak arms the
+            # migration plane (on a plain cluster they are skipped, i.e.
+            # wasted steps), so existing seeded lineages stay byte-stable.
+            self.insert_ops = self.insert_ops + (
+                "migrate", "migrate", "migrate_abort")
         if n_nodes < 2:
             # Link-topology ops need a second node to point at.
             self.insert_ops = tuple(
@@ -246,7 +255,7 @@ class Mutator:
         """Point a step somewhere else: flip leader<->follower, move a
         node index, or re-draw a link/partition's endpoints."""
         idx = [i for i, st in enumerate(g.schedule.steps)
-               if st.op != "heal_all"]
+               if st.op not in ("heal_all", "migrate_abort")]
         if not idx:
             return None
         i = self.rng.choice(idx)
@@ -258,6 +267,8 @@ class Mutator:
             cur = args.get("role", "any")
             args["role"] = self.rng.choice(
                 [r for r in ROLES if r != cur])
+        elif st.op == "migrate":
+            args["stream"] = self._stream()
         elif "target" in args:
             args["target"] = ("follower" if args["target"] == "leader"
                               else "leader")
@@ -342,6 +353,11 @@ class Mutator:
         else:
             args["target"] = self.rng.choice(TARGETS)
 
+    def _stream(self) -> int:
+        # Stream 0 is pinned (metadata row) — the coordinator would just
+        # skip it, so the draw starts at 1.
+        return self.rng.randrange(1, max(2, self.n_streams))
+
     def _gen_step(self, horizon: int) -> Step:
         """One fresh random step, drawn from the op catalog with args in
         their validated domains (nemesis.OP_ARGS is the contract)."""
@@ -391,6 +407,10 @@ class Mutator:
         elif op == "skew":
             args = {"stride": rng.randint(2, 4)}
             self._node_or_target(args)
+        elif op == "migrate":
+            args = {"stream": self._stream()}
+        elif op == "migrate_abort":
+            args = {}
         else:  # heal_all
             args = {}
         return Step(at=at, op=op, args=args)
@@ -526,7 +546,8 @@ class ChaosSearch:
                  repro_dir: str | None = None,
                  log_path: str | None = None,
                  start_iteration: int | None = None,
-                 wire: bool = False, wire_opts: dict | None = None):
+                 wire: bool = False, wire_opts: dict | None = None,
+                 migration: bool = False):
         self.seed = seed
         self.corpus = corpus
         self.n_nodes = n_nodes
@@ -539,7 +560,17 @@ class ChaosSearch:
         # (tenants, produce_every, commitless_limit, ...).
         self.wire = wire
         self.wire_opts = dict(wire_opts or {})
-        self.schedules = WIRE_SCHEDULES if wire else SCHEDULES
+        # Migration mode: every candidate soak arms the migration plane
+        # (spare row + coordinator), the migration nemeses join the
+        # bootstrap/parent catalog, and the mutator draws migrate /
+        # migrate_abort ops. Off (the default) leaves the classic search
+        # byte-identical — the base SCHEDULES dict must never grow (its
+        # sorted order seeds every committed corpus's parent draws).
+        self.migration = migration and not wire
+        self.schedules = (
+            WIRE_SCHEDULES if wire
+            else {**SCHEDULES, **MIGRATION_SCHEDULES} if self.migration
+            else SCHEDULES)
         if wire:
             workload = None  # the wire driver owns its own tenant spec
         self.active_set = active_set
@@ -566,7 +597,8 @@ class ChaosSearch:
         self.rng = random.Random(seed * 2654435761 + start_iteration)
         self.mutator = Mutator(self.rng, n_nodes, self.limits,
                                workload_genome=self.workload is not None,
-                               wire=wire)
+                               wire=wire, migration=self.migration,
+                               n_streams=groups)
         self.log_lines: list[dict] = []
         self.admitted = 0
         self.violations = 0
@@ -588,6 +620,7 @@ class ChaosSearch:
             "flight_wire": self.flight_wire, "quiet_net": self.quiet_net,
             "commitless_limit": self.commitless_limit,
             "flight_ring": self.flight_ring,
+            "migration": self.migration,
         }
         if self.wire:
             cfg["wire"] = True
@@ -610,7 +643,7 @@ class ChaosSearch:
             active_set=self.active_set, hb_ticks=self.hb_ticks,
             device_route=self.device_route, flight_wire=self.flight_wire,
             workload=workload, commitless_limit=self.commitless_limit,
-            flight_ring=self.flight_ring,
+            flight_ring=self.flight_ring, migration=self.migration,
             # Search runs keep their own repro records; the per-violation
             # auto-artifact (journals+registry) would litter the cwd once
             # per probe during minimization.

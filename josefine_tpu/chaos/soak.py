@@ -17,8 +17,10 @@ import os
 
 from josefine_tpu.chaos.faults import FaultPlane, NetFaults
 from josefine_tpu.chaos.harness import DEFAULT_PARAMS, ChaosCluster
-from josefine_tpu.chaos.invariants import InvariantViolation
-from josefine_tpu.chaos.nemesis import SCHEDULES, Nemesis, Schedule
+from josefine_tpu.chaos.invariants import (InvariantViolation,
+                                           duplicate_acked_count)
+from josefine_tpu.chaos.nemesis import (MIGRATION_SCHEDULES, SCHEDULES,
+                                        Nemesis, Schedule)
 from josefine_tpu.models.types import step_params
 from josefine_tpu.utils.coverage import CoverageMap
 from josefine_tpu.utils.flight import merge_journals, timeline_jsonl
@@ -38,6 +40,11 @@ def resolve_schedule(name_or_schedule, n_nodes: int = 3) -> Schedule:
         return name_or_schedule.validate(n_nodes)
     if name_or_schedule in SCHEDULES:
         return SCHEDULES[name_or_schedule](n_nodes)
+    if name_or_schedule in MIGRATION_SCHEDULES:
+        # Bundled migration nemeses resolve by name too; they only DO
+        # anything on a soak with the migration plane armed (elsewhere
+        # their migrate steps skip-and-record, by the nemesis contract).
+        return MIGRATION_SCHEDULES[name_or_schedule](n_nodes)
     return Schedule.from_json(name_or_schedule).validate(n_nodes)
 
 
@@ -55,7 +62,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          artifact_path: str | None = None,
                          flight_ring: int | None = None,
                          commitless_limit: int | None = None,
-                         request_spans: bool = False) -> dict:
+                         request_spans: bool = False,
+                         migration: bool = False) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -142,7 +150,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                            payload_ring=payload_ring and device_route,
                            flight_wire=flight_wire, workload=traffic,
                            flight_ring=flight_ring or 4096,
-                           request_spans=request_spans)
+                           request_spans=request_spans,
+                           migration=migration)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -186,8 +195,16 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                     f"(> commitless_limit {commitless_limit}) at tick "
                     f"{cluster.tick_no}")
         if spans_rec is not None:
-            spans_rec.fault_active = False
+            # A migration still unresolved at the horizon keeps the fault
+            # arm up through heal: requests straddling the cutover retain
+            # their spans unconditionally, so request_report can name the
+            # migration stall as a dominant phase (the dual-ownership
+            # window is a fault window for attribution purposes).
+            spans_rec.fault_active = (cluster.migrator is not None
+                                      and cluster.migrator.mig is not None)
         cluster.heal(sched.heal_ticks)
+        if spans_rec is not None:
+            spans_rec.fault_active = False
         cluster.harvest_traffic()
         cluster.assert_converged_and_linearizable()
     except InvariantViolation as e:
@@ -251,6 +268,15 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
             "(chaos_soak --flight-ring)", ring_dropped, cluster.flight_ring)
 
     acked_total = sum(len(v) for v in cluster.acked.values())
+    # Idempotent-produce verdict: acked payloads applied more than once in
+    # the final owner-row logs. Expected 0 — the retry machinery re-proposes
+    # under FRESH payloads, and migration carries the applied prefix exactly
+    # once — recorded (not just asserted) so a regression shows up as a
+    # nonzero number in every soak summary, not only when a checker trips.
+    dup_acked = sum(
+        duplicate_acked_count(cluster.acked[g],
+                              cluster.fsms[0][cluster.row_of(g)].applied)
+        for g in range(groups))
     return {
         "schedule": sched.name,
         "seed": seed,
@@ -315,6 +341,14 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # history — size the ring up for searched soaks at scale.
         "flight_ring": {"capacity": cluster.flight_ring,
                         "dropped": ring_dropped},
+        # Live-migration epilogue (None with the plane off): coordinator
+        # outcomes, pause ticks (the refused-traffic window), final
+        # stream->row placement, and per-row incarnations.
+        "migration": cluster.migration_summary(),
+        # Idempotent-produce duplicate scan: acked payloads seen >1x in
+        # the owner-row applied logs (expected clean; see above).
+        "dup_check": {"dup_acked": dup_acked,
+                      "verdict": "clean" if dup_acked == 0 else "DUPLICATES"},
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "artifact": artifact,

@@ -141,6 +141,16 @@ class Node:
         # replica host's ack commits.
         self._pending_acks: list[int] = []
         self._ack_task: asyncio.Task | None = None
+        # Live migration (Kafka-style reassignment through the metadata
+        # FSM): begin freezes the source row and arms the fence; the fence
+        # commit hands the carried prefix to the target row; the last
+        # host ack cuts over (source purged + drained back to the pool).
+        self.fsm.on_migration_begin = self._migration_begin
+        self.fsm.on_migration_cutover = self._migration_cutover
+        self.fsm.on_migration_abort = self._migration_abort
+        self._mig_fences: list = []   # migrations whose fence we drive
+        self._mig_acks: list = []     # migrations whose handoff we must ack
+        self._mig_task: asyncio.Task | None = None
         self._rewire_partitions()
         self._register_task: asyncio.Task | None = None
         # Observability endpoint (TPU-build addition; the reference's only
@@ -205,6 +215,16 @@ class Node:
         # (their conf-REMOVE prune may predate our durable state).
         self.store.prune_drains(
             m.node_id for m in eng.members.by_id.values() if m.active)
+        # Migrations still in flight while we were down roll FORWARD: the
+        # begin hook is idempotent (re-freeze, re-arm the fence, re-attach
+        # an already-adopted target row and re-ack). A fence that committed
+        # before the crash but whose adoption did not is re-proposed — the
+        # duplicate fence is a no-op on the source FSM and its apply
+        # re-fires the adoption at the same carried prefix.
+        for m in self.store.get_migrations():
+            p = self.store.get_partition(m.topic, m.idx)
+            if p is not None:
+                self._migration_begin(m, p)
 
     def _on_conf_applied(self, change) -> None:
         from josefine_tpu.raft.membership import REMOVE
@@ -305,6 +325,200 @@ class Node:
                 log.exception("release ack for row %d failed; retrying", g)
                 await asyncio.sleep(0.5)
 
+    # ------------------------------------------------------ live migration
+
+    def _hosts_partition(self, p) -> bool:
+        return self.config.broker.id in p.assigned_replicas
+
+    def _replica_slots(self, p) -> set[int]:
+        eng = self.raft.engine
+        slots = {eng.members.slot_of(b) for b in p.assigned_replicas}
+        slots.discard(None)
+        return slots
+
+    def _migration_begin(self, m, p) -> None:
+        """Commit-time hook (MigrationBegin applied) and restart re-arm:
+        freeze the source row (new proposals fail with retryable NotLeader
+        — the dual-ownership window), wire the fence trigger on the local
+        source FSM, and start driving the fence proposal. Idempotent."""
+        eng = self.raft.engine
+        src, dst = m.src_group, m.dst_group
+        if not (0 < src < eng.P and 0 < dst < eng.P):
+            return
+        eng.freeze_group(src)
+        if self._hosts_partition(p):
+            drv = eng.drivers.get(src)
+            if drv is not None:
+                drv.fsm.on_fence = (
+                    lambda _bid, m=m, p=p: self._adopt_migration(m, p))
+            if int(self.kv.get(b"ginc:%d" % dst) or -1) == m.inc:
+                # Crash after handoff, before cutover: the adoption is
+                # durable (target chain + position record) — re-attach
+                # the target FSM and re-ack.
+                self._reattach_dst(m, p)
+            elif m not in self._mig_fences:
+                self._mig_fences.append(m)
+                self._kick_migs()
+
+    def _adopt_migration(self, m, p) -> None:
+        """The handoff, fired at fence commit on the source row: move the
+        partition's consensus state into the target row. The seglog
+        belongs to the PARTITION and stays in place — a header-only export
+        at the log end adopts position + producer-dedup state without
+        rewriting a byte of log; only chain/device/term state moves rows
+        (migrate_adopt_row). Runs inside commit-apply like the release
+        hooks (the established cross-row mutation point)."""
+        from josefine_tpu.broker.state import Migration  # noqa: F401
+
+        eng = self.raft.engine
+        src, dst = m.src_group, m.dst_group
+        cur = self.store.get_migration(m.topic, m.idx)
+        if cur is None or cur.dst_group != dst:
+            return  # resolved (cutover/abort) while the fence was in flight
+        if int(self.kv.get(b"ginc:%d" % dst) or -1) == m.inc \
+                and dst in eng.drivers:
+            return  # duplicate fence: already adopted
+        drv = eng.drivers.get(src)
+        if drv is None:
+            return
+        src_fsm = drv.fsm
+        record = src_fsm.snapshot()
+        export = src_fsm.snapshot_export(
+            record, start=src_fsm.snapshot_resume_offset())
+        rep = self.broker.broker.replicas.ensure(p)
+        # The target position record must exist BEFORE binding a
+        # PartitionFsm over the (non-empty) shared log — the foreign-log
+        # guard would wipe it otherwise.
+        self.kv.put(b"pfsm:%d" % dst, record)
+        dst_fsm = PartitionFsm(
+            self.kv, dst, rep.log,
+            on_append=self.broker.broker.signal_append,
+            fsync=self.config.broker.durability == "power")
+        eng.register_fsm(dst, dst_fsm)
+        eng.migrate_adopt_row(dst, src_fsm.applied_id(), export, m.inc)
+        # Adoption reverts the row to full membership; restrict it to the
+        # partition's replica hosts so quorum is over the hosts that ack.
+        eng.set_group_members(dst, self._replica_slots(p))
+        self.kv.put(b"ginc:%d" % dst, b"%d" % m.inc)
+        if m not in self._mig_acks:
+            self._mig_acks.append(m)
+        self._kick_migs()
+
+    def _reattach_dst(self, m, p) -> None:
+        """Restart path for a host that adopted before crashing: re-bind
+        the target FSM over the shared log (register replays the durable
+        chain's committed suffix exactly) and re-propose the ack."""
+        eng = self.raft.engine
+        dst = m.dst_group
+        if dst not in eng.drivers:
+            rep = self.broker.broker.replicas.ensure(p)
+            eng.register_fsm(dst, PartitionFsm(
+                self.kv, dst, rep.log,
+                on_append=self.broker.broker.signal_append,
+                fsync=self.config.broker.durability == "power"))
+        eng.set_group_members(dst, self._replica_slots(p))
+        eng.set_group_incarnation(dst, m.inc)
+        if m not in self._mig_acks:
+            self._mig_acks.append(m)
+        self._kick_migs()
+
+    def _migration_cutover(self, m, p) -> None:
+        """Commit-time hook (last handoff ack applied): the partition now
+        points at the target row. Purge the source exactly like a recycle
+        (pending queues, route/ring planes, pipelined dispatches — the
+        dead owner's in-flight traffic dies at intake), queue the drain
+        ack, and re-wire the partition at its new row."""
+        eng = self.raft.engine
+        src = m.src_group
+        self._mig_fences = [f for f in self._mig_fences
+                            if f.dst_group != m.dst_group]
+        if 0 < src < eng.P:
+            drv = eng.drivers.get(src)
+            if drv is not None:
+                drv.fsm.on_fence = None
+            eng.unregister_fsm(src)
+            eng.migrate_purge_source(src, self.store.group_incarnation(src))
+            if self._hosts_partition(p):
+                self.kv.delete(b"pfsm:%d" % src)
+                self.kv.delete(b"pfsm:r:%d" % src)
+                self.kv.delete(b"ginc:%d" % src)
+                if src not in self._pending_acks:
+                    self._pending_acks.append(src)
+                self._kick_acks()
+        self._wire_partition(p)
+
+    def _migration_abort(self, m, p) -> None:
+        """Commit-time hook (MigrationAbort applied): the source row is
+        the single owner again; the claimed target row drains back to the
+        pool (hosts that already adopted reset it like a released row)."""
+        eng = self.raft.engine
+        src, dst = m.src_group, m.dst_group
+        self._mig_fences = [f for f in self._mig_fences if f.dst_group != dst]
+        self._mig_acks = [a for a in self._mig_acks if a.dst_group != dst]
+        if 0 < src < eng.P:
+            drv = eng.drivers.get(src)
+            if drv is not None:
+                drv.fsm.on_fence = None
+            eng.unfreeze_group(src)
+        if 0 < dst < eng.P and self._hosts_partition(p):
+            eng.unregister_fsm(dst)
+            eng.set_group_members(dst, set())
+            self._reset_released_row(dst)
+
+    def _kick_migs(self) -> None:
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return  # constructed outside the loop: start() kicks
+        if self._mig_task is None or self._mig_task.done():
+            if self._mig_fences or self._mig_acks:
+                self._mig_task = loop.create_task(self._drain_migrations())
+
+    async def _drain_migrations(self) -> None:
+        """Migration proposal lane (the _drain_acks pattern): handoff acks
+        first (they resolve migrations), then fence proposals for
+        migrations still waiting on their handoff point. Entries retire
+        when the replicated record shows them done or superseded."""
+        from josefine_tpu.raft.migration import migration_fence
+
+        while ((self._mig_fences or self._mig_acks)
+               and not self.shutdown.is_shutdown):
+            for m in list(self._mig_acks):
+                cur = self.store.get_migration(m.topic, m.idx)
+                if (cur is None or cur.dst_group != m.dst_group
+                        or self.config.broker.id in cur.acks):
+                    if m in self._mig_acks:
+                        self._mig_acks.remove(m)
+                    continue
+                payload = Transition.migration_ack(
+                    m.topic, m.idx, m.dst_group, self.config.broker.id)
+                try:
+                    await self.client.propose(payload, timeout=5.0)
+                    if m in self._mig_acks:
+                        self._mig_acks.remove(m)
+                except asyncio.CancelledError:
+                    return
+                except Exception:  # noqa: BLE001 - retried below
+                    pass
+            for m in list(self._mig_fences):
+                cur = self.store.get_migration(m.topic, m.idx)
+                adopted = (int(self.kv.get(b"ginc:%d" % m.dst_group) or -1)
+                           == m.inc)
+                if cur is None or cur.dst_group != m.dst_group or adopted:
+                    if m in self._mig_fences:
+                        self._mig_fences.remove(m)
+                    continue
+                payload = migration_fence(m.src_group, m.dst_group)
+                try:
+                    await self.client.propose(payload, group=m.src_group,
+                                              timeout=5.0)
+                except asyncio.CancelledError:
+                    return
+                except Exception:  # noqa: BLE001 - retried below
+                    pass
+            if self._mig_fences or self._mig_acks:
+                await asyncio.sleep(0.5)
+
     def _drop_topic_local(self, name: str) -> None:
         replicas = self.broker.broker.replicas
         dirs = replicas.release_topic(name)
@@ -324,6 +538,7 @@ class Node:
             await self.metrics_server.start()
         self._register_task = asyncio.create_task(self._register_self())
         self._kick_acks()
+        self._kick_migs()
 
     async def _register_self(self) -> None:
         """Propose EnsureBroker(self) until the cluster has a leader."""
@@ -359,6 +574,9 @@ class Node:
         if self._ack_task:
             self._ack_task.cancel()
             await asyncio.gather(self._ack_task, return_exceptions=True)
+        if self._mig_task:
+            self._mig_task.cancel()
+            await asyncio.gather(self._mig_task, return_exceptions=True)
         # Raft first: broker.stop() closes the replica logs, and the engine
         # must not tick or receive (commit-apply, snapshot restore) after
         # that — a restore interrupted by a closed log orphans its intent

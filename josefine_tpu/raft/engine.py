@@ -68,6 +68,7 @@ from josefine_tpu.raft.group_admin import (
 )
 from josefine_tpu.raft.hostio import HostIO
 from josefine_tpu.raft.membership import ConfChange, MemberTable, is_conf
+from josefine_tpu.raft.migration import is_migration_fence
 from josefine_tpu.raft.packed_step import (
     _MIRROR13_ROWS,
     _active_window_fn,
@@ -303,6 +304,13 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # tests/test_reset_safety.py), the committed sequence is unique
         # again and incremental resume is back ON by default.
         self.snap_incremental = True
+        # Migration freeze (volatile): groups whose row is the SOURCE of an
+        # in-progress live migration refuse NEW proposals with a retryable
+        # NotLeader (the dual-ownership window — clients re-route/retry per
+        # the PR 13 machinery). Volatile by design: a restarted engine comes
+        # back unfrozen and the migration coordinator re-freezes it (or the
+        # cutover already purged the row). See raft/migration.py.
+        self._frozen_groups: set[int] = set()
         # Vote parole (durable): group -> pre-reset head watermark. A group
         # that reset its chain abstains from elections until its head has
         # been re-replicated past everything it may have acked (see
@@ -896,6 +904,16 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         fut = asyncio.get_running_loop().create_future()
         if is_conf(payload) and group != 0:
             fut.set_exception(ValueError("conf changes must go through group 0"))
+            return fut
+        if group in self._frozen_groups and not is_migration_fence(payload):
+            # Dual-ownership window: this row is the source of a live
+            # migration. Refuse with the same retryable error as a deposed
+            # leader — the client's retry/reroute machinery carries the
+            # traffic across the cutover. The migration FENCE itself must
+            # still commit through the frozen row (it marks the handoff
+            # point in the applied sequence), hence the payload-prefix
+            # bypass.
+            fut.set_exception(NotLeader(group, -1))
             return fut
         span = None
         if self._request_spans:

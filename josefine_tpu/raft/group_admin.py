@@ -21,7 +21,7 @@ import numpy as np
 
 from josefine_tpu.ops import ids
 from josefine_tpu.raft import rpc
-from josefine_tpu.raft.chain import GENESIS
+from josefine_tpu.raft.chain import GENESIS, id_seq, id_term
 from josefine_tpu.raft.fsm import Driver, Fsm, ReplicaDiverged, supports_snapshot
 from josefine_tpu.raft.membership import ConfChange, is_conf
 from josefine_tpu.raft.result import NotLeader, TickResult
@@ -171,6 +171,117 @@ class GroupAdmin:
         self._recycled_this_tick.add(g)
         self.flight.emit(self._flight_tick(), "group_recycled", group=g,
                          inc=int(self._h_ginc[g]))
+
+    # ---------------------------------------------------- live migration
+
+    def freeze_group(self, g: int) -> None:
+        """Arm the migration dual-ownership window on a SOURCE row: new
+        proposals fail with a retryable NotLeader (see engine.propose —
+        the migration fence payload is exempt), and queued-but-unminted
+        proposals are failed the same way, so nothing can mint after the
+        fence. Volatile by design: a restarted engine revives unfrozen and
+        the migration controller re-arms it. Idempotent."""
+        if not (0 < g < self.P):
+            raise ValueError(f"group {g} not a data group (P={self.P})")
+        if g in self._frozen_groups:
+            return
+        self._frozen_groups.add(g)
+        for _payload, fut, _t_sub, _span in self._proposals.pop(g, ()):
+            if fut is not None and not fut.done():
+                fut.set_exception(NotLeader(g, -1))
+        self._prop_groups.discard(g)
+        self.flight.emit(self._flight_tick(), "migration_started", group=g,
+                         inc=int(self._h_ginc[g]))
+
+    def unfreeze_group(self, g: int) -> None:
+        """Lift the freeze without a cutover (migration aborted): the
+        source row is the single owner again."""
+        if g in self._frozen_groups:
+            self._frozen_groups.discard(g)
+            self.flight.emit(self._flight_tick(), "migration_aborted",
+                             group=g, inc=int(self._h_ginc[g]))
+
+    def group_frozen(self, g: int) -> bool:
+        return g in self._frozen_groups
+
+    def migrate_adopt_row(self, g: int, snap_id: int, snap_data: bytes,
+                          inc: int) -> None:
+        """Install a migrating group's carried prefix into TARGET row
+        ``g`` as a synthetic snapshot: recycle the row first (it may hold
+        a previous life — an aborted earlier attempt revived from durable
+        state — and ``install_snapshot`` requires ``snap_id`` above the
+        committed id; the purge inventory is exactly a reuse), restore the
+        FSM, then adopt chain/device/term per the ``_adopt_snapshot``
+        recipe and stamp the target incarnation so source-life frames die
+        at intake."""
+        if not (0 < g < self.P):
+            raise ValueError(f"group {g} not a data group (P={self.P})")
+        drv = self.drivers.get(g)
+        if drv is None or not supports_snapshot(drv.fsm):
+            raise ValueError(f"group {g} has no snapshot-capable FSM")
+        self.recycle_group(g)
+        drv.drop_waiters(NotLeader(g, -1))
+        drv.fsm.restore(snap_data)
+        snap_record = drv.fsm.snapshot()
+        ch = self.chains[g]
+        # Persist the snapshot record BEFORE mutating the chain (the
+        # take_snapshot/_adopt_snapshot crash-ordering rule: a floor above
+        # GENESIS with no matching record is unrecoverable).
+        self._store_snapshot(g, snap_id, snap_record)
+        ch.install_snapshot(snap_id)
+        # INVARIANT: every out-of-tick chain mutation must refresh the
+        # _h_head/_h_commit mirrors itself — tick_finish's need-mask skips
+        # quiet rows, so it will NOT heal a mirror this site leaves stale.
+        self._h_head[g] = ch.head
+        self._h_commit[g] = ch.committed
+        if self._active_set:
+            self._force_active.add(g)
+        snap_term = id_term(snap_id)
+        if snap_term > int(self._h_term[g]):
+            # term >= id_term(head) must hold or a later election won at a
+            # lower term would mint a non-advancing id; voted_for resets
+            # with the term, one atomic (term, voted) record.
+            self._store_vol(g, snap_term, -1)
+            self._h_term[g] = snap_term
+            self._h_voted[g] = -1
+            self.state = self.state.replace(
+                term=self.state.term.at[g].set(jnp.asarray(snap_term, _I32)),
+                voted_for=self.state.voted_for.at[g].set(
+                    jnp.asarray(-1, _I32)))
+        t = jnp.asarray(snap_term, _I32)
+        s = jnp.asarray(id_seq(snap_id), _I32)
+        self.state = self.state.replace(
+            head=ids.Bid(self.state.head.t.at[g].set(t),
+                         self.state.head.s.at[g].set(s)),
+            commit=ids.Bid(self.state.commit.t.at[g].set(t),
+                           self.state.commit.s.at[g].set(s)),
+        )
+        # Activate the row (spare rows are claim-idled — no elections; see
+        # migrate_purge_source). CRITICAL that this happens only WITH the
+        # snapshot in place: an electable empty spare could win the row at
+        # the snapshot's own term and then commit, off adopters' acks,
+        # blocks it never carried.
+        self.set_group_members(g, None)
+        self.set_group_incarnation(g, inc)
+        self.flight.emit(self._flight_tick(), "migration_handoff", group=g,
+                         snap_id=int(snap_id), inc=inc)
+
+    def migrate_purge_source(self, g: int, inc: int) -> None:
+        """Cutover purge of the SOURCE row: exactly a recycle (chain to
+        genesis, pending queues, route/ring planes, pipelined dispatches
+        all purged — see recycle_group) under the new incarnation so the
+        dead owner's in-flight traffic is dropped at intake; the freeze
+        dies with the row (the dual-ownership window is over) and the
+        freed row is the caller's new spare."""
+        self.recycle_group(g)
+        # Idle the freed row (empty claim: no elections, no traffic) until
+        # a future migration adopts into it — a recycled-but-electable
+        # spare would mint leader blocks that poison the next adoption.
+        self.set_group_members(g, frozenset())
+        self.set_group_incarnation(g, inc)
+        self._frozen_groups.discard(g)
+        self.flight.emit(self._flight_tick(), "migration_cutover", group=g,
+                         inc=inc)
 
     def configure_groups(self, claims: dict[int, frozenset[int] | set[int]]) -> None:
         """Replace ALL data-group claims at once (startup re-wiring from the

@@ -117,9 +117,13 @@ class ChaosTraffic:
     def _admit(self, cluster, t: int, arr: ProduceArrival, attempt: int,
                first: int) -> None:
         g = self.group_of(arr)
+        # Streams ride their OWNING engine row (identity unless a live
+        # migration cut over) — the re-route half of the client machinery:
+        # a retry that raced a cutover re-resolves to the new row here.
+        row = cluster.row_of(g) if hasattr(cluster, "row_of") else g
         leader = None
         for i in cluster.live_nodes():
-            if cluster.engines[i].is_leader(g):
+            if cluster.engines[i].is_leader(row):
                 leader = cluster.engines[i]
                 break
         if leader is None:
@@ -135,11 +139,11 @@ class ChaosTraffic:
             # runs on the soak loop, not in a per-request task.
             tok = bind_span(span)
             try:
-                fut = leader.propose(g, payload)
+                fut = leader.propose(row, payload)
             finally:
                 unbind_span(tok)
         else:
-            fut = leader.propose(g, payload)
+            fut = leader.propose(row, payload)
         cluster.submit_tick[payload] = t
         cluster.proposed += 1
         self.n_admitted += 1

@@ -138,6 +138,26 @@ class _InprocClient:
         return self._engine.proposal_backlog(group)
 
 
+class _PeerShimFsm:
+    """Snapshot-capable stand-in registered on the chain-only replica
+    engines at a migration TARGET row: replicas persist and ack while the
+    broker node serves, so apply is a no-op — but ``migrate_adopt_row``
+    refuses rows without a snapshot-capable FSM (an adoption that cannot
+    restore its carried header would silently discard the handoff)."""
+
+    def __init__(self):
+        self._record = b""
+
+    def transition(self, payload: bytes) -> bytes:
+        return b""
+
+    def snapshot(self) -> bytes:
+        return self._record
+
+    def restore(self, data: bytes) -> None:
+        self._record = data
+
+
 class _Consumer:
     """One consumer session of a tenant's group (modeled membership: the
     in-process plane drives assignment deterministically; the real
@@ -287,6 +307,18 @@ class TrafficEngine:
         # hook): without it the registry would hand a re-created topic its
         # predecessor's log and PartitionFsm's foreign-log reset fires.
         self.fsm.on_delete_topic = self.broker.replicas.drop_topic
+        # Live migration (ISSUE 16): the metadata FSM's reassignment
+        # transitions drive a row-to-row handoff under traffic.
+        self.fsm.on_migration_begin = self._migration_begin
+        self.fsm.on_migration_cutover = self._migration_cutover
+        self.fsm.on_migration_abort = self._migration_abort
+        # (topic, idx) -> pause ledger for the in-flight migration.
+        self._active_migs: dict[tuple[str, int], dict] = {}
+        self.migrations: list[dict] = []   # resolved, for the summary
+        self._mig_tasks: list[tuple[str, object]] = []
+        # Per-group commit heat (hot-tenant trigger input, paired with the
+        # engine's wake gauges at trigger time).
+        self._group_heat: dict[int, int] = {}
 
         self.tick = 0
         # Bootstrap batches membership claims into ONE mask rebuild
@@ -475,7 +507,8 @@ class TrafficEngine:
         drain = (self.spec.max_retries + 2) * 2 * self.spec.retry_backoff_max
         for _ in range(drain):
             if not (self._inflight or self._adm.pending()
-                    or self._commit_tasks or self._ack_tasks):
+                    or self._commit_tasks or self._ack_tasks
+                    or self._mig_tasks):
                 break
             await self._tick_once(offer=False)
         aborted = len(self._inflight) + self._adm.pending()
@@ -486,14 +519,18 @@ class TrafficEngine:
                 task.cancel()
             for _g, task in self._ack_tasks:
                 task.cancel()
+            for _n, task in self._mig_tasks:
+                task.cancel()
             await asyncio.gather(
                 *(f.task for f in self._inflight),
                 *(task for _, task in self._commit_tasks),
                 *(task for _, task in self._ack_tasks),
+                *(task for _, task in self._mig_tasks),
                 return_exceptions=True)
             self._inflight = []
             self._commit_tasks = []
             self._ack_tasks = []
+            self._mig_tasks = []
             self._adm.clear()
             self.trace.emit(self.tick, "drain_aborted", pending=aborted)
         if self._ledger:
@@ -603,6 +640,13 @@ class TrafficEngine:
                     self.trace.emit(t, "produce_rejected",
                                     tenant=arr.tenant, seq=arr.seq,
                                     code=code)
+                    mig = self._active_migs.get((arr.topic, arr.partition))
+                    if mig is not None:
+                        # Dual-ownership window: the frozen source refused
+                        # this attempt; the retry ledger reroutes it to the
+                        # target row after cutover — the migration pause in
+                        # request terms.
+                        mig["refused"] += 1
                 if self.store.topic_exists(arr.topic):
                     self._schedule_retry(t, f)
                 else:
@@ -636,6 +680,14 @@ class TrafficEngine:
             self.trace.emit(t, "recycle_ack", group=g)
         self._ack_tasks = still_a
 
+        still_m = []
+        for name, task in self._mig_tasks:
+            if not task.done():
+                still_m.append((name, task))
+                continue
+            task.result()  # handoff-drive errors surface loudly
+        self._mig_tasks = still_m
+
     def _record_commit(self, t: int, f: _Flight, base: int) -> None:
         arr = f.arr
         lat = t - f.first_tick
@@ -647,6 +699,8 @@ class TrafficEngine:
         part = self.store.get_partition(arr.topic, arr.partition)
         if part is not None and part.group >= 1:
             self.n_replicated += 1
+            self._group_heat[part.group] = \
+                self._group_heat.get(part.group, 0) + 1
         else:
             self.n_direct += 1
         self.trace.emit(t, "produce_ok", tenant=arr.tenant, seq=arr.seq,
@@ -860,6 +914,226 @@ class TrafficEngine:
         self.trace.emit(self.tick, "topic_ready", topic=name,
                         groups=len(groups))
 
+    # ---------------------------------------------------- live migration
+
+    def _migration_begin(self, m, p) -> None:
+        """Commit-time hook (MigrationBegin applied): freeze the source
+        row — the dual-ownership window opens, new proposals on it fail
+        with a retryable NotLeader and ride the retry ledger across the
+        cutover — then drive fence + handoff ack asynchronously."""
+        eng = self.engine
+        src, dst = m.src_group, m.dst_group
+        if not (0 < src < eng.P and 0 < dst < eng.P):
+            return
+        for e in self.engines:
+            e.freeze_group(src)
+        drv = eng.drivers.get(src)
+        if drv is not None:
+            drv.fsm.on_fence = (
+                lambda _bid, m=m, p=p: self._adopt_migration(m, p))
+        self._active_migs[(m.topic, m.idx)] = {
+            "topic": m.topic, "idx": m.idx, "src": src, "dst": dst,
+            "begin_tick": self.tick, "refused": 0,
+        }
+        self.trace.emit(self.tick, "migration_begin", topic=m.topic,
+                        part=m.idx, src=src, dst=dst)
+        self._mig_tasks.append((
+            f"{m.topic}/{m.idx}",
+            asyncio.ensure_future(self._drive_migration(m, p))))
+
+    async def _drive_migration(self, m, p) -> None:
+        """The Node ``_drain_migrations`` lane collapsed to the in-process
+        case: propose the fence on the frozen source row until its commit
+        adopts the target, then ack the handoff until cutover commits."""
+        from josefine_tpu.raft.migration import migration_fence
+
+        while True:
+            cur = self.store.get_migration(m.topic, m.idx)
+            if cur is None or cur.dst_group != m.dst_group:
+                return  # resolved under us (cutover or abort)
+            adopted = (m.dst_group in self.engine.drivers
+                       and int(self.kv.get(b"ginc:%d" % m.dst_group) or -1)
+                       == m.inc)
+            try:
+                if not adopted:
+                    await self.broker.client.propose(
+                        migration_fence(m.src_group, m.dst_group),
+                        group=m.src_group)
+                else:
+                    await self.broker.client.propose(
+                        Transition.migration_ack(m.topic, m.idx,
+                                                 m.dst_group, 1))
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                await self._settle(1)
+
+    def _adopt_migration(self, m, p) -> None:
+        """The handoff, at fence commit on the source row: the seglog
+        belongs to the PARTITION and stays put — a header-only export at
+        the log end carries position + producer-dedup state into a target
+        PartitionFsm over the SAME log; chain/device/term state moves rows
+        via migrate_adopt_row on every engine (replicas adopt through a
+        snapshot shim: they only persist and ack)."""
+        eng = self.engine
+        src, dst = m.src_group, m.dst_group
+        cur = self.store.get_migration(m.topic, m.idx)
+        if cur is None or cur.dst_group != dst:
+            return
+        if dst in eng.drivers and \
+                int(self.kv.get(b"ginc:%d" % dst) or -1) == m.inc:
+            return  # duplicate fence: already adopted
+        drv = eng.drivers.get(src)
+        if drv is None:
+            return
+        src_fsm = drv.fsm
+        record = src_fsm.snapshot()
+        export = src_fsm.snapshot_export(
+            record, start=src_fsm.snapshot_resume_offset())
+        snap_id = src_fsm.applied_id()
+        rep = self.broker.replicas.ensure(p)
+        # Target position record BEFORE binding over the non-empty shared
+        # log (the foreign-log guard wipes otherwise).
+        self.kv.put(b"pfsm:%d" % dst, record)
+        eng.register_fsm(dst, PartitionFsm(
+            self.kv, dst, rep.log, on_append=self.broker.signal_append))
+        eng.migrate_adopt_row(dst, snap_id, export, m.inc)
+        for peer in self.peers:
+            peer.register_fsm(dst, _PeerShimFsm())
+            peer.migrate_adopt_row(dst, snap_id, export, m.inc)
+        claim = set(range(self.replication))
+        for e in self.engines:
+            e.set_group_members(dst, claim)
+        eng.set_group_tag(dst, TenantModel.tenant_label(
+            TenantModel.tenant_of(p.topic)))
+        self.kv.put(b"ginc:%d" % dst, b"%d" % m.inc)
+        led = self._active_migs.get((m.topic, m.idx))
+        if led is not None:
+            led["handoff_tick"] = self.tick
+        self.trace.emit(self.tick, "migration_handoff", topic=m.topic,
+                        part=m.idx, src=src, dst=dst)
+
+    def _migration_cutover(self, m, p) -> None:
+        """Commit-time hook (last handoff ack applied): the store now
+        points the partition at the target row. Purge the source exactly
+        like a recycle on every engine — pending queues, route/ring
+        planes, pipelined dispatches die at intake under the bumped
+        incarnation — and queue its drain ack."""
+        eng = self.engine
+        src = m.src_group
+        drv = eng.drivers.get(src)
+        if drv is not None:
+            drv.fsm.on_fence = None
+        eng.unregister_fsm(src)
+        inc = self.store.group_incarnation(src)
+        for e in self.engines:
+            e.migrate_purge_source(src, inc)
+        self.kv.delete(b"pfsm:%d" % src)
+        self.kv.delete(b"pfsm:r:%d" % src)
+        self._pending_acks.append((src, inc))
+        self._group_heat.pop(src, None)
+        led = self._active_migs.pop((m.topic, m.idx), None)
+        if led is not None:
+            led["cutover_tick"] = self.tick
+            led["pause_ticks"] = self.tick - led["begin_tick"]
+            led["outcome"] = "cutover"
+            self.migrations.append(led)
+        self.trace.emit(self.tick, "migration_cutover", topic=m.topic,
+                        part=m.idx, src=src, dst=m.dst_group)
+
+    def _migration_abort(self, m, p) -> None:
+        """Commit-time hook (MigrationAbort applied): single owner again —
+        unfreeze the source, tear the adopted-or-claimed target back down
+        and drain it to the pool like a released row."""
+        eng = self.engine
+        src, dst = m.src_group, m.dst_group
+        drv = eng.drivers.get(src)
+        if drv is not None:
+            drv.fsm.on_fence = None
+        for e in self.engines:
+            e.unfreeze_group(src)
+        if 0 < dst < eng.P:
+            for e in self.engines:
+                e.unregister_fsm(dst)
+                e.set_group_members(dst, set())
+                e.recycle_group(dst)
+            self.kv.delete(b"pfsm:%d" % dst)
+            self.kv.delete(b"pfsm:r:%d" % dst)
+            self._pending_acks.append(
+                (dst, self.store.group_incarnation(dst)))
+        led = self._active_migs.pop((m.topic, m.idx), None)
+        if led is not None:
+            led["abort_tick"] = self.tick
+            led["outcome"] = "aborted"
+            self.migrations.append(led)
+        self.trace.emit(self.tick, "migration_abort", topic=m.topic,
+                        part=m.idx, src=src, dst=dst)
+
+    async def migrate_partition(self, topic: str, idx: int,
+                                max_ticks: int = 256) -> dict:
+        """Migrate one live partition to a spare row under traffic: run
+        the reassignment transition through the metadata FSM and tick the
+        handoff to cutover, the target row's election, and the source
+        drain. Returns the pause ledger (or outcome=rejected when the FSM
+        refused — no spare row / already migrating)."""
+        p0 = self.store.get_partition(topic, idx)
+        if p0 is None or p0.group < 1:
+            raise ValueError(f"{topic}/{idx} has no live group row")
+        src = p0.group
+        task = asyncio.ensure_future(self.broker.client.propose(
+            Transition.migrate_partition(topic, idx)))
+        for _ in range(max_ticks):
+            await self._tick_once()
+            if not task.done():
+                continue
+            if self.store.get_migration(topic, idx) is not None:
+                continue
+            p = self.store.get_partition(topic, idx)
+            if p is None or p.group == src:
+                task.result()
+                self.trace.emit(self.tick, "migration_rejected",
+                                topic=topic, part=idx, src=src)
+                return {"topic": topic, "idx": idx, "src": src,
+                        "outcome": "rejected"}
+            if (self.engine.is_leader(p.group) and not self._mig_tasks
+                    and not self._ack_tasks and not self._pending_acks):
+                break
+        else:
+            raise RuntimeError(
+                f"migration of {topic}/{idx} did not settle in "
+                f"{max_ticks} ticks")
+        task.result()
+        for led in reversed(self.migrations):
+            if (led["topic"], led["idx"]) == (topic, idx):
+                return led
+        raise RuntimeError(f"migration of {topic}/{idx} left no ledger")
+
+    async def migrate_hot_tenant(self, max_ticks: int = 256) -> dict:
+        """Hot-tenant trigger: migrate the partition behind the hottest
+        live row — ranked by commit heat, stamped with the engine's wake
+        gauge at trigger time (the active-set scheduler's view of who is
+        keeping the device busy)."""
+        if not self._group_heat:
+            raise RuntimeError("no commit heat yet — run traffic first")
+        g = max(sorted(self._group_heat),
+                key=lambda k: self._group_heat[k])
+        target = None
+        for name in self.model.topic_names:
+            for p in self.store.get_partitions(name):
+                if p.group == g:
+                    target = p
+                    break
+            if target is not None:
+                break
+        if target is None:
+            raise RuntimeError(f"hot row {g} has no live partition")
+        self.trace.emit(self.tick, "migrate_hot_trigger", topic=target.topic,
+                        part=target.idx, group=g,
+                        heat=self._group_heat[g],
+                        wake_rows=self.engine._last_wake_rows)
+        return await self.migrate_partition(target.topic, target.idx,
+                                            max_ticks=max_ticks)
+
     # ----------------------------------------------------------- summary
 
     def tenant_latency(self, tenant: int) -> dict:
@@ -920,6 +1194,10 @@ class TrafficEngine:
             "fetched_bytes": self.n_fetched_bytes,
             "offset_commits": self.n_offset_commits,
             "recycle_acks": self.n_recycle_acks,
+            # Live migrations resolved this run: pause (begin -> cutover,
+            # virtual ticks) and refused (dual-ownership NotLeader
+            # rejections rerouted by the retry ledger) per migration.
+            "migrations": self.migrations,
             "trace_events": len(self.trace.events),
             "trace_sha256": self.trace.sha256(),
             # Request-span epilogue (raft.request_spans): request counts,

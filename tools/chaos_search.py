@@ -84,6 +84,7 @@ def replay(path: str) -> int:
         workload=rep.get("workload"),
         commitless_limit=soak.get("commitless_limit"),
         flight_ring=soak.get("flight_ring"),
+        migration=soak.get("migration", False),
         artifact_path=os.devnull)
     print(json.dumps({
         "repro": path,
@@ -154,6 +155,15 @@ def main() -> int:
                          "starve every group's commit progress past this "
                          "many ticks VIOLATE (the searchable liveness "
                          "axis)")
+    ap.add_argument("--migration", action="store_true",
+                    help="migration mode: every candidate soak arms the "
+                         "live-migration plane, the migrate-* nemeses "
+                         "join the bootstrap catalog, and the mutator "
+                         "genome includes migrate/migrate_abort ops — "
+                         "the search hunts handoff-interruption corners "
+                         "(source/target crash, partition mid-handoff, "
+                         "election mid-cutover) against the "
+                         "migration-state invariant")
     ap.add_argument("--wire", action="store_true",
                     help="wire mode: candidates run through the wire "
                          "chaos soak (real Kafka connections, socket "
@@ -213,7 +223,7 @@ def main() -> int:
                             max_heal=args.max_heal),
         min_novel=args.min_novel, minimize=not args.no_minimize,
         repro_dir=repro_dir, log_path=args.log,
-        wire=args.wire,
+        wire=args.wire, migration=args.migration,
         wire_opts={"tenants": args.wire_tenants} if args.wire else None)
 
     if args.bootstrap:

@@ -123,6 +123,17 @@ def main() -> int:
                     help="offered workload batches per tick (open loop)")
     ap.add_argument("--workload-skew", type=float, default=1.1,
                     help="Zipf exponent over the workload's topics")
+    ap.add_argument("--migration", action="store_true",
+                    help="arm the live-migration plane: the cluster gets "
+                         "a spare consensus row plus a migration "
+                         "coordinator, the bundled migrate-* schedules "
+                         "resolve, and the nemesis ops migrate/"
+                         "migrate_abort drive group handoffs under the "
+                         "schedule's faults with the migration-state "
+                         "invariant (single owner after resolution, "
+                         "carried prefix intact, zero acked loss) "
+                         "enforced; the summary carries the coordinator's "
+                         "outcome counts and pause ticks")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -155,16 +166,19 @@ def main() -> int:
     jax.config.update("jax_platforms", args.platform)
 
     from josefine_tpu.chaos.faults import NetFaults
-    from josefine_tpu.chaos.nemesis import SCHEDULES, WIRE_SCHEDULES
+    from josefine_tpu.chaos.nemesis import (MIGRATION_SCHEDULES, SCHEDULES,
+                                            WIRE_SCHEDULES)
     from josefine_tpu.chaos.soak import run_soak
 
     if args.list:
         for name, builder in sorted(SCHEDULES.items()) \
+                + sorted(MIGRATION_SCHEDULES.items()) \
                 + sorted(WIRE_SCHEDULES.items()):
             sched = builder(args.nodes)
-            wire = " [--wire]" if name in WIRE_SCHEDULES else ""
+            flag = (" [--wire]" if name in WIRE_SCHEDULES else
+                    " [--migration]" if name in MIGRATION_SCHEDULES else "")
             print(f"{name:22s} horizon={sched.horizon:4d} "
-                  f"steps={len(sched.steps):2d}{wire}  "
+                  f"steps={len(sched.steps):2d}{flag}  "
                   f"{(builder.__doc__ or '').strip().splitlines()[0]}")
         return 0
 
@@ -183,7 +197,8 @@ def main() -> int:
     elif schedule.startswith("@"):
         with open(schedule[1:]) as fh:
             schedule = fh.read()
-    elif schedule not in (WIRE_SCHEDULES if args.wire else SCHEDULES):
+    elif schedule not in (WIRE_SCHEDULES if args.wire
+                          else {**SCHEDULES, **MIGRATION_SCHEDULES}):
         print(f"unknown schedule {schedule!r}; use --list, "
               f"--schedule-file PATH, or @file.json", file=sys.stderr)
         return 2
@@ -245,7 +260,7 @@ def main() -> int:
             flight_wire=args.flight_wire, workload=workload,
             artifact_path=args.artifact, flight_ring=args.flight_ring,
             commitless_limit=args.commitless_limit,
-            request_spans=args.request_spans)
+            request_spans=args.request_spans, migration=args.migration)
     except ValueError as e:
         # The DSL boundary rejected the schedule (unknown op, negative at,
         # malformed args — it names the step). Usage error, not a crash.
@@ -294,6 +309,9 @@ def main() -> int:
         summary["span_summary"] = result["span_summary"]
     if result.get("device_route_stats"):
         summary["device_route_stats"] = result["device_route_stats"]
+    summary["dup_check"] = result["dup_check"]
+    if result.get("migration") is not None:
+        summary["migration"] = result["migration"]
     # Observability epilogue: the full registry dump (counters, gauges,
     # histograms — includes the commit-latency axis) and the tail of each
     # node's flight journal, so a soak's summary line says what the

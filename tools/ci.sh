@@ -135,6 +135,40 @@ print("wire chaos ok:", d["produced"], "produced/", s["consumed"],
 PYEOF
 }
 
+migration_chaos_smoke() {
+    # Live migration under chaos (PR 16): the migrate-leader-partition
+    # nemesis at 3 nodes — a group handoff begun, then the source row's
+    # leader isolated mid-window, then a second migration after heal —
+    # must resolve to a single owner with every invariant green
+    # (migration-state machine, carried prefix, zero acked-write loss,
+    # idempotent-produce dup scan clean), and two same-seed runs must
+    # produce cmp-byte-identical fault-event logs: the migration plane
+    # joins the chaos-determinism contract, it does not get an exemption
+    # from it.
+    echo "== migration chaos smoke =="
+    rm -f /tmp/ci_mig_a.jsonl /tmp/ci_mig_b.jsonl
+    python tools/chaos_soak.py --seed 7 --schedule migrate-leader-partition \
+        --nodes 3 --migration --events /tmp/ci_mig_a.jsonl \
+        > /tmp/ci_mig_a.json
+    python tools/chaos_soak.py --seed 7 --schedule migrate-leader-partition \
+        --nodes 3 --migration --events /tmp/ci_mig_b.jsonl \
+        > /tmp/ci_mig_b.json
+    cmp /tmp/ci_mig_a.jsonl /tmp/ci_mig_b.jsonl
+    python - <<'PYEOF'
+import json
+s = json.loads(open("/tmp/ci_mig_a.json").read().strip().splitlines()[-1])
+assert s["invariants"] == "ok", s.get("violation")
+mig = s["migration"]
+assert mig["outcomes"].get("cutover", 0) >= 1, mig
+assert mig["outcomes"].get("skipped", 0) == 0, mig
+assert s["dup_check"]["verdict"] == "clean", s["dup_check"]
+assert s["acked"] > 0, s
+print("migration chaos ok:", mig["migrations"], "migrations,",
+      mig["outcomes"], "pause", mig["pause_ticks"], "ticks,",
+      s["acked"], "acked")
+PYEOF
+}
+
 chaos_search_smoke() {
     # Coverage-guided chaos search (chaos/search.py): a few seeded
     # iterations from the COMMITTED corpus (tests/fixtures/chaos_corpus)
@@ -317,6 +351,7 @@ if [[ "${1:-}" == "quick" ]]; then
         tests/test_integration.py tests/test_kafka_codec.py -q -x
     chaos_smoke
     chaos_smoke_device_route
+    migration_chaos_smoke
     chaos_search_smoke
     wire_chaos_smoke
     traffic_smoke
@@ -364,9 +399,14 @@ else
         tests/test_coverage.py tests/test_chaos_search.py \
         tests/test_wire_chaos.py \
         tests/test_reset_safety.py tests/test_graftlint.py -q
+    # Live-migration suite (PR 16) unfiltered: engine handoff primitives,
+    # the metadata reassignment FSM, the mid-pipelined-dispatch twin
+    # matrix, the bundled migrate nemeses, and the product/workload e2e.
+    python -m pytest tests/test_migration.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route
+    migration_chaos_smoke
     chaos_search_smoke
     chaos_search_repros
     wire_chaos_smoke

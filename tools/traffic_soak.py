@@ -30,9 +30,14 @@ input for tools/request_report.py), --spans-overhead measures the on
 cost against an adjacent spans-off baseline, and a p99 outlier
 (p99 > --outlier-mult * p50) auto-dumps the span trees even unasked.
 
+--migrate-hot N performs N live hot-tenant migrations mid-soak (the PR 16
+tentpole's workload leg): the Zipf head tenant's consensus row hands off
+to a spare row under open-loop traffic and the row records the migration
+pause and the refused-then-rerouted produce count.
+
 Rows merge into BENCH_traffic.json keyed on the workload axes
 (tenants, partitions, skew, offered load, active_set, replication,
-device_route, payload_ring, request_spans); per-tenant
+device_route, payload_ring, request_spans, migrate_hot); per-tenant
 p50/p99 commit-latency quantiles, throughput split by path
 (replicated vs legacy-direct), and backpressure/retry counters land in
 every row.
@@ -70,7 +75,8 @@ def _row_key(r: dict) -> tuple:
     return (r["tenants"], r["partitions"], float(r["skew"]),
             float(r["offered_per_tick"]), bool(r.get("active_set")),
             int(r.get("replication", 1)), bool(r.get("device_route")),
-            bool(r.get("payload_ring")), bool(r.get("request_spans")))
+            bool(r.get("payload_ring")), bool(r.get("request_spans")),
+            int(r.get("migrate_hot", 0)))
 
 
 def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
@@ -102,17 +108,36 @@ async def _run_driver(args, request_spans: bool):
         churn_every_ticks=args.churn,
         max_inflight_per_tenant=args.inflight,
     )
+    # Migrating soaks need spare consensus rows to hand groups into: one
+    # is enough even for repeated migrations (each cutover recycles its
+    # source row back into the pool), plus one headroom.
+    groups = None
+    if args.migrate_hot:
+        groups = spec.total_partitions + 1 + 2
     drv = TrafficEngine(spec, seed=args.seed, active_set=args.active_set,
                         window=args.window, hb_ticks=args.hb_ticks,
                         replication=args.replication,
                         device_route=args.device_route,
                         payload_ring=args.payload_ring,
+                        engine_groups=groups,
                         request_spans=request_spans)
     t0 = time.perf_counter()
     await drv.start()
     t_boot = time.perf_counter() - t0
     t1 = time.perf_counter()
-    await drv.run_ticks(args.ticks)
+    if args.migrate_hot:
+        # Split the soak around the migrations: the Zipf head tenant's
+        # hottest row moves between engine rows while its traffic keeps
+        # arriving — pause_ticks/refused in the row quote the cost.
+        legs = args.migrate_hot + 1
+        per = max(1, args.ticks // legs)
+        await drv.run_ticks(per)
+        for i in range(args.migrate_hot):
+            await drv.migrate_hot_tenant()
+            await drv.run_ticks(per if i < args.migrate_hot - 1
+                                else max(1, args.ticks - per * legs + per))
+    else:
+        await drv.run_ticks(args.ticks)
     wall = time.perf_counter() - t1
     return drv, spec, t_boot, wall
 
@@ -142,6 +167,7 @@ async def run_soak(args) -> dict:
         "device_route": bool(args.device_route),
         "payload_ring": bool(args.payload_ring),
         "request_spans": bool(args.request_spans),
+        "migrate_hot": int(args.migrate_hot),
         "route_stats": s["route_stats"],
         "window": args.window,
         "bootstrap_s": round(t_boot, 3),
@@ -166,6 +192,19 @@ async def run_soak(args) -> dict:
             "spec": s["spec"],
         },
     }
+    if args.migrate_hot:
+        migs = s["migrations"]
+        pauses = [m["pause_ticks"] for m in migs if "pause_ticks" in m]
+        row["migration"] = {
+            "count": len(migs),
+            "outcomes": {o: sum(1 for m in migs if m.get("outcome") == o)
+                         for o in {m.get("outcome") for m in migs}},
+            "pause_ticks_max": max(pauses) if pauses else None,
+            "pause_ticks_mean": (round(sum(pauses) / len(pauses), 2)
+                                 if pauses else None),
+            "refused_total": sum(m.get("refused", 0) for m in migs),
+            "ledger": migs,
+        }
     if args.request_spans:
         # Span epilogue: compact summary in the row; the full per-tenant
         # phase table + retained trees ride the --spans-out artifact
@@ -244,6 +283,13 @@ def main() -> int:
                     help="with --device-route: AppendEntries payloads "
                          "serve from the device payload ring, so the "
                          "produce path's replication leg routes on-chip")
+    ap.add_argument("--migrate-hot", type=int, default=0,
+                    help="perform this many live hot-tenant migrations "
+                         "spread through the soak: the wake-gauge-hottest "
+                         "consensus row (the Zipf head tenant) hands off "
+                         "to a spare row under traffic, and the row "
+                         "records the migration pause (dual-ownership "
+                         "ticks) plus refused-and-rerouted produce counts")
     ap.add_argument("--request-spans", action="store_true",
                     help="record request-scoped phase spans (admission/"
                          "queue/consensus/apply/serve on the engine tick "
