@@ -114,6 +114,14 @@ class Broker:
         # current event; signal_append() replaces it and sets the old one,
         # waking every current waiter with no clear() race.
         self._append_event = asyncio.Event()
+        # Read-path consistency mode (ARCHITECTURE.md "Leader leases"):
+        # "local" serves reads from local state unchecked (seed behavior);
+        # "lease" serves leader-local iff the partition's group lease is
+        # unexpired, else pays a quorum read barrier; "consensus" always
+        # barriers. Only meaningful when the raft client exposes the lease
+        # surface (RaftClient / the workload driver's in-proc client — the
+        # test shims don't, and degrade to "local").
+        self._read_mode = getattr(config, "read_mode", "local")
 
     def signal_append(self) -> None:
         """Called by the data-plane PartitionFsm after each applied batch."""
@@ -148,7 +156,7 @@ class Broker:
             if api_key == ApiKey.API_VERSIONS:
                 return self.api_versions(api_version, body)
             if api_key == ApiKey.METADATA:
-                return self.metadata(api_version, body)
+                return await self.metadata(api_version, body)
             if api_key == ApiKey.CREATE_TOPICS:
                 return await self.create_topics(api_version, body)
             if api_key == ApiKey.DELETE_TOPICS:
@@ -209,10 +217,14 @@ class Broker:
 
     # ------------------------------------------------------------- Metadata
 
-    def metadata(self, version: int, body: dict) -> dict:
+    async def metadata(self, version: int, body: dict) -> dict:
         """Reference ``handler/metadata.rs:12-110``: brokers from the store,
         per-topic partition/leader/ISR metadata, UnknownTopicOrPartition for
-        misses (:57-61)."""
+        misses (:57-61). Under broker.read_mode "lease"/"consensus" the
+        response is gated on the metadata group's lease (:meth:`_metadata_gate`)
+        so a partitioned ex-controller cannot keep advertising a stale
+        cluster view past its lease expiry."""
+        await self._metadata_gate()
         brokers = [
             {"node_id": b.id, "host": b.ip, "port": b.port, "rack": None}
             for b in self.store.get_brokers()
@@ -714,6 +726,68 @@ class Broker:
             return int(ErrorCode.NOT_LEADER_OR_FOLLOWER)
         return rep, part
 
+    # ------------------------------------------------------ read-path gate
+
+    async def _read_gate(self, group: int) -> int | None:
+        """Per-group read-consistency gate (ARCHITECTURE.md "Leader
+        leases"). Returns None when local state may be served now, else a
+        retryable error code. Mode "lease": an unexpired tick-denominated
+        lease serves immediately (raft_reads_leased_total counts it) and
+        an invalid one falls back to the quorum read barrier
+        (raft_reads_fallback_total says why); mode "consensus" always pays
+        the barrier — the measured baseline, so it deliberately skips the
+        lease counters. A False barrier means this node does not lead the
+        group: answer NotLeader and let the client re-route."""
+        if self._read_mode == "lease":
+            ok, _reason = self.client.lease_serve(group)
+            if ok:
+                return None
+        if await self.client.read_barrier(group):
+            return None
+        return int(ErrorCode.NOT_LEADER_OR_FOLLOWER)
+
+    async def _metadata_gate(self) -> None:
+        """Read gate for Metadata: group 0 — the replicated store IS the
+        metadata FSM's applied state. Leased: serve immediately. Metadata
+        leader without a valid lease: pay the quorum barrier. NOT the
+        metadata leader: serve the local mirror as ever — Kafka metadata
+        is advisory from any broker (clients bootstrap through followers),
+        so refusing would break discovery; lease_serve still counts the
+        fallback."""
+        if self._read_mode == "local":
+            return
+        serve = getattr(self.client, "lease_serve", None)
+        if serve is None:
+            return
+        if self._read_mode == "lease" and serve(0)[0]:
+            return
+        if self.client.is_leader(0):
+            await self.client.read_barrier(0)
+
+    async def _refused_reads(self, body: dict) -> dict | None:
+        """(topic, partition) -> retryable error code for every group-backed
+        partition in a Fetch body whose read gate refused, one gate per
+        DISTINCT group (a request fanning over 100 partitions of one topic
+        pays one lease check / barrier, not 100). None when the mode or the
+        client cannot gate — the seed's ungated local serve."""
+        if self._read_mode == "local" \
+                or getattr(self.client, "lease_serve", None) is None:
+            return None
+        gate: dict[int, int | None] = {}
+        refused: dict[tuple[str, int], int] = {}
+        for t in body.get("topics") or []:
+            for p in t.get("partitions") or []:
+                key = (t["topic"], p["partition"])
+                part = self.store.get_partition(*key)
+                g = self._live_group(part) if part is not None else None
+                if g is None:
+                    continue  # group-less/unknown: legacy local serve
+                if g not in gate:
+                    gate[g] = await self._read_gate(g)
+                if gate[g] is not None:
+                    refused[key] = gate[g]
+        return refused or None
+
     # ---------------------------------------------------------------- Fetch
 
     async def fetch(self, version: int, body: dict) -> dict:
@@ -721,8 +795,13 @@ class Broker:
         its reader is a stub, ``src/broker/log/reader.rs:3-8``). An empty
         fetch long-polls the FULL max_wait_ms on an append-signaled event —
         consumers wake within a tick of data landing instead of sleeping a
-        fixed interval (VERDICT r1 weak 3)."""
-        responses = self._fetch_once(body)
+        fixed interval (VERDICT r1 weak 3). Under broker.read_mode
+        "lease"/"consensus" every serve — including each long-poll
+        re-check — first passes the per-group read gate, so a lease that
+        expires mid-poll stops being served the moment it lapses (the
+        bounded-staleness contract; tests/test_lease_safety.py)."""
+        refused = await self._refused_reads(body)
+        responses = self._fetch_once(body, refused)
         max_wait_ms = body.get("max_wait_ms") or 0
         if max_wait_ms > 0 and _fetch_should_wait(responses):
             loop = asyncio.get_running_loop()
@@ -732,22 +811,29 @@ class Broker:
                 if remaining <= 0:
                     break
                 ev = self._append_event  # grab BEFORE re-checking the log
-                responses = self._fetch_once(body)
+                refused = await self._refused_reads(body)
+                responses = self._fetch_once(body, refused)
                 if not _fetch_should_wait(responses):
                     break
                 try:
                     await asyncio.wait_for(ev.wait(), remaining)
                 except asyncio.TimeoutError:
-                    responses = self._fetch_once(body)  # final re-check
+                    refused = await self._refused_reads(body)
+                    responses = self._fetch_once(body, refused)  # final re-check
                     break
         return {"throttle_time_ms": 0, "responses": responses}
 
-    def _fetch_once(self, body: dict) -> list[dict]:
+    def _fetch_once(self, body: dict,
+                    refused: dict | None = None) -> list[dict]:
         out = []
         for t in body.get("topics") or []:
             parts_out = []
             for p in t.get("partitions") or []:
                 idx = p["partition"]
+                if refused is not None and (t["topic"], idx) in refused:
+                    parts_out.append(
+                        _fetch_err(idx, refused[(t["topic"], idx)]))
+                    continue
                 rep = self._local_replica(t["topic"], idx)
                 if isinstance(rep, int):
                     parts_out.append(_fetch_err(idx, rep))

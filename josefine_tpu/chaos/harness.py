@@ -32,6 +32,12 @@ from josefine_tpu.utils.kv import MemKV
 
 DEFAULT_PARAMS = step_params(timeout_min=3, timeout_max=8, hb_ticks=1)
 
+# Lease soaks need timeout_min > hb_ticks + 2 (raft.lease.check_lease_params:
+# the non-overlap margin) — DEFAULT_PARAMS sits exactly on the boundary, so
+# lease runs bump timeout_min by one. Everything else matches DEFAULT_PARAMS;
+# a leases-off control run at these params is the digest-identity twin.
+LEASE_PARAMS = step_params(timeout_min=4, timeout_max=8, hb_ticks=1)
+
 # Per-node flight-journal archive cap (events): a few engine rings deep —
 # restart churn keeps the newest history instead of growing without bound.
 _ARCHIVE_CAP = 16384
@@ -206,7 +212,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                  payload_ring: bool = False,
                  flight_wire: bool = False, workload=None,
                  flight_ring: int = 4096, request_spans: bool = False,
-                 migration: bool = False):
+                 migration: bool = False, leases: bool = False):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -218,6 +224,20 @@ class ChaosCluster(_PlaneDrivenCluster):
         # identity forever, and every artifact is byte-identical to the
         # pre-migration harness.
         self.migration = bool(migration)
+        # Tick-denominated leader leases (raft.leases): engines derive the
+        # host-side lease plane and the harness checks lease non-overlap +
+        # leader exclusion every tick, plus the stale-read probe (a node
+        # that believes it leads must REFUSE leased serves once its lease
+        # expires). Leases demand timeout_min > hb_ticks + 2, so the
+        # default params are silently upgraded to LEASE_PARAMS; explicit
+        # params must satisfy the constraint themselves (the engine
+        # raises). NOTE: lease soundness is scoped to a non-duplicating
+        # transport — run with dup_p=0 (soak.py enforces this); dup faults
+        # can replay an APPEND_RESP that is byte-identical to the next
+        # idle-heartbeat ack and over-credit the evidence window.
+        self.leases = bool(leases)
+        if leases and params is DEFAULT_PARAMS:
+            params = LEASE_PARAMS
         self.R = groups + (1 if migration else 0)  # engine rows
         self.stream_row = list(range(groups))
         self.spare_row = groups if migration else -1
@@ -296,6 +316,11 @@ class ChaosCluster(_PlaneDrivenCluster):
             self.migrator = MigrationCoordinator(self)
         self.delayed: list[tuple[int, int, object]] = []  # (deliver_tick, dst, msg)
         self.ledger = invariants.ElectionSafetyLedger()
+        self.lease_ledger = (invariants.LeaseSafetyLedger()
+                             if self.leases else None)
+        # Stale-read probe tallies (see _check_leases).
+        self.leased_reads = 0
+        self.lease_refusals = 0
         self.acked: dict[int, list[bytes]] = {g: [] for g in range(groups)}
         self.pending: list[tuple[int, bytes, object]] = []
         self.proposed = 0
@@ -315,6 +340,8 @@ class ChaosCluster(_PlaneDrivenCluster):
             flight_wire=self.flight_wire,
             flight_ring=self.flight_ring,
             request_spans=self.request_spans,
+            leases=self.leases,
+            flight_lease=self.leases,
         )
         if self.k_out is not None:
             e._k_out = self.k_out
@@ -362,6 +389,34 @@ class ChaosCluster(_PlaneDrivenCluster):
         # All R rows, not just stream-owned ones: a spare row's elections
         # still must never produce two leaders in one term.
         self.ledger.check(self._live_engines(), self.R)
+
+    def _check_leases(self):
+        """Per-tick lease safety + the stale-read probe. The ledger pins
+        non-overlap and term-qualified leader exclusion; the probe then
+        attempts one leased serve per (group, self-believed leader) — a
+        holder serves (counted, and must still be lease-valid), while a
+        partitioned ex-leader whose lease expired must REFUSE: an ok there
+        would be exactly the stale read leases exist to prevent."""
+        if self.lease_ledger is None:
+            return
+        live = self._live_engines()
+        self.lease_ledger.check(live, self.G, self.tick_no,
+                                row_of=self.row_of)
+        for g in range(self.G):
+            row = self.row_of(g)
+            for i, e in live:
+                if not e.is_leader(row):
+                    continue
+                ok, reason = e.lease_serve(row)
+                if ok:
+                    invariants._require(
+                        e.lease_valid(row),
+                        f"node {i} served a leased read on group {g} "
+                        f"(row {row}) at tick {self.tick_no} without a "
+                        f"valid lease")
+                    self.leased_reads += 1
+                else:
+                    self.lease_refusals += 1
 
     def check_log_matching(self):
         # Keyed by STREAM through the row mapping: during a handoff the
@@ -422,6 +477,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                 self.fabric.flush()
 
         self.check_election_safety()
+        self._check_leases()
         if self.migrator is not None:
             invariants.check_migration_state(self)
         if self.tick_no % 10 == 0:
@@ -493,6 +549,7 @@ class ChaosCluster(_PlaneDrivenCluster):
                 if self.fabric is not None:
                     self.fabric.flush()
             self.check_election_safety()
+            self._check_leases()
             if self.migrator is not None:
                 invariants.check_migration_state(self)
 
@@ -553,6 +610,23 @@ class ChaosCluster(_PlaneDrivenCluster):
         return {**self.migrator.summary(),
                 "stream_row": list(self.stream_row),
                 "spare_row": self.spare_row}
+
+    def lease_summary(self) -> dict | None:
+        """Lease-lane outcome telemetry for the soak result (None when the
+        lease plane is off, keeping legacy artifacts unchanged). The held/
+        handover counts come from the safety ledger, the read tallies from
+        the stale-read probe, and the per-node blocks from each engine's
+        own lane (credits, refused queue pushes, armed group count)."""
+        if self.lease_ledger is None:
+            return None
+        return {
+            "held_ticks": self.lease_ledger.held_ticks,
+            "handovers": self.lease_ledger.handovers,
+            "leased_reads": self.leased_reads,
+            "refusals": self.lease_refusals,
+            "nodes": {str(i): e.lease_summary()
+                      for i, e in enumerate(self.engines) if e is not None},
+        }
 
 
 class MembershipChaosCluster(_PlaneDrivenCluster):

@@ -57,6 +57,68 @@ class ElectionSafetyLedger:
                              f"{prev} and {i}")
 
 
+class LeaseSafetyLedger:
+    """Tick-denominated leader-lease safety (raft/lease.py), checked every
+    tick while the lease lane is armed:
+
+    * **non-overlap** — at most one live engine may hold a valid lease per
+      group at any tick, across partitions, elections, recycles and
+      migration freezes (two simultaneous holders would both serve
+      leader-local reads — split-brain on the read path);
+    * **leader exclusion** — while an engine's lease on a group is valid,
+      no OTHER live engine may lead the group at a term >= the holder's.
+      This is the statement the serve path actually relies on: a valid
+      lease means no newer-or-equal term can have committed anywhere, so
+      the holder's local committed state is the freshest and a leased read
+      is linearizable within the lease window. A *lower*-term leader
+      belief is explicitly allowed — a partitioned ex-leader keeps its
+      stale ``is_leader`` view until heal (prevote means nothing deposes
+      it in isolation), which is harmless: its lease has expired by the
+      time the majority elects, so it cannot SERVE (the stale-read probe
+      in the harness asserts exactly that refusal every tick).
+
+    The ledger also accumulates coverage telemetry (``held_ticks``,
+    ``handovers``) so a soak summary can show the lease lane actually
+    exercised grants and expiries, not just vacuous emptiness."""
+
+    def __init__(self):
+        self.held_ticks = 0     # (tick, group) pairs with a valid holder
+        self.handovers = 0      # holder changed group-to-group across ticks
+        self._last_holder: dict[int, int] = {}
+
+    def check(self, live_engines, groups: int, tick: int,
+              row_of=None) -> None:
+        """``live_engines``: iterable of (node_index, engine) for nodes
+        currently up; ``row_of`` maps a logical group to its owning engine
+        row (identity when the migration plane is off)."""
+        engines = dict(live_engines)
+        for g in range(groups):
+            row = row_of(g) if row_of is not None else g
+            holders = [i for i, e in live_engines if e.lease_valid(row)]
+            _require(len(holders) <= 1,
+                     f"lease overlap on group {g} (row {row}) at tick "
+                     f"{tick}: holders {holders}")
+            if not holders:
+                # Keep the last holder across the gap: every safe handover
+                # goes through a no-holder window (leases never overlap),
+                # and the handover count is about holder IDENTITY changing,
+                # not tick adjacency.
+                continue
+            h = holders[0]
+            ht = engines[h].term(row)
+            usurpers = [i for i, e in live_engines
+                        if i != h and e.is_leader(row) and e.term(row) >= ht]
+            _require(not usurpers,
+                     f"leader exclusion violated on group {g} (row {row}) "
+                     f"at tick {tick}: node {h} holds a valid lease at term "
+                     f"{ht} while {usurpers} lead at >= that term")
+            self.held_ticks += 1
+            prev = self._last_holder.get(g)
+            if prev is not None and prev != h:
+                self.handovers += 1
+            self._last_holder[g] = h
+
+
 def check_log_matching(logs_per_group: dict[int, list[list[bytes]]]) -> None:
     """``logs_per_group[g]`` = each live node's applied-FSM sequence for
     group g. All pairs must be prefix-compatible (divergence at any index

@@ -605,3 +605,33 @@ MIGRATION_SCHEDULES = {
     "migrate-under-election": migrate_under_election,
     "migrate-abort": migrate_abort,
 }
+
+
+# --------------------------------------------------- bundled lease schedules
+#
+# Kept OUT of SCHEDULES for the same determinism reason as the wire and
+# migration catalogs (the search bootstraps from sorted(SCHEDULES)). Lease
+# search mode merges this catalog in explicitly, and the soak CLIs resolve
+# these names only alongside --leases. Lease soundness is scoped to the
+# lockstep pacer and a non-duplicating transport (see raft/lease.py), so
+# these builders never emit "skew" ops and lease soaks run with dup_p=0 —
+# a duplicated APPEND_RESP is byte-identical to the next idle-heartbeat
+# ack and would over-credit the evidence window.
+
+def lease_expiry_under_partition(n_nodes: int = 3) -> Schedule:
+    """The stale-read nemesis: the lease-holding leader is cut off
+    (symmetric) for LONGER than the lease window — its lease must expire
+    in place and leased reads flip to refusals BEFORE the majority side
+    can elect (the non-overlap margin); after heal the deposed node
+    rejoins, a fresh lease is granted, and a second round repeats the
+    hand-off to prove re-grant after expiry. The 50-tick cuts dwarf
+    timeout_min=4, so both rounds force a genuine expiry + re-election
+    rather than a renewal blip."""
+    steps = [Step(at=t, op="isolate", args={"target": "leader", "for": 50})
+             for t in (60, 180)]
+    return Schedule("lease-expiry-under-partition", steps, horizon=320)
+
+
+LEASE_SCHEDULES = {
+    "lease-expiry-under-partition": lease_expiry_under_partition,
+}

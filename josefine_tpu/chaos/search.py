@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from josefine_tpu.chaos.faults import NetFaults
 from josefine_tpu.chaos.nemesis import (
     DISK_FAULTS,
+    LEASE_SCHEDULES,
     MIGRATION_SCHEDULES,
     ROLES,
     SCHEDULES,
@@ -155,6 +156,11 @@ _WIRE_INSERT_OPS = (
     "partition", "isolate", "block_link", "heal_all",
 )
 
+#: Bundled schedules that carry pacer-skew steps: excluded from the lease
+#: search catalog (lease soundness is stated for the lockstep pacer, and
+#: run_soak with leases REFUSES skew-bearing schedules outright).
+_SKEW_SCHEDULES = ("slow-disk", "skewed-pacer")
+
 #: Mutation-kind draw weights.
 _MUTATIONS = (
     "insert", "insert", "insert", "delete", "delete", "retime", "retime",
@@ -170,7 +176,7 @@ class Mutator:
     def __init__(self, rng: random.Random, n_nodes: int,
                  limits: SearchLimits, workload_genome: bool = False,
                  wire: bool = False, migration: bool = False,
-                 n_streams: int = 0):
+                 n_streams: int = 0, leases: bool = False):
         self.rng = rng
         self.n_nodes = n_nodes
         self.n_streams = n_streams
@@ -178,6 +184,13 @@ class Mutator:
         # Wire mode mutates over the socket-fate op catalog (plus the
         # raft-plane partitions the wire soak's interceptors honor).
         self.insert_ops = _WIRE_INSERT_OPS if wire else _INSERT_OPS
+        if leases:
+            # Lease soaks refuse skew schedules (lockstep scoping), so the
+            # op must not enter the draw — a single inserted skew step
+            # would turn the candidate into a hard soak error, not just a
+            # wasted genome.
+            self.insert_ops = tuple(
+                op for op in self.insert_ops if op != "skew")
         if migration:
             # Migration ops join the draw ONLY when the soak arms the
             # migration plane (on a plain cluster they are skipped, i.e.
@@ -547,7 +560,7 @@ class ChaosSearch:
                  log_path: str | None = None,
                  start_iteration: int | None = None,
                  wire: bool = False, wire_opts: dict | None = None,
-                 migration: bool = False):
+                 migration: bool = False, leases: bool = False):
         self.seed = seed
         self.corpus = corpus
         self.n_nodes = n_nodes
@@ -567,10 +580,24 @@ class ChaosSearch:
         # byte-identical — the base SCHEDULES dict must never grow (its
         # sorted order seeds every committed corpus's parent draws).
         self.migration = migration and not wire
-        self.schedules = (
-            WIRE_SCHEDULES if wire
-            else {**SCHEDULES, **MIGRATION_SCHEDULES} if self.migration
-            else SCHEDULES)
+        # Lease mode: every candidate soak arms the lease plane (and its
+        # per-tick ledger + stale-read probe), the lease nemeses join the
+        # bootstrap/parent catalog, and the skew-bearing classics drop out
+        # of it — run_soak with leases refuses skew schedules (lockstep
+        # scoping), and the mutator stops drawing the op. Off by default
+        # for the same SCHEDULES byte-stability reason as migration.
+        self.leases = leases and not wire
+        if wire:
+            self.schedules = WIRE_SCHEDULES
+        else:
+            base = dict(SCHEDULES)
+            if self.leases:
+                base = {k: v for k, v in base.items()
+                        if k not in _SKEW_SCHEDULES}
+                base.update(LEASE_SCHEDULES)
+            if self.migration:
+                base.update(MIGRATION_SCHEDULES)
+            self.schedules = base
         if wire:
             workload = None  # the wire driver owns its own tenant spec
         self.active_set = active_set
@@ -598,7 +625,7 @@ class ChaosSearch:
         self.mutator = Mutator(self.rng, n_nodes, self.limits,
                                workload_genome=self.workload is not None,
                                wire=wire, migration=self.migration,
-                               n_streams=groups)
+                               n_streams=groups, leases=self.leases)
         self.log_lines: list[dict] = []
         self.admitted = 0
         self.violations = 0
@@ -621,6 +648,7 @@ class ChaosSearch:
             "commitless_limit": self.commitless_limit,
             "flight_ring": self.flight_ring,
             "migration": self.migration,
+            "leases": self.leases,
         }
         if self.wire:
             cfg["wire"] = True
@@ -644,6 +672,7 @@ class ChaosSearch:
             device_route=self.device_route, flight_wire=self.flight_wire,
             workload=workload, commitless_limit=self.commitless_limit,
             flight_ring=self.flight_ring, migration=self.migration,
+            leases=self.leases,
             # Search runs keep their own repro records; the per-violation
             # auto-artifact (journals+registry) would litter the cwd once
             # per probe during minimization.

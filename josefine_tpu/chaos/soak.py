@@ -19,8 +19,8 @@ from josefine_tpu.chaos.faults import FaultPlane, NetFaults
 from josefine_tpu.chaos.harness import DEFAULT_PARAMS, ChaosCluster
 from josefine_tpu.chaos.invariants import (InvariantViolation,
                                            duplicate_acked_count)
-from josefine_tpu.chaos.nemesis import (MIGRATION_SCHEDULES, SCHEDULES,
-                                        Nemesis, Schedule)
+from josefine_tpu.chaos.nemesis import (LEASE_SCHEDULES, MIGRATION_SCHEDULES,
+                                        SCHEDULES, Nemesis, Schedule)
 from josefine_tpu.models.types import step_params
 from josefine_tpu.utils.coverage import CoverageMap
 from josefine_tpu.utils.flight import merge_journals, timeline_jsonl
@@ -45,6 +45,11 @@ def resolve_schedule(name_or_schedule, n_nodes: int = 3) -> Schedule:
         # anything on a soak with the migration plane armed (elsewhere
         # their migrate steps skip-and-record, by the nemesis contract).
         return MIGRATION_SCHEDULES[name_or_schedule](n_nodes)
+    if name_or_schedule in LEASE_SCHEDULES:
+        # Lease nemeses are ordinary partition schedules — they resolve
+        # anywhere, but only a soak with leases armed checks the lease
+        # ledger and probe against them.
+        return LEASE_SCHEDULES[name_or_schedule](n_nodes)
     return Schedule.from_json(name_or_schedule).validate(n_nodes)
 
 
@@ -63,7 +68,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          flight_ring: int | None = None,
                          commitless_limit: int | None = None,
                          request_spans: bool = False,
-                         migration: bool = False) -> dict:
+                         migration: bool = False,
+                         leases: bool = False) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -113,15 +119,45 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     and the result's ``max_commitless_window`` lets a scorer see
     near-misses either way.
 
+    ``leases`` arms tick-denominated leader leases on every engine and
+    turns on the per-tick lease-safety checks (non-overlap, term-qualified
+    leader exclusion) plus the stale-read probe — a partitioned ex-leader
+    must refuse leased serves once its lease expires. Lease soundness is
+    stated for the lockstep pacer on a non-duplicating transport, so a
+    lease soak REFUSES schedules with skew ops and net profiles with
+    ``dup_p > 0`` (a duplicated APPEND_RESP is byte-identical to the next
+    idle-heartbeat ack and would over-credit the evidence window); with
+    ``net=None`` it defaults to the standard noise profile minus dup.
+    Election params get timeout_min = hb_ticks + 3 (the lease margin
+    constraint); the result gains a ``lease`` block.
+
     On an invariant violation the run auto-dumps a JSON repro artifact —
     the per-node flight-recorder journals, the metrics-registry dump, the
     fault-event log, and the violation — to ``artifact_path`` (default
     ``chaos_artifact_<schedule>_<seed>.json`` in the working directory);
     the result carries the path as ``artifact``."""
     sched = resolve_schedule(schedule, n_nodes)
+    if leases:
+        if any(s.op == "skew" for s in sched.steps):
+            raise ValueError(
+                f"schedule {sched.name!r} has pacer-skew steps: lease "
+                "soundness is stated for the lockstep pacer (raft/lease.py)"
+                " — run it without --leases")
+        if net is not None and net.dup_p > 0:
+            raise ValueError(
+                f"lease soak needs a dup-free net profile (dup_p="
+                f"{net.dup_p}): duplicated APPEND_RESPs over-credit the "
+                "lease evidence window")
+        if net is None:
+            net = NetFaults(dup_p=0.0)
     plane = FaultPlane(seed, n_nodes, net=net)
-    params = DEFAULT_PARAMS if hb_ticks is None else step_params(
-        timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
+    if leases:
+        hb = 1 if hb_ticks is None else hb_ticks
+        params = step_params(timeout_min=hb + 3, timeout_max=hb + 7,
+                             hb_ticks=hb)
+    else:
+        params = DEFAULT_PARAMS if hb_ticks is None else step_params(
+            timeout_min=3, timeout_max=8, hb_ticks=hb_ticks)
     spans_rec = None
     if request_spans and workload:
         # Request spans under chaos (utils/spans.py): one recorder on the
@@ -151,7 +187,7 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                            flight_wire=flight_wire, workload=traffic,
                            flight_ring=flight_ring or 4096,
                            request_spans=request_spans,
-                           migration=migration)
+                           migration=migration, leases=leases)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -345,6 +381,11 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         # outcomes, pause ticks (the refused-traffic window), final
         # stream->row placement, and per-row incarnations.
         "migration": cluster.migration_summary(),
+        # Leader-lease epilogue (None with the plane off): ledger coverage
+        # (held ticks, holder handovers), stale-read probe tallies, and
+        # per-node lane state — nonzero leased_reads is the CI smoke's
+        # proof the lane actually served, not just stayed silent.
+        "lease": cluster.lease_summary(),
         # Idempotent-produce duplicate scan: acked payloads seen >1x in
         # the owner-row applied logs (expected clean; see above).
         "dup_check": {"dup_acked": dup_acked,

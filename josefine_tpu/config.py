@@ -129,6 +129,22 @@ class RaftConfig:
     # when diagnosing why routed_frac is below target. The spill COUNT is
     # always available as raft_route_ring_spills_total.
     flight_ring_spill: bool = False
+    # Tick-denominated leader leases (raft/lease.py): the host mirrors a
+    # per-group lease row (holder, expiry tick, granted term) renewed by
+    # quorum-acknowledged AppendEntries evidence, letting the broker serve
+    # Fetch/Metadata leader-local (broker.read_mode = "lease") without a
+    # consensus round-trip. Observation-only: nothing in the device step
+    # reads lease state, so leases-on consensus traffic is byte-identical
+    # to leases-off (tests/test_lease_safety.py twin differentials). Off by
+    # default; requires prevote (always on here) and an election timeout of
+    # at least hb_ticks + 3 ticks — validated below and again at engine
+    # init (lease.check_lease_params).
+    leases: bool = False
+    # lease_acquired / lease_renewed / lease_expired / lease_refused events
+    # in the flight journal. Off by default, the flight_wire discipline:
+    # renewals are per-quorum-advance per held group, so chaos soaks want
+    # it and the bench hot path does not.
+    flight_lease: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120
@@ -201,6 +217,21 @@ class RaftConfig:
             raise ValueError("raft.window_ticks must be >= 1")
         if self.flight_ring < 1:
             raise ValueError("raft.flight_ring must be >= 1")
+        if self.leases:
+            # Same derivation RaftServer uses to turn ms into ticks; fail
+            # at config time with the constraint in tick units so the
+            # operator sees the actual safety margin (lease.py module docs:
+            # lease duration timeout_min must exceed the heartbeat cadence
+            # by >= 3 ticks or an idle leader can expire between renewals).
+            t_min = max(2, self.election_timeout_min_ms // self.tick_ms)
+            hb = max(1, self.heartbeat_timeout_ms // self.tick_ms)
+            if t_min <= hb + 2:
+                raise ValueError(
+                    f"raft.leases requires election_timeout_min >= "
+                    f"heartbeat + 3 ticks (got timeout_min={t_min}, "
+                    f"hb_ticks={hb}): a leased leader renews on heartbeat "
+                    "acks, so the lease window must outlive the renewal "
+                    "cadence with margin")
         for n in self.nodes:
             if n.id == self.id:
                 raise ValueError(f"raft.nodes must not contain self (id {n.id})")
@@ -273,6 +304,16 @@ class BrokerConfig:
     # (bench_log.py --fsync). The reference never decided (sled defaults,
     # src/lib.rs:33).
     durability: str = "process"
+    # Read-path mode (ARCHITECTURE.md "Leader leases"): "local" (default)
+    # serves Fetch/Metadata from the local replica with no leadership
+    # check — the seed behavior, weakest consistency; "lease" serves
+    # leader-local iff this node holds an unexpired tick-denominated lease
+    # for the partition's group (raft.leases must be on), falling back to
+    # a quorum read barrier when the lease is expired/frozen/mid-recycle;
+    # "consensus" always pays the read barrier (ReadIndex-style quorum
+    # round-trip) — the baseline the lease row in BENCH_traffic.json is
+    # measured against.
+    read_mode: str = "local"
 
     def validate(self) -> None:
         if self.id == 0:
@@ -287,6 +328,10 @@ class BrokerConfig:
                 f"got {self.durability!r}")
         if self.max_group_inflight < 0:
             raise ValueError("broker.max_group_inflight must be >= 0")
+        if self.read_mode not in ("local", "lease", "consensus"):
+            raise ValueError(
+                f"broker.read_mode must be 'local', 'lease' or "
+                f"'consensus', got {self.read_mode!r}")
 
 
 @dataclass
@@ -333,6 +378,12 @@ class JosefineConfig:
             # spaces to coincide, as they do in every example config.
             raise ValueError(
                 "engine.partitions > 1 requires raft.id == broker.id")
+        if self.broker.read_mode != "local" and not self.raft.leases:
+            # Both non-local modes ride the lease lane: "lease" for the
+            # fast path, "consensus" for the read-barrier waiter machinery.
+            raise ValueError(
+                f"broker.read_mode = {self.broker.read_mode!r} requires "
+                "raft.leases = true")
         return self
 
 

@@ -488,3 +488,23 @@ def purge_plane_row_masked(plane, g, keep_mask):
     gi = jax.lax.broadcasted_iota(jnp.int32, plane.shape, 1)
     sel = (gi == g) & ~keep_mask[None, None, :]
     return jnp.where(sel, jnp.zeros_like(plane), plane)
+
+
+def place_lease_plane(mesh: Mesh, plane_np):
+    """device_put the (P, 3) lease mirror plane [holder, expiry, term]
+    (raft/lease.py) co-sharded with the engine state on 'p' — the lease
+    lane is per-group bookkeeping, so a row and its lease always live on
+    the same shard and no update ever crosses ICI."""
+    return jax.device_put(plane_np, NamedSharding(mesh, P("p", None)))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def lease_plane_select(plane, changed_mask, vals):
+    """Mesh twin of packed_step._lease_plane_scatter_fn: refresh the
+    rows of the (P, 3) lease mirror where ``changed_mask`` (P,) is True
+    with the matching rows of ``vals`` (P, 3), as a pure elementwise
+    select — the same no-dynamic-scatter rule as
+    :func:`purge_plane_row_masked`, so GSPMD keeps the plane
+    'p'-sharded with zero cross-shard traffic. The plane is donated
+    (the engine exclusively owns it between refreshes)."""
+    return jnp.where(changed_mask[:, None], vals, plane)

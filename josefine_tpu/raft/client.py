@@ -51,3 +51,18 @@ class RaftClient:
         requested groups (use for Metadata requests spanning many
         partitions); groups this node does not lead are absent."""
         return self._server.engine.in_sync_ids_map(groups)
+
+    def lease_serve(self, group: int = 0) -> tuple[bool, str]:
+        """Whether a read on ``group`` may be served leader-local right now
+        under the tick-denominated leader lease (raft.leases); ``(ok,
+        reason)`` — see RaftEngine.lease_serve. Counts the decision in
+        raft_reads_leased_total / raft_reads_fallback_total."""
+        return self._server.engine.lease_serve(group)
+
+    def read_barrier(self, group: int = 0):
+        """Awaitable quorum read barrier (ReadIndex-style): resolves True
+        once a quorum acknowledged this leader's traffic from the current
+        tick onward — local committed state is then at least as fresh as
+        any write acknowledged before the barrier started. False = lost
+        leadership; the caller answers a retryable NotLeader."""
+        return self._server.engine.read_barrier(group)

@@ -67,6 +67,12 @@ from josefine_tpu.raft.group_admin import (
     GroupAdmin,
 )
 from josefine_tpu.raft.hostio import HostIO
+from josefine_tpu.raft.lease import (
+    LeaseLane,
+    check_lease_params,
+    m_reads_fallback,
+    m_reads_leased,
+)
 from josefine_tpu.raft.membership import ConfChange, MemberTable, is_conf
 from josefine_tpu.raft.migration import is_migration_fence
 from josefine_tpu.raft.packed_step import (
@@ -86,12 +92,14 @@ from josefine_tpu.raft.packed_step import (
     _py_packed_step,
     _py_packed_window,
     _py_sparse_window,
+    _lease_plane_scatter_fn,
     _sparse_window_fn,
     _sparse_window_routed_fn,
     _window_step_fn,
     _window_step_routed_fn,
     active_bucket,
     host_wake_mask,
+    route_bucket,
 )
 from josefine_tpu.raft.result import NotLeader, TickResult
 from josefine_tpu.raft.snap_transfer import SnapshotTransfer, _SnapStream
@@ -179,6 +187,10 @@ _m_ring_occ = REGISTRY.gauge(
     "Blocks resident in this engine's device payload ring (route-servable "
     "AppendEntries payloads; see raft_route_ring_spills_total for the "
     "misses)")
+_m_lease_held = REGISTRY.gauge(
+    "raft_lease_holder",
+    "Groups whose tick-denominated leader lease this node currently holds "
+    "(raft.leases; see raft_reads_leased_total for the reads they served)")
 
 _I32 = jnp.int32
 
@@ -217,6 +229,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         flight_wire: bool = False,
         flight_ring_spill: bool = False,
         request_spans: bool = False,
+        leases: bool = False,
+        flight_lease: bool = False,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -631,6 +645,22 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # contextvar) and the mint/commit/apply sites stamp the span's
         # phase rungs. The off path is this single bool in propose().
         self._request_spans = bool(request_spans)
+        # Tick-denominated leader leases (raft/lease.py, raft.leases):
+        # host-derived from quorum-ack evidence the tick-finish fetches
+        # anyway, OBSERVATION-ONLY with respect to the step (nothing in
+        # the kernel reads lease state, so leases-on wire traffic is
+        # byte-identical to leases-off — tests/test_lease_safety.py).
+        # _lease_plane is the (P, 3) device mirror [holder, expiry,
+        # term], scatter-refreshed for changed rows and co-sharded on
+        # the 'p' mesh; None until the first lease row changes.
+        self._flight_lease = bool(flight_lease)
+        self._lease: LeaseLane | None = None
+        self._lease_plane = None
+        if leases:
+            check_lease_params(self.params)
+            self._lease = LeaseLane(self.P, self.N, self.me,
+                                    int(self.params.timeout_min))
+        self._c_leased = m_reads_leased.bind(node=self.self_id)
         # Pipelined-tick state: the in-flight tick handle (tick_pipelined's
         # double buffer), the dispatch-in-flight flag (True from tick_begin
         # until the tick's device fetch materializes), and host-side
@@ -699,6 +729,8 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             r = self._fabric.rings.get(self.me)
             if r is not None:
                 _m_ring_occ.set(r.occupancy(), node=node)
+        if self._lease is not None:
+            _m_lease_held.set(self._lease.valid_count(), node=node)
         if self._active_set:
             _m_wake_frac.set(
                 round(self._last_wake_rows / max(1, self.P), 6), node=node)
@@ -792,6 +824,12 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             return
         if msg.kind in _PAROLE_DROP_KINDS and msg.group in self._parole:
             return  # on vote parole: abstain from elections (see _reset_group)
+        if (self._lease is not None and msg.kind == rpc.MSG_APPEND_RESP
+                and msg.ok):
+            # Lease evidence: an accepted-append ack drains the (group,
+            # src) ship queue (raft/lease.py) — pure host observation,
+            # the message still rides the inbox unchanged.
+            self._lease.credit(msg.group, msg.src, msg.x, msg.term)
         self._c_in.inc()
         self._pending_msgs.append(msg)
 
@@ -875,6 +913,11 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             for grp in bad:
                 b.blocks.pop(grp, None)
         if len(b):
+            if self._lease is not None:
+                am = (b.kind_col == rpc.MSG_APPEND_RESP) & (b.ok != 0)
+                if am.any():
+                    self._lease.credit_many(b.group[am], b.src, b.x[am],
+                                            b.term[am])
             self._c_in.inc(len(b))
             self._pending_batches.append(b)
             # Backlog cap per src: a peer that floods stale per-tick
@@ -2011,6 +2054,12 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # AE-ack claims to hold, and a same-tick vote grant from the wiped
         # row is exactly the forgotten-ack vote parole exists to prevent.
         skip = self._recycled_this_tick | reset_rows
+        if self._lease is not None:
+            # Lease lane (raft/lease.py): record this tick's shipped AEs,
+            # recompute expiries off the post-adoption mirrors, settle
+            # read barriers. Observation-only — runs before the route/
+            # decode below but reads the SAME compact outbox they do.
+            self._lease_finish(proc, ov_c, skip, t_now)
         if ring is not None and (self._ring_stage_decode or ring_pend):
             # Stage this finish's minted/adopted blocks — plus the capped
             # catch-up reads the LAST decode recorded (deferred one tick:
@@ -2102,6 +2151,197 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
 
     def term(self, group: int = 0) -> int:
         return int(self._h_term[group])
+
+    # ------------------------------------------------------------- leases
+
+    def _lease_finish(self, proc, ov_c, skip, t_now: int) -> None:
+        """Per-tick lease maintenance (tick_finish, post mirror adoption):
+        resync armed terms with the role/term mirrors, push this tick's
+        shipped AppendEntries onto the evidence queues (pre-cap send tops
+        from the compact outbox — the composition matches _decode_outbox
+        bit for bit, so acks match their ships exactly), recompute every
+        led row's expiry, settle read barriers, journal transitions, and
+        refresh the device mirror plane for changed rows."""
+        lane = self._lease
+        lead = self._h_role == LEADER
+        lane.resync(lead, self._h_term)
+        if len(proc):
+            ae = ov_c[0] == rpc.MSG_APPEND
+            if ae.any():
+                gids = np.asarray(proc, np.int64)
+                if skip:
+                    smask = np.isin(gids, np.fromiter(skip, np.int64,
+                                                      len(skip)))
+                    if smask.any():
+                        ae = ae & ~smask[:, None]
+                ae[:, self.me] = False
+                rows, dsts = np.nonzero(ae)
+                if len(rows):
+                    i64 = np.int64
+                    y64 = ((ov_c[4][rows, dsts].astype(i64) << 32)
+                           | ov_c[5][rows, dsts].astype(i64))
+                    lane.record(gids[rows], dsts, y64, t_now)
+        ev = lane.recompute(t_now, lead, self._h_term, self._mask_np)
+        lane.resolve_waiters(lead, self._h_term, self._mask_np)
+        if self._flight_lease:
+            fl = self.flight
+            for g in ev["acquired"].tolist():
+                fl.emit(t_now, "lease_acquired", group=g,
+                        term=int(lane.ev_term[g]), leader=self.me,
+                        expiry=int(lane.expiry[g]))
+            for g in ev["renewed"].tolist():
+                fl.emit(t_now, "lease_renewed", group=g,
+                        term=int(lane.ev_term[g]), leader=self.me,
+                        expiry=int(lane.expiry[g]))
+            for g in ev["expired"].tolist():
+                fl.emit(t_now, "lease_expired", group=g,
+                        term=int(self._h_term[g]),
+                        leader=int(self._h_leader[g]))
+        if len(ev["changed"]):
+            self._lease_plane_update(ev["changed"], ev["plane_vals"])
+
+    def _lease_plane_update(self, rows: np.ndarray,
+                            vals: np.ndarray) -> None:
+        """Refresh the (P, 3) device lease mirror [holder, expiry, term]
+        for changed rows. Observation-only: nothing in the step reads
+        it — it exists so device-side consumers can check lease
+        occupancy without a host round trip. Scalar-twin engines keep
+        the host array itself as the plane (the differential rigs
+        compare values, not buffer types)."""
+        lane = self._lease
+        if self._backend != "jax":
+            self._lease_plane = lane.plane_np
+            return
+        if self._mesh is not None:
+            from josefine_tpu.parallel.sharded import (
+                lease_plane_select, place_lease_plane)
+            if self._lease_plane is None:
+                self._lease_plane = place_lease_plane(self._mesh,
+                                                      lane.plane_np)
+                return
+            # Elementwise masked select: keeps the plane 'p'-sharded (a
+            # dynamic-index scatter could make GSPMD gather it), same
+            # rule as the route fabric's sharded purge.
+            mask = np.zeros(self.P, bool)
+            mask[rows] = True
+            self._lease_plane = lease_plane_select(
+                self._lease_plane, jnp.asarray(mask),
+                jnp.asarray(lane.plane_np))
+            return
+        if self._lease_plane is None:
+            self._lease_plane = jnp.asarray(lane.plane_np)
+            return
+        # Bucketed scatter (power-of-8 ladder, padding rows dropped) so
+        # jit caches a handful of variants instead of one per row count.
+        B = route_bucket(len(rows), self.P)
+        idx_b = np.full(B, self.P, np.int32)
+        idx_b[:len(rows)] = rows
+        vals_b = np.zeros((B, 3), np.int64)
+        vals_b[:len(rows)] = vals
+        self._lease_plane = _lease_plane_scatter_fn(
+            self._lease_plane, jnp.asarray(idx_b), jnp.asarray(vals_b))
+
+    def lease_valid(self, group: int) -> bool:
+        """True iff this node may serve ``group``'s reads leader-local
+        right now: the row leads at its armed term, the lease tick has
+        not expired, and the group is not frozen for migration. Any
+        in-kernel step-down lands in the role mirror within the same
+        tick_finish, so this gate can never outlive a deposition."""
+        lane = self._lease
+        if lane is None or not self.has_group(group):
+            return False
+        return (bool(lane.valid[group])
+                and self._h_role[group] == LEADER
+                and lane.ev_term[group] == self._h_term[group]
+                and self._ticks < lane.expiry[group]
+                and group not in self._frozen_groups)
+
+    def lease_expiry(self, group: int) -> int | None:
+        """The group's lease expiry tick (exclusive), or None when no
+        lease is held."""
+        if not self.lease_valid(group):
+            return None
+        return int(self._lease.expiry[group])
+
+    def lease_serve(self, group: int) -> tuple[bool, str]:
+        """Gate a leader-local read: (True, "ok") when the lease covers
+        it (counted in raft_reads_leased_total), else (False, reason)
+        with reason in off / frozen / not_leader / expired (counted in
+        raft_reads_fallback_total{reason}; journaled as lease_refused
+        under raft.flight_lease). Callers fall back to read_barrier()
+        or surface a retryable NotLeader."""
+        lane = self._lease
+        if lane is None or not self.has_group(group):
+            reason = "off"
+        elif group in self._frozen_groups:
+            reason = "frozen"
+        elif not (self._h_role[group] == LEADER
+                  and lane.ev_term[group] == self._h_term[group]):
+            reason = "not_leader"
+        elif not (lane.valid[group]
+                  and self._ticks < lane.expiry[group]):
+            reason = "expired"
+        else:
+            self._c_leased.inc()
+            return True, "ok"
+        m_reads_fallback.inc(node=self.self_id, reason=reason)
+        if lane is not None and self._flight_lease:
+            self.flight.emit(self._ticks, "lease_refused",
+                             group=int(group) if self.has_group(group)
+                             else -1,
+                             term=self.term(group)
+                             if self.has_group(group) else -1,
+                             reason=reason)
+        return False, reason
+
+    def read_barrier(self, group: int) -> asyncio.Future:
+        """ReadIndex-style read fence, the consensus fallback for the
+        lease fast path: resolves True once a full quorum of peers has
+        acked AppendEntries shipped at or after the call tick — proving
+        this node was still the leader when the read arrived — and False
+        (the caller surfaces a retryable NotLeader) the moment the row
+        stops leading at its armed term. Appends NOTHING to the log, so
+        the write plane is byte-identical whichever read mode runs.
+
+        Trace context: a bound RequestSpan gets the barrier wait as its
+        consensus phase (minted at submit, committed+applied at quorum) —
+        the span shape the lease fast path collapses to zero."""
+        fut = asyncio.get_running_loop().create_future()
+        lane = self._lease
+        g = int(group)
+        span = current_span() if self._request_spans else None
+        if span is not None:
+            span.mark("minted", self._ticks)
+
+            def _close(f, span=span):
+                t = self._ticks
+                span.mark("committed", t)
+                span.mark("applied", t)
+            fut.add_done_callback(_close)
+        if (lane is None or not self.has_group(g)
+                or self._h_role[g] != LEADER
+                or lane.ev_term[g] != self._h_term[g]):
+            fut.set_result(False)
+            return fut
+        if int(self._mask_np[g].sum()) // 2 == 0:
+            fut.set_result(True)  # self-quorum: the local read is exact
+            return fut
+        lane.add_waiter(g, self._ticks, fut)
+        return fut
+
+    def _lease_invalidate(self, group: int) -> None:
+        """Drop a row's lease state (reset / recycle / membership
+        change). The serve gate's role check already refuses instantly —
+        this clears evidence and queues so nothing from the old
+        incarnation or member set ever credits the next."""
+        lane = getattr(self, "_lease", None)
+        if lane is not None:
+            lane.reset_rows(np.asarray([group], np.int64))
+
+    def lease_summary(self) -> dict | None:
+        """Lane telemetry for bench rows / soak artifacts (None when
+        leases are off)."""
+        return None if self._lease is None else self._lease.summary()
 
     def in_sync_map(self, groups, max_lag: int = 64,
                     liveness_ticks: int = 30) -> dict[int, set[int]]:

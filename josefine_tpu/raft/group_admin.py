@@ -107,6 +107,10 @@ class GroupAdmin:
         # (co-sharded on mesh engines — see _place_member).
         self._mask_np[g] = self._claim_row(g, self._active_vec())
         self.member = self._place_member(self._mask_np)
+        # A claim change moves the row's quorum arithmetic: lease
+        # evidence earned against the old member set must not carry over
+        # (raft/lease.py — the n_need intersection bound assumed it).
+        self._lease_invalidate(g)
         # A claim change moves quorum/membership for the row — wake it so
         # the full kernel (not the decay closed form) sees the new mask.
         # (Dense engines never drain _force_active, so only track it when
@@ -144,6 +148,12 @@ class GroupAdmin:
         # stamp isolates stale frames instead.
         self._reset_group(g, parole=False)
         self._lift_parole(g)
+        # The dead incarnation's lease evidence and queued ships must not
+        # survive into the new topic's life: the serve gate already refuses
+        # (the role mirror is demoted above), but a straggler ack arriving
+        # before the next tick_finish resync would otherwise still credit
+        # the old queues.
+        self._lease_invalidate(g)
         self._h_last_seen[g] = 0
         # Queued-but-unminted proposals belong to the dead incarnation:
         # fail their futures (NotLeader — the client re-routes/retries)
@@ -440,6 +450,10 @@ class GroupAdmin:
         self._h_commit[g] = GENESIS
         self._h_role[g] = 0
         self._h_leader[g] = -1
+        # Any held lease dies with the row (the serve gate's role check
+        # already refuses from this line on; this drops the evidence so
+        # the successor incarnation re-earns it from its own acks).
+        self._lease_invalidate(g)
         # Timer mirrors follow the device-row demotion below (elapsed and
         # hb_elapsed zeroed; timeout keeps its old draw), and the recycled
         # row is forced into the next active set — its next step must run
@@ -529,6 +543,12 @@ class GroupAdmin:
             return
         self.node_ids = [self.members.id_of(s) for s in range(self.N)]
         self.member = self._member_mask()
+        # Cluster membership moved: EVERY row's quorum arithmetic is
+        # rebuilt from the new mask, so all lease evidence is suspect —
+        # disarm the whole lane and re-earn it (raft/lease.py).
+        lane = getattr(self, "_lease", None)
+        if lane is not None:
+            lane.reset_all()
         if self.on_conf_applied is not None:
             # App-layer hook (wired by the node, like the partition hooks):
             # e.g. pruning row-drain entries pinned to a removed broker.

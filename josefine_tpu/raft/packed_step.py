@@ -335,6 +335,19 @@ def _purge_plane_row_fn(plane, g, keep_mask):
         jnp.where(keep_mask[None, :], row, jnp.zeros_like(row)))
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _lease_plane_scatter_fn(plane, idx, vals):
+    """Refresh changed rows of the (P, 3) device lease mirror
+    [holder, expiry tick, granted term] (raft/lease.py): ``idx`` is the
+    bucketed changed-row set (padded with P — dropped), ``vals`` the
+    (bucket, 3) int64 replacement rows. The plane is DONATED — the
+    engine exclusively owns it between scatters, so XLA updates in
+    place instead of copying per tick. Observation-only: no step kernel
+    reads this plane, which is what keeps leases-on step programs
+    byte-identical to leases-off."""
+    return plane.at[idx].set(vals, mode="drop")
+
+
 # Multi-tick device window (VERDICT r3 #3 — close the product-vs-bench
 # kernel gap). One dispatch folds ``window`` consecutive ticks: the uploaded
 # inbox (and queued proposals) applies at tick 1, ticks 2..K run with an

@@ -363,6 +363,22 @@ class RouteFabric:
             if len(rs):
                 routed[rs, d] = True
                 terms_col = ov[1][rs, d]
+                peer_lease = getattr(peer, "_lease", None)
+                if peer_lease is not None:
+                    # Routed APPEND_RESP frames never reach the receiver's
+                    # host decode, so the lease lane's ack credit
+                    # (raft/lease.py) hooks the route decision instead:
+                    # the ack column composition matches hostio's
+                    # bit for bit. Pure host observation — the scatter
+                    # below is untouched.
+                    ak = (kind[rs, d] == rpc.MSG_APPEND_RESP) \
+                        & (ov[8][rs, d] != 0)
+                    if ak.any():
+                        ar = rs[ak]
+                        x64 = ((ov[2][ar, d].astype(i64) << 32)
+                               | ov[3][ar, d].astype(i64))
+                        peer_lease.credit_many(
+                            gids[ar], me, x64, ov[1][ar, d].astype(i64))
                 if engine._flight_wire:
                     # Wire trace: routed msg_sent, off the routed rows the
                     # decision table just selected (terms from the

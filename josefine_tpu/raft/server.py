@@ -96,6 +96,8 @@ class JosefineRaft:
             flight_wire=getattr(config, "flight_wire", False),
             flight_ring_spill=getattr(config, "flight_ring_spill", False),
             request_spans=getattr(config, "request_spans", False),
+            leases=getattr(config, "leases", False),
+            flight_lease=getattr(config, "flight_lease", False),
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

@@ -137,6 +137,12 @@ class _InprocClient:
     def proposal_backlog(self, group: int) -> int:
         return self._engine.proposal_backlog(group)
 
+    def lease_serve(self, group: int = 0):
+        return self._engine.lease_serve(group)
+
+    def read_barrier(self, group: int = 0):
+        return self._engine.read_barrier(group)
+
 
 class _PeerShimFsm:
     """Snapshot-capable stand-in registered on the chain-only replica
@@ -221,7 +227,9 @@ class TrafficEngine:
                  replication: int = 1, device_route: bool = False,
                  payload_ring: bool = False,
                  request_spans: bool = False,
-                 span_capacity: int = 4096):
+                 span_capacity: int = 4096,
+                 leases: bool = False, flight_lease: bool = False,
+                 read_mode: str = "local", timeout_min: int = 3):
         self.spec = spec.validate()
         self.seed = seed
         self.model = TenantModel(spec)
@@ -245,13 +253,25 @@ class TrafficEngine:
         # with the ring on, the AE-with-blocks leg routes on-chip and the
         # serve loop's host share is the broker handlers themselves.
         self.replication = max(1, int(replication))
+        # Read-path mode (config.BrokerConfig.read_mode): non-local modes
+        # ride the engine's lease lane. timeout_min is a knob (not bumped
+        # implicitly when leases turn on) so a leases-on/off BENCH pair
+        # can run IDENTICAL election parameters — the twin-differential
+        # digest comparison requires the consensus plane byte-identical.
+        if read_mode not in ("local", "lease", "consensus"):
+            raise ValueError(f"read_mode must be local|lease|consensus, "
+                             f"got {read_mode!r}")
+        if read_mode != "local" and not leases:
+            raise ValueError(f"read_mode={read_mode!r} requires leases=True")
+        self.read_mode = read_mode
         node_ids = list(range(1, self.replication + 1))
         self.engine = RaftEngine(
             self.kv, node_ids, 1, groups=P, fsms={0: self.fsm},
-            params=step_params(timeout_min=3, timeout_max=8,
+            params=step_params(timeout_min=timeout_min, timeout_max=8,
                                hb_ticks=hb_ticks),
             base_seed=seed, backend=backend, active_set=active_set,
-            request_spans=request_spans)
+            request_spans=request_spans, leases=leases,
+            flight_lease=flight_lease)
         # Request spans (in-process trace context: minted at first
         # enqueue — the "driver submit" of the wire path's frame decode —
         # finished at response harvest; every mark rides the ENGINE tick
@@ -293,7 +313,8 @@ class TrafficEngine:
                                       ring_bytes=1024)
             for e in self.engines:
                 self.fabric.register(e)
-        cfg = BrokerConfig(id=1, ip="127.0.0.1", port=9092, seed=seed)
+        cfg = BrokerConfig(id=1, ip="127.0.0.1", port=9092, seed=seed,
+                           read_mode=read_mode)
         if max_group_inflight is not None:
             cfg.max_group_inflight = max_group_inflight
         self.broker = Broker(cfg, self.store, _InprocClient(self.engine))
@@ -329,6 +350,10 @@ class TrafficEngine:
         self._inflight: list[_Flight] = []
         self._commit_tasks: list[tuple[int, object]] = []  # (tenant, task)
         self._ack_tasks: list[tuple[int, object]] = []     # (group, task)
+        # Gated reads (read_mode != "local") run as harvested tasks: a
+        # lease-fallback read barrier resolves inside engine.tick, so an
+        # inline await in the consumer round would deadlock the tick loop.
+        self._fetch_tasks: list[tuple[int, object]] = []   # (tenant, task)
         # Bounded admission (queues/inflight/retry ledger): the ONE policy
         # implementation, shared with the chaos traffic adapter.
         self._adm = AdmissionState(spec)
@@ -508,7 +533,7 @@ class TrafficEngine:
         for _ in range(drain):
             if not (self._inflight or self._adm.pending()
                     or self._commit_tasks or self._ack_tasks
-                    or self._mig_tasks):
+                    or self._mig_tasks or self._fetch_tasks):
                 break
             await self._tick_once(offer=False)
         aborted = len(self._inflight) + self._adm.pending()
@@ -521,16 +546,20 @@ class TrafficEngine:
                 task.cancel()
             for _n, task in self._mig_tasks:
                 task.cancel()
+            for _t, task in self._fetch_tasks:
+                task.cancel()
             await asyncio.gather(
                 *(f.task for f in self._inflight),
                 *(task for _, task in self._commit_tasks),
                 *(task for _, task in self._ack_tasks),
                 *(task for _, task in self._mig_tasks),
+                *(task for _, task in self._fetch_tasks),
                 return_exceptions=True)
             self._inflight = []
             self._commit_tasks = []
             self._ack_tasks = []
             self._mig_tasks = []
+            self._fetch_tasks = []
             self._adm.clear()
             self.trace.emit(self.tick, "drain_aborted", pending=aborted)
         if self._ledger:
@@ -680,6 +709,14 @@ class TrafficEngine:
             self.trace.emit(t, "recycle_ack", group=g)
         self._ack_tasks = still_a
 
+        still_f = []
+        for tenant, task in self._fetch_tasks:
+            if not task.done():
+                still_f.append((tenant, task))
+                continue
+            task.result()  # gated-fetch errors surface loudly
+        self._fetch_tasks = still_f
+
         still_m = []
         for name, task in self._mig_tasks:
             if not task.done():
@@ -764,7 +801,11 @@ class TrafficEngine:
             for c in self._consumers[tenant]:
                 if not c.live or (t + c.idx) % every:
                     continue
-                await self._fetch_for(t, c)
+                if self.read_mode == "local":
+                    await self._fetch_for(t, c)
+                else:
+                    self._fetch_tasks.append((c.tenant, asyncio.ensure_future(
+                        self._fetch_for(t, c))))
                 # Per-session commit cadence (ticks since THIS consumer's
                 # last commit): a global t % commit_every gate composed
                 # with the staggered fetch gate, and most sessions' two
@@ -1192,6 +1233,10 @@ class TrafficEngine:
                 "errors": self.n_errors,
             },
             "fetched_bytes": self.n_fetched_bytes,
+            "read_mode": self.read_mode,
+            # Lease-lane epilogue (raft.leases): held rows, renewal credits,
+            # queue-overflow refusals — None when leases are off.
+            "lease": self.engine.lease_summary(),
             "offset_commits": self.n_offset_commits,
             "recycle_acks": self.n_recycle_acks,
             # Live migrations resolved this run: pause (begin -> cutover,

@@ -65,8 +65,9 @@ def test_api_versions_matches_codec(broker):
     assert advertised == set(supported_apis())
 
 
-def test_metadata_unknown_topic(broker):
-    body = broker.metadata(1, {"topics": [{"name": "nope"}]})
+@pytest.mark.asyncio
+async def test_metadata_unknown_topic(broker):
+    body = await broker.metadata(1, {"topics": [{"name": "nope"}]})
     assert body["topics"][0]["error_code"] == ErrorCode.UNKNOWN_TOPIC_OR_PARTITION
     assert body["cluster_id"] == "josefine"
     assert body["brokers"][0]["node_id"] == 1
@@ -85,7 +86,7 @@ async def test_create_topics_end_to_end(broker):
     assert broker.replicas.get("events", 0) is not None
     assert broker.replicas.get("events", 1) is not None
     # Metadata now serves it.
-    md = broker.metadata(1, {"topics": None})
+    md = await broker.metadata(1, {"topics": None})
     assert md["topics"][0]["name"] == "events"
     assert len(md["topics"][0]["partitions"]) == 2
 

@@ -332,7 +332,7 @@ async def test_delete_topics_removes_everything(broker, tmp_path):
     assert broker.replicas.get("doomed", 0) is None
     assert not log_dir.exists()
     # Metadata now reports it unknown.
-    md = broker.metadata(1, {"topics": [{"name": "doomed"}]})
+    md = await broker.metadata(1, {"topics": [{"name": "doomed"}]})
     assert md["topics"][0]["error_code"] == ErrorCode.UNKNOWN_TOPIC_OR_PARTITION
 
 

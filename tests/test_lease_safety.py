@@ -1,0 +1,669 @@
+"""Tick-denominated leader leases: the safety suite (raft/lease.py).
+
+The lease lane claims three things, each pinned here at its own layer:
+
+* **Observation-only**: nothing in the packed step reads lease state, so
+  a leases-on engine emits byte-identical wire traffic to its leases-off
+  twin — pinned by twin differentials across the plain, active-set,
+  pipelined, routed-fabric, and sharded-mesh drivers (the same rig as
+  tests/test_active_set.py / test_device_route.py).
+* **Non-overlap**: while one engine's lease on a group is valid, no
+  other live engine leads that group at a term >= the holder's — pinned
+  through an election (leader isolated past the lease window), a group
+  recycle, and a migration freeze.
+* **Evidence soundness**: the FIFO ship-queue accounting only ever
+  under-credits — overflow refuses pushes, capped-frame acks match
+  nothing, wrong-term acks are ignored — pinned by LeaseLane unit tests
+  against the module-docstring pop rule and quorum arithmetic.
+
+The chaos-mode guards (skew schedules and duplicating nets are refused
+with leases on) and a tier-1 mini soak of the bundled stale-read nemesis
+ride along; the full bundled schedule plus two-run determinism is
+``slow`` (tools/ci.sh full runs this file unfiltered).
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from josefine_tpu.models.types import step_params
+from josefine_tpu.raft.engine import RaftEngine
+from josefine_tpu.raft.lease import (
+    NEG_TICK,
+    QUEUE_DEPTH,
+    LeaseLane,
+    check_lease_params,
+)
+from josefine_tpu.raft.route import RouteFabric
+from josefine_tpu.utils.kv import MemKV
+from test_active_set import ListFsm, _wire_key
+# The device-route variant of the engine-equality helper: it skips the
+# timer-mirror exactness stanza (an ACTIVE-set-only property; these
+# twins span plain/pipelined/routed/mesh drivers) and adds the liveness
+# mirrors (_h_src_seen/_h_last_seen) to the comparison.
+from test_device_route import _assert_engines_equal
+
+# check_lease_params needs timeout_min > hb_ticks + 2; the suite-default
+# (timeout_min=3, hb_ticks=1) fails it by design, so every lease cluster
+# here runs one tick wider. Twin differentials give BOTH twins these
+# params — election timing must be tick-identical for the comparison.
+PARAMS = step_params(timeout_min=4, timeout_max=8, hb_ticks=1)
+
+
+def mk_cluster(n=3, groups=1, leases=True, seeds=None, **kw):
+    ids_ = [10 * (i + 1) for i in range(n)]
+    return [RaftEngine(MemKV(), ids_, ids_[i], groups=groups,
+                       fsms={0: ListFsm()}, params=PARAMS,
+                       base_seed=(seeds or [7] * n)[i], leases=leases, **kw)
+            for i in range(n)]
+
+
+def run_ticks(engines, n, down=(), isolated=()):
+    """Lockstep tick with next-tick delivery (test_engine idiom) plus a
+    SYMMETRIC isolation set: traffic crossing the isolation boundary is
+    dropped both ways, but isolated engines keep ticking — the partition
+    shape the lease argument is about (the cut-off leader keeps its
+    stale leadership belief; only its lease expiry stops its serves)."""
+    for _ in range(n):
+        batches = []
+        for i, e in enumerate(engines):
+            if i in down:
+                continue
+            batches.append((i, e.tick()))
+        for i, res in batches:
+            for m in res.outbound:
+                if m.dst >= len(engines) or m.dst in down:
+                    continue
+                if (m.src in isolated) != (m.dst in isolated):
+                    continue
+                engines[m.dst].receive(m)
+
+
+def wait_leader(engines, g=0, down=(), isolated=(), max_ticks=120):
+    """Tick until the non-isolated live majority agrees on one leader
+    for group ``g`` (test_engine's wait_leader, group-parametrized)."""
+    for _ in range(max_ticks):
+        run_ticks(engines, 1, down=down, isolated=isolated)
+        live = [i for i in range(len(engines))
+                if i not in down and i not in isolated]
+        leaders = [i for i in live if engines[i].is_leader(g)]
+        if len(leaders) == 1 and all(
+                engines[i].leader_index(g) == leaders[0] for i in live):
+            return leaders[0]
+    raise AssertionError("no leader elected")
+
+
+def wait_lease(engines, lead, g=0, max_ticks=20, **kw):
+    for _ in range(max_ticks):
+        if engines[lead].lease_valid(g):
+            return
+        run_ticks(engines, 1, **kw)
+    raise AssertionError(f"node {lead} never acquired a lease on {g}")
+
+
+def holders(engines, g=0):
+    return [i for i, e in enumerate(engines) if e.lease_valid(g)]
+
+
+# --------------------------------------------------------- param validation
+
+
+def test_check_lease_params_accepts_wide_timeout():
+    check_lease_params(PARAMS)  # no raise
+
+
+def test_check_lease_params_rejects_tight_timeout():
+    with pytest.raises(ValueError, match="timeout_min"):
+        check_lease_params(step_params(timeout_min=3, timeout_max=8,
+                                       hb_ticks=1))
+    with pytest.raises(ValueError, match="timeout_min"):
+        check_lease_params(step_params(timeout_min=6, timeout_max=9,
+                                       hb_ticks=4))
+
+
+def test_check_lease_params_rejects_prevote_off():
+    with pytest.raises(ValueError, match="prevote"):
+        check_lease_params(step_params(timeout_min=4, timeout_max=8,
+                                       hb_ticks=1, prevote=0))
+
+
+def test_engine_construction_enforces_lease_params():
+    with pytest.raises(ValueError, match="timeout_min"):
+        RaftEngine(MemKV(), [1, 2, 3], 1, groups=1,
+                   params=step_params(timeout_min=3, timeout_max=8,
+                                      hb_ticks=1),
+                   leases=True)
+
+
+# ------------------------------------------------------------ lane evidence
+
+
+def _armed_lane(P=4, N=3, me=0, timeout_min=4, term=5):
+    lane = LeaseLane(P, N, me, timeout_min)
+    lead = np.zeros(P, bool)
+    lead[0] = True
+    terms = np.zeros(P, np.int64)
+    terms[0] = term
+    lane.resync(lead, terms)
+    assert lane.ev_term[0] == term
+    return lane
+
+
+def test_lane_credit_pops_below_and_equal():
+    lane = _armed_lane()
+    # Ships y=2 @ t=1, y=4 @ t=2, y=6 @ t=3 on (group 0, peer 1).
+    for t, y in ((1, 2), (2, 4), (3, 6)):
+        lane.record(np.array([0]), np.array([1]), np.array([y], np.int64), t)
+    # Ack x=5: pops y=2 and y=4 (strictly below), leaves y=6; the
+    # credited tick is the NEWEST popped ship (t=2).
+    lane.credit(0, 1, 5, term=5)
+    assert lane.ev[0, 1] == 2 and lane._q_len[0, 1] == 1
+    # A lower ack matches nothing (conservative miss, not a regression).
+    lane.credit(0, 1, 1, term=5)
+    assert lane.ev[0, 1] == 2 and lane._q_len[0, 1] == 1
+    # Equal head pops the matching entry too.
+    lane.credit(0, 1, 6, term=5)
+    assert lane.ev[0, 1] == 3 and lane._q_len[0, 1] == 0
+    assert lane.credits == 2
+
+
+def test_lane_capped_ack_misses_then_drains_under_higher():
+    """An ack for a max_append_entries-capped frame carries a head BELOW
+    the queued pre-cap y: it must credit nothing (crediting would vouch
+    for a ship the follower has not fully processed) and the entry must
+    drain under a later, higher ack."""
+    lane = _armed_lane()
+    lane.record(np.array([0]), np.array([1]), np.array([6], np.int64), 3)
+    lane.credit(0, 1, 5, term=5)  # capped head < queued pre-cap y
+    assert lane.ev[0, 1] == NEG_TICK and lane.credits == 0
+    lane.credit(0, 1, 7, term=5)
+    assert lane.ev[0, 1] == 3 and lane.credits == 1
+
+
+def test_lane_wrong_term_ack_ignored():
+    lane = _armed_lane(term=5)
+    lane.record(np.array([0]), np.array([1]), np.array([4], np.int64), 2)
+    lane.credit(0, 1, 9, term=4)   # stale-term ack
+    lane.credit(0, 1, 9, term=6)   # future-term ack (row not armed for it)
+    assert lane.ev[0, 1] == NEG_TICK and lane._q_len[0, 1] == 1
+
+
+def test_lane_overflow_refuses_push_not_oldest():
+    """Drop-NEWEST on a full queue: dropping the oldest would let a later
+    ack match a younger ship and over-credit. The refused push only
+    pauses renewal (the queue still drains normally)."""
+    lane = _armed_lane()
+    for t in range(QUEUE_DEPTH):
+        lane.record(np.array([0]), np.array([1]),
+                    np.array([t + 1], np.int64), t)
+    assert lane._q_len[0, 1] == QUEUE_DEPTH and lane.refused_pushes == 0
+    lane.record(np.array([0]), np.array([1]),
+                np.array([QUEUE_DEPTH + 1], np.int64), QUEUE_DEPTH)
+    assert lane.refused_pushes == 1 and lane._q_len[0, 1] == QUEUE_DEPTH
+    # The oldest entry survived the refusal: an ack for it still credits.
+    lane.credit(0, 1, 1, term=5)
+    assert lane.ev[0, 1] == 0 and lane._q_len[0, 1] == QUEUE_DEPTH - 1
+
+
+def test_lane_resync_disarms_and_rearms_clean():
+    lane = _armed_lane(term=5)
+    lane.record(np.array([0]), np.array([1]), np.array([4], np.int64), 2)
+    lane.credit(0, 1, 4, term=5)
+    assert lane.ev[0, 1] == 2
+    # Term bump on the same led row: evidence is re-earned from the new
+    # term's own acks (old-term acks could predate a rival's window).
+    lead = np.zeros(4, bool)
+    lead[0] = True
+    terms = np.zeros(4, np.int64)
+    terms[0] = 6
+    lane.resync(lead, terms)
+    assert lane.ev_term[0] == 6
+    assert lane.ev[0, 1] == NEG_TICK and lane._q_len[0, 1] == 0
+    # Losing leadership disarms entirely.
+    lane.resync(np.zeros(4, bool), terms)
+    assert lane.ev_term[0] == -1
+
+
+def test_lane_quorum_expiry_arithmetic():
+    """m=3 members needs n_need = m - m//2 - 1 = 1 fresh peer: the
+    expiry is the LARGEST peer evidence tick + timeout_min (exclusive),
+    and validity flips exactly at it."""
+    lane = _armed_lane(P=1, N=3, term=7)
+    lead = np.array([True])
+    terms = np.array([7], np.int64)
+    mask = np.ones((1, 3), bool)
+    lane.ev[0] = [NEG_TICK, 10, 6]  # me=0 column is ignored
+    ev = lane.recompute(12, lead, terms, mask)
+    assert lane.expiry[0] == 14 and bool(lane.valid[0])
+    assert list(ev["acquired"]) == [0]
+    assert lane.plane_np[0].tolist() == [0, 14, 7]
+    ev = lane.recompute(14, lead, terms, mask)  # exclusive expiry
+    assert not lane.valid[0] and list(ev["expired"]) == [0]
+    assert lane.plane_np[0].tolist() == [-1, 0, -1]
+
+
+def test_lane_singleton_rolls_without_peers():
+    """m=1 (and m<=2 generally): every rival quorum contains this
+    leader, who never grants while leading — the lease degenerates to a
+    rolling now + timeout_min with no peer evidence at all."""
+    lane = _armed_lane(P=1, N=1, me=0, term=3)
+    lead = np.array([True])
+    terms = np.array([3], np.int64)
+    mask = np.ones((1, 1), bool)
+    lane.recompute(100, lead, terms, mask)
+    assert lane.expiry[0] == 104 and bool(lane.valid[0])
+
+
+# --------------------------------------------------------- engine lifecycle
+
+
+def test_lease_grant_serve_and_follower_refusal():
+    engines = mk_cluster()
+    lead = wait_leader(engines)
+    wait_lease(engines, lead)
+    assert holders(engines) == [lead]
+    ok, reason = engines[lead].lease_serve(0)
+    assert (ok, reason) == (True, "ok")
+    exp = engines[lead].lease_expiry(0)
+    assert exp is not None
+    assert engines[lead]._ticks < exp <= engines[lead]._ticks + 4
+    for i in range(3):
+        if i == lead:
+            continue
+        assert engines[i].lease_serve(0) == (False, "not_leader")
+        assert engines[i].lease_expiry(0) is None
+    # Leases off entirely: the gate reports "off", never serves.
+    off = mk_cluster(leases=False)
+    l2 = wait_leader(off)
+    assert off[l2].lease_serve(0) == (False, "off")
+    assert off[l2].lease_summary() is None
+
+
+def test_lease_expires_under_isolation_and_never_overlaps():
+    """The stale-read scenario end to end: the holder is cut off
+    symmetrically but KEEPS TICKING (prevote means nothing deposes it in
+    isolation — it still believes it leads); its lease must expire
+    within timeout_min ticks, its serves must refuse with "expired"
+    before the majority can elect, and at no tick do two engines hold
+    valid leases. The new holder's term strictly exceeds the old."""
+    engines = mk_cluster()
+    lead = wait_leader(engines)
+    wait_lease(engines, lead)
+    old_term = engines[lead].term(0)
+
+    # Isolated, the lease may renew off in-flight acks for one round
+    # trip at most; after timeout_min + 2 ticks it MUST be gone.
+    for _ in range(PARAMS.timeout_min + 2):
+        run_ticks(engines, 1, isolated=(lead,))
+        assert len(holders(engines)) <= 1
+    assert not engines[lead].lease_valid(0)
+    assert engines[lead].is_leader(0), "prevote keeps the stale belief"
+    assert engines[lead].lease_serve(0) == (False, "expired")
+
+    # The majority side elects and re-leases; the old holder still ticks.
+    new = wait_leader(engines, isolated=(lead,))
+    assert new != lead
+    wait_lease(engines, new, isolated=(lead,))
+    assert holders(engines) == [new]
+    assert engines[new].term(0) > old_term
+    assert engines[lead].lease_serve(0) == (False, "expired")
+
+    # Heal: the deposed leader adopts the new term and refuses as a
+    # follower; exactly one holder remains.
+    for _ in range(2 * PARAMS.timeout_max):
+        run_ticks(engines, 1)
+        assert len(holders(engines)) <= 1
+    assert not engines[lead].is_leader(0)
+    assert engines[lead].lease_serve(0) == (False, "not_leader")
+    assert holders(engines) == [wait_leader(engines)]
+
+
+def test_recycle_invalidates_lease_and_queues():
+    engines = mk_cluster(groups=2)
+    lead = wait_leader(engines, g=1)
+    wait_lease(engines, lead, g=1)
+    for e in engines:
+        e.recycle_group(1)
+        e.set_group_incarnation(1, 1)
+    # Immediate, not next-tick: a straggler ack from the dead
+    # incarnation must find disarmed queues, not credit them.
+    assert not engines[lead].lease_valid(1)
+    assert engines[lead]._lease.ev_term[1] == -1
+    assert engines[lead]._lease._q_len[1].sum() == 0
+    # The new incarnation re-earns a lease from its own evidence.
+    lead2 = wait_leader(engines, g=1)
+    wait_lease(engines, lead2, g=1)
+
+
+def test_migration_freeze_refuses_then_unfreeze_restores():
+    engines = mk_cluster(groups=2)
+    lead = wait_leader(engines, g=1)
+    wait_lease(engines, lead, g=1)
+    engines[lead].freeze_group(1)
+    assert not engines[lead].lease_valid(1)
+    assert engines[lead].lease_serve(1) == (False, "frozen")
+    # Freeze does NOT shed the evidence — the handoff may abort, and the
+    # quorum acks stayed live — so unfreeze restores the lease at once.
+    engines[lead].unfreeze_group(1)
+    assert engines[lead].lease_valid(1)
+    assert engines[lead].lease_serve(1) == (True, "ok")
+
+
+def test_read_barrier_semantics():
+    """The consensus fallback: a leader's barrier resolves True after a
+    quorum acks post-submission ships; a follower's resolves False
+    immediately (retryable NotLeader); a single-node group is its own
+    quorum and resolves True inline."""
+
+    async def main():
+        engines = mk_cluster()
+        lead = wait_leader(engines)
+        fut = engines[lead].read_barrier(0)
+        assert not fut.done()
+        run_ticks(engines, 2 * PARAMS.hb_ticks + 3)
+        assert fut.done() and (await fut) is True
+        follower = next(i for i in range(3) if i != lead)
+        fut = engines[follower].read_barrier(0)
+        assert fut.done() and (await fut) is False
+
+        solo = mk_cluster(n=1)
+        wait_leader(solo)
+        assert solo[0].lease_valid(0), "n=1 lease rolls with no peers"
+        fut = solo[0].read_barrier(0)
+        assert fut.done() and (await fut) is True
+
+    asyncio.run(main())
+
+
+def test_read_barrier_fails_on_leadership_loss():
+    async def main():
+        engines = mk_cluster()
+        lead = wait_leader(engines)
+        fut = engines[lead].read_barrier(0)
+        # Cut the leader off BEFORE any ack can resolve the barrier; once
+        # it observes the new term on heal, the waiter must fail, not hang.
+        for _ in range(3 * PARAMS.timeout_max):
+            run_ticks(engines, 1, isolated=(lead,))
+            if fut.done():
+                break
+        new = wait_leader(engines, isolated=(lead,))
+        assert new != lead
+        for _ in range(3 * PARAMS.timeout_max):
+            if fut.done():
+                break
+            run_ticks(engines, 1)
+        assert fut.done() and (await fut) is False
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------- twin differentials
+
+
+# Tier-1 keeps one case per driver axis (plain, active-set, pipelined);
+# the sparse/windowed and combined cases are `slow` (tools/ci.sh full
+# runs this file unfiltered) — same budget split as test_active_set.
+@pytest.mark.parametrize("sparse,window,pipeline,active", [
+    (False, 1, False, False),
+    (False, 1, False, True),
+    (False, 1, True, False),
+    pytest.param(True, 1, False, False, marks=pytest.mark.slow),
+    pytest.param(False, 8, False, False, marks=pytest.mark.slow),
+    pytest.param(True, 1, True, True, marks=pytest.mark.slow),
+])
+def test_twin_differential_leases_vs_off(sparse, window, pipeline, active):
+    """THE observation-only pin: twin 3-node clusters — leases on vs
+    off, identical params — through the standard chaos schedule
+    (elections, proposal drizzle, a 15-tick partition, a mid-run group
+    recycle) stay bit-exact on EVERY tick: device state, mirrors,
+    chains, and byte-identical outbound wire traffic. The leased twin
+    must actually hold leases along the way — a lane that never arms
+    would pass vacuously."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+
+        def mk(leases):
+            return [RaftEngine(MemKV(), ids3, ids3[i], groups=6,
+                               fsms={0: ListFsm(), 3: ListFsm()},
+                               params=PARAMS, base_seed=i, sparse_io=sparse,
+                               active_set=active, leases=leases)
+                    for i in range(3)]
+
+        act, ref = mk(True), mk(False)
+        committed = [0, 0]
+        held_ticks = 0
+        for t in range(75):
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                e.propose(g, b"t%d-g%d" % (t, g))
+                                break
+                if t == 40:
+                    for e in cl:
+                        e.recycle_group(2)
+                        e.set_group_incarnation(2, 1)
+                for e in cl:
+                    w = e.suggest_window(window)
+                    res = e.tick_pipelined(w) if pipeline else e.tick(w)
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    if 15 <= t < 30 and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            assert [_wire_key(m) for m in outs[0]] == \
+                   [_wire_key(m) for m in outs[1]], f"outbound tick {t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"t={t} n={i}")
+            held_ticks += sum(e.lease_valid(g) for e in act for g in (0, 3))
+            await asyncio.sleep(0)
+        for cl in (act, ref):
+            for e in cl:
+                if e.pipeline_window:
+                    e.tick_drain()
+        assert committed[0] == committed[1]
+        assert committed[0] > 0, "schedule must exercise real commits"
+        assert held_ticks > 0, "the leased twin never held a lease"
+        assert all(e.lease_summary() is None for e in ref)
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_twin_differential_leases_routed_fabric():
+    """Device-resident routed delivery with leases on vs off: both twins
+    run the SAME RouteFabric configuration (so routed delivery and the
+    host residual are directly comparable) and must stay bit-exact —
+    the lease credit hook also fires on the routed intake path."""
+
+    async def main():
+        ids3 = [1, 2, 3]
+
+        def mk(leases):
+            cl = [RaftEngine(MemKV(), ids3, ids3[i], groups=6,
+                             fsms={0: ListFsm(), 3: ListFsm()},
+                             params=PARAMS, base_seed=i, leases=leases)
+                  for i in range(3)]
+            fab = RouteFabric()
+            for e in cl:
+                fab.register(e)
+            return cl, fab
+
+        act, fab_a = mk(True)
+        ref, fab_r = mk(False)
+        committed = [0, 0]
+        held_ticks = 0
+        routed = [0, 0]
+        for t in range(75):
+            cur_part = 15 <= t < 30
+            link_ok = (lambda s, d, cp=cur_part:
+                       not (cp and (s == 2 or d == 2)))
+            fab_a.link_filter = link_ok
+            fab_r.link_filter = link_ok
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                e.propose(g, b"t%d-g%d" % (t, g))
+                                break
+                if t == 40:
+                    for e in cl:
+                        e.recycle_group(2)
+                        e.set_group_incarnation(2, 1)
+                for e in cl:
+                    res = e.tick()
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    if cur_part and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            fab_a.flush()
+            fab_r.flush()
+            assert [_wire_key(m) for m in outs[0]] == \
+                   [_wire_key(m) for m in outs[1]], f"residual tick {t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"t={t} n={i}")
+            for ci, cl in enumerate((act, ref)):
+                routed[ci] = sum(e.routed_msgs for e in cl)
+            held_ticks += sum(e.lease_valid(g) for e in act for g in (0, 3))
+            await asyncio.sleep(0)
+        assert committed[0] == committed[1] and committed[0] > 0
+        assert routed[0] == routed[1]
+        assert routed[0] > 0, "schedule must exercise routed delivery"
+        assert held_ticks > 0, "the leased twin never held a lease"
+
+    asyncio.run(main())
+
+
+@pytest.mark.slow
+def test_twin_differential_leases_sharded_mesh():
+    """Leases on a 'p'-sharded two-device mesh vs the leases-off mesh
+    twin: bit-exact, and the lease lane (pure host numpy over the same
+    tick-finish evidence) arms and serves identically — the sharded
+    lease plane update must not perturb anything the step reads."""
+
+    async def main():
+        devs = jax.devices()
+        assert len(devs) >= 2, "conftest provides 8 virtual devices"
+        ids3 = [1, 2, 3]
+
+        def mk(leases):
+            mesh = Mesh(np.array(devs[:2]), ("p",))
+            return [RaftEngine(MemKV(), ids3, ids3[i], groups=6,
+                               fsms={0: ListFsm(), 3: ListFsm()},
+                               params=PARAMS, base_seed=i, mesh=mesh,
+                               leases=leases)
+                    for i in range(3)]
+
+        act, ref = mk(True), mk(False)
+        committed = [0, 0]
+        held_ticks = 0
+        for t in range(60):
+            outs = [[], []]
+            for ci, cl in enumerate((act, ref)):
+                if t % 5 == 0 and t > 10:
+                    for g in (0, 3):
+                        for e in cl:
+                            if e.is_leader(g):
+                                e.propose(g, b"t%d-g%d" % (t, g))
+                                break
+                for e in cl:
+                    res = e.tick()
+                    committed[ci] += len(res.committed)
+                    outs[ci].extend(res.outbound)
+            for ci, cl in enumerate((act, ref)):
+                for m in outs[ci]:
+                    if 15 <= t < 30 and (m.dst == 2 or m.src == 2):
+                        continue
+                    cl[m.dst].receive(m)
+            assert [_wire_key(m) for m in outs[0]] == \
+                   [_wire_key(m) for m in outs[1]], f"outbound tick {t}"
+            for i in range(3):
+                _assert_engines_equal(act[i], ref[i], f"t={t} n={i}")
+            held_ticks += sum(e.lease_valid(g) for e in act for g in (0, 3))
+            await asyncio.sleep(0)
+        assert committed[0] == committed[1] and committed[0] > 0
+        assert held_ticks > 0, "the leased twin never held a lease"
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------- chaos mode
+
+
+def test_lease_soak_rejects_skew_schedules():
+    """Lease soundness is stated for the lockstep pacer only: arming
+    leases under a pacer-skew schedule must refuse up front, not run
+    with a silently unsound invariant."""
+    from josefine_tpu.chaos.soak import run_soak
+
+    for sched in ("slow-disk", "skewed-pacer"):
+        with pytest.raises(ValueError, match="skew"):
+            run_soak(7, sched, leases=True)
+
+
+def test_lease_soak_rejects_duplicating_net():
+    """A duplicated APPEND_RESP is byte-identical to the next idle-HB
+    ack and would over-credit the evidence window — lease soaks must
+    refuse dup-bearing net profiles."""
+    from josefine_tpu.chaos.faults import NetFaults
+    from josefine_tpu.chaos.soak import run_soak
+
+    with pytest.raises(ValueError, match="dup"):
+        run_soak(7, "lease-expiry-under-partition", leases=True,
+                 net=NetFaults())
+
+
+def test_lease_mini_soak_serves_and_stays_safe():
+    """Tier-1 chaos smoke: a short leader-isolation soak with the
+    lease-safety ledger armed must finish clean, actually SERVE leased
+    reads, and log refusals from the cut-off stale leader."""
+    from josefine_tpu.chaos.nemesis import Schedule, Step
+    from josefine_tpu.chaos.soak import run_soak
+
+    sched = Schedule("lease-mini", [
+        Step(at=30, op="isolate", args={"target": "leader", "for": 30}),
+    ], horizon=110)
+    result = run_soak(7, sched, leases=True)
+    assert result["violation"] is None
+    lease = result["lease"]
+    assert lease is not None
+    assert lease["held_ticks"] > 0
+    assert lease["leased_reads"] > 0
+    assert lease["refusals"] > 0, "the isolated stale leader must refuse"
+    assert any(n["credits"] > 0 for n in lease["nodes"].values()
+               if n is not None)
+
+
+@pytest.mark.slow
+def test_lease_bundled_nemesis_deterministic():
+    """The bundled stale-read nemesis end to end, twice with the same
+    seed: clean ledger both times and byte-identical flight journals /
+    merged timeline / coverage signature — the determinism contract the
+    CI lease_chaos_smoke pins from the CLI."""
+    from josefine_tpu.chaos.soak import run_soak
+
+    a = run_soak(11, "lease-expiry-under-partition", leases=True)
+    b = run_soak(11, "lease-expiry-under-partition", leases=True)
+    for r in (a, b):
+        assert r["violation"] is None
+        assert r["lease"]["leased_reads"] > 0
+        assert r["lease"]["handovers"] >= 1, \
+            "two over-window isolations must hand the lease over"
+    assert a["journals"] == b["journals"]
+    assert a["timeline"] == b["timeline"]
+    assert a["coverage_signature"] == b["coverage_signature"]
+    assert a["state_digest"] == b["state_digest"]
+    assert a["lease"] == b["lease"]

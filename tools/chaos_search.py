@@ -85,6 +85,7 @@ def replay(path: str) -> int:
         commitless_limit=soak.get("commitless_limit"),
         flight_ring=soak.get("flight_ring"),
         migration=soak.get("migration", False),
+        leases=soak.get("leases", False),
         artifact_path=os.devnull)
     print(json.dumps({
         "repro": path,
@@ -164,6 +165,15 @@ def main() -> int:
                          "(source/target crash, partition mid-handoff, "
                          "election mid-cutover) against the "
                          "migration-state invariant")
+    ap.add_argument("--leases", action="store_true",
+                    help="lease mode: every candidate soak arms tick-"
+                         "denominated leader leases with the lease-safety "
+                         "ledger and stale-read probe, the lease-* nemeses "
+                         "join the bootstrap catalog, and the skew-bearing "
+                         "classics drop out of it (lease soundness is "
+                         "lockstep-scoped; candidate nets run dup-free) — "
+                         "the search hunts lease-overlap and stale-serve "
+                         "corners under partitions/crashes")
     ap.add_argument("--wire", action="store_true",
                     help="wire mode: candidates run through the wire "
                          "chaos soak (real Kafka connections, socket "
@@ -223,7 +233,7 @@ def main() -> int:
                             max_heal=args.max_heal),
         min_novel=args.min_novel, minimize=not args.no_minimize,
         repro_dir=repro_dir, log_path=args.log,
-        wire=args.wire, migration=args.migration,
+        wire=args.wire, migration=args.migration, leases=args.leases,
         wire_opts={"tenants": args.wire_tenants} if args.wire else None)
 
     if args.bootstrap:

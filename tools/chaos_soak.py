@@ -134,6 +134,17 @@ def main() -> int:
                          "carried prefix intact, zero acked loss) "
                          "enforced; the summary carries the coordinator's "
                          "outcome counts and pause ticks")
+    ap.add_argument("--leases", action="store_true",
+                    help="arm tick-denominated leader leases (raft.leases) "
+                         "on every engine, with the per-tick lease-safety "
+                         "ledger (non-overlap, term-qualified leader "
+                         "exclusion) and the stale-read probe (a "
+                         "partitioned ex-leader must refuse leased serves "
+                         "once its lease expires); the bundled lease-* "
+                         "schedules resolve, the net defaults to dup-free "
+                         "(duplicated acks would over-credit the lease "
+                         "evidence), skew schedules are refused, and the "
+                         "summary carries the lease block")
     ap.add_argument("--auto-faults", action="store_true",
                     help="layer random background crashes/partitions over "
                          "the schedule (hostile mode)")
@@ -166,17 +177,20 @@ def main() -> int:
     jax.config.update("jax_platforms", args.platform)
 
     from josefine_tpu.chaos.faults import NetFaults
-    from josefine_tpu.chaos.nemesis import (MIGRATION_SCHEDULES, SCHEDULES,
+    from josefine_tpu.chaos.nemesis import (LEASE_SCHEDULES,
+                                            MIGRATION_SCHEDULES, SCHEDULES,
                                             WIRE_SCHEDULES)
     from josefine_tpu.chaos.soak import run_soak
 
     if args.list:
         for name, builder in sorted(SCHEDULES.items()) \
                 + sorted(MIGRATION_SCHEDULES.items()) \
+                + sorted(LEASE_SCHEDULES.items()) \
                 + sorted(WIRE_SCHEDULES.items()):
             sched = builder(args.nodes)
             flag = (" [--wire]" if name in WIRE_SCHEDULES else
-                    " [--migration]" if name in MIGRATION_SCHEDULES else "")
+                    " [--migration]" if name in MIGRATION_SCHEDULES else
+                    " [--leases]" if name in LEASE_SCHEDULES else "")
             print(f"{name:22s} horizon={sched.horizon:4d} "
                   f"steps={len(sched.steps):2d}{flag}  "
                   f"{(builder.__doc__ or '').strip().splitlines()[0]}")
@@ -198,7 +212,8 @@ def main() -> int:
         with open(schedule[1:]) as fh:
             schedule = fh.read()
     elif schedule not in (WIRE_SCHEDULES if args.wire
-                          else {**SCHEDULES, **MIGRATION_SCHEDULES}):
+                          else {**SCHEDULES, **MIGRATION_SCHEDULES,
+                                **LEASE_SCHEDULES}):
         print(f"unknown schedule {schedule!r}; use --list, "
               f"--schedule-file PATH, or @file.json", file=sys.stderr)
         return 2
@@ -260,7 +275,8 @@ def main() -> int:
             flight_wire=args.flight_wire, workload=workload,
             artifact_path=args.artifact, flight_ring=args.flight_ring,
             commitless_limit=args.commitless_limit,
-            request_spans=args.request_spans, migration=args.migration)
+            request_spans=args.request_spans, migration=args.migration,
+            leases=args.leases)
     except ValueError as e:
         # The DSL boundary rejected the schedule (unknown op, negative at,
         # malformed args — it names the step). Usage error, not a crash.
@@ -312,6 +328,8 @@ def main() -> int:
     summary["dup_check"] = result["dup_check"]
     if result.get("migration") is not None:
         summary["migration"] = result["migration"]
+    if result.get("lease") is not None:
+        summary["lease"] = result["lease"]
     # Observability epilogue: the full registry dump (counters, gauges,
     # histograms — includes the commit-latency axis) and the tail of each
     # node's flight journal, so a soak's summary line says what the

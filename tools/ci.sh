@@ -169,6 +169,42 @@ print("migration chaos ok:", mig["migrations"], "migrations,",
 PYEOF
 }
 
+lease_chaos_smoke() {
+    # Tick-denominated leader leases under chaos (PR 18): the
+    # lease-expiry-under-partition nemesis — the lease-holding leader
+    # isolated for LONGER than its lease window, twice — with the
+    # lease-safety ledger and stale-read probe armed must finish with
+    # zero violations (non-overlap + term-qualified leader exclusion),
+    # a NONZERO leased-read count (the fast path actually served, not
+    # just stayed silent), nonzero refusals (the cut-off stale leader
+    # was probed and correctly refused), and at least one holder
+    # handover across the isolations. Two same-seed runs must produce
+    # cmp-byte-identical fault-event logs: the lease lane joins the
+    # chaos-determinism contract.
+    echo "== lease chaos smoke =="
+    rm -f /tmp/ci_lease_a.jsonl /tmp/ci_lease_b.jsonl
+    python tools/chaos_soak.py --seed 7 \
+        --schedule lease-expiry-under-partition --nodes 3 --leases \
+        --events /tmp/ci_lease_a.jsonl > /tmp/ci_lease_a.json
+    python tools/chaos_soak.py --seed 7 \
+        --schedule lease-expiry-under-partition --nodes 3 --leases \
+        --events /tmp/ci_lease_b.jsonl > /tmp/ci_lease_b.json
+    cmp /tmp/ci_lease_a.jsonl /tmp/ci_lease_b.jsonl
+    python - <<'PYEOF'
+import json
+s = json.loads(open("/tmp/ci_lease_a.json").read().strip().splitlines()[-1])
+assert s["invariants"] == "ok", s.get("violation")
+lease = s["lease"]
+assert lease["leased_reads"] > 0, lease
+assert lease["refusals"] > 0, lease
+assert lease["held_ticks"] > 0, lease
+assert lease["handovers"] >= 1, lease
+print("lease chaos ok:", lease["leased_reads"], "leased reads,",
+      lease["refusals"], "refusals,", lease["held_ticks"],
+      "held ticks,", lease["handovers"], "handovers")
+PYEOF
+}
+
 chaos_search_smoke() {
     # Coverage-guided chaos search (chaos/search.py): a few seeded
     # iterations from the COMMITTED corpus (tests/fixtures/chaos_corpus)
@@ -352,6 +388,7 @@ if [[ "${1:-}" == "quick" ]]; then
     chaos_smoke
     chaos_smoke_device_route
     migration_chaos_smoke
+    lease_chaos_smoke
     chaos_search_smoke
     wire_chaos_smoke
     traffic_smoke
@@ -403,10 +440,16 @@ else
     # the metadata reassignment FSM, the mid-pipelined-dispatch twin
     # matrix, the bundled migrate nemeses, and the product/workload e2e.
     python -m pytest tests/test_migration.py -q
+    # Leader-lease safety suite (PR 18) unfiltered: lane evidence units,
+    # engine lease lifecycle, the leases-on/off twin matrix (plain,
+    # active-set, pipelined, routed-fabric, sharded-mesh), and the
+    # bundled stale-read nemesis determinism pair.
+    python -m pytest tests/test_lease_safety.py -q
     chaos_smoke
     chaos_smoke_active_set
     chaos_smoke_device_route
     migration_chaos_smoke
+    lease_chaos_smoke
     chaos_search_smoke
     chaos_search_repros
     wire_chaos_smoke

@@ -88,6 +88,15 @@ def run_traffic(floor: dict) -> dict:
         "--seed", "7",
         "--out", out, "--no-merge",
     ]
+    if floor.get("replication"):
+        cmd += ["--replication", str(floor["replication"])]
+    if floor.get("leases"):
+        # The leased-fetch row (PR 18): replicated serve loop with the
+        # broker read gate on the lease fast path — a regression here
+        # means leased reads started paying the consensus round trip
+        # (or the lane bookkeeping itself re-grew the host share).
+        cmd += ["--leases", "--read-mode", floor.get("read_mode", "lease"),
+                "--timeout-min", str(floor.get("timeout_min", 4))]
     env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
     subprocess.run(cmd, check=True, cwd=ROOT, env=env,
                    stdout=subprocess.DEVNULL,
@@ -178,8 +187,10 @@ def run_bench(floor: dict) -> dict:
 
 def _row_name(floor: dict) -> str:
     if floor.get("traffic"):
+        mode = (f", leased {floor.get('read_mode', 'lease')} reads"
+                if floor.get("leases") else "")
         return (f"traffic {floor['tenants']}x{floor['partitions']} "
-                f"(load {floor.get('load', 16)}/tick)")
+                f"(load {floor.get('load', 16)}/tick{mode})")
     if floor.get("podsim"):
         return (f"podsim sharded P={floor['per_device'] * floor['devices']} "
                 f"({floor['devices']}-device mesh, active-set)")

@@ -71,12 +71,16 @@ DEFAULT_OUT = os.path.join(ROOT, "BENCH_traffic.json")
 
 def _row_key(r: dict) -> tuple:
     # replication/device_route/payload_ring joined the key in PR 12;
-    # request_spans in the span PR; legacy rows normalize to defaults.
+    # request_spans in the span PR; leases/read_mode/timeout_min in the
+    # lease PR (timeout_min keys too so a leases-on/off pair at matched
+    # election params sits BESIDE the legacy default-param rows);
+    # legacy rows normalize to defaults.
     return (r["tenants"], r["partitions"], float(r["skew"]),
             float(r["offered_per_tick"]), bool(r.get("active_set")),
             int(r.get("replication", 1)), bool(r.get("device_route")),
             bool(r.get("payload_ring")), bool(r.get("request_spans")),
-            int(r.get("migrate_hot", 0)))
+            int(r.get("migrate_hot", 0)), bool(r.get("leases")),
+            str(r.get("read_mode", "local")), int(r.get("timeout_min", 3)))
 
 
 def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
@@ -95,6 +99,31 @@ def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
                    "results": [merged[k] for k in sorted(merged)]},
                   f, indent=1)
         f.write("\n")
+
+
+# The write-plane slice of the workload trace: produce admission through
+# ack/retry plus topic lifecycle — everything consensus writes touch.
+# Fetch/consumer-session events are deliberately OUT: switching read
+# modes moves fetch completion ticks (that is the point), so the
+# zero-write-perturbation claim of a leases-on/off BENCH pair is stated
+# on this digest, not the full trace sha.
+_WRITE_KINDS = frozenset((
+    "topic_create", "topics_ready", "topic_ready", "topic_delete",
+    "produce", "produce_ok", "produce_err", "produce_rejected",
+    "backpressure", "dropped", "shed", "retry", "gave_up"))
+
+
+def _write_plane_sha(trace) -> str:
+    import hashlib
+    lines = []
+    for e in trace.events:
+        if e["kind"] not in _WRITE_KINDS:
+            continue
+        # The global seq renumbers when read events interleave
+        # differently; the write-plane statement is about tick+content.
+        lines.append(json.dumps({k: v for k, v in e.items() if k != "seq"},
+                                sort_keys=True, separators=(",", ":")))
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
 
 
 async def _run_driver(args, request_spans: bool):
@@ -120,7 +149,9 @@ async def _run_driver(args, request_spans: bool):
                         device_route=args.device_route,
                         payload_ring=args.payload_ring,
                         engine_groups=groups,
-                        request_spans=request_spans)
+                        request_spans=request_spans,
+                        leases=args.leases, read_mode=args.read_mode,
+                        timeout_min=args.timeout_min)
     t0 = time.perf_counter()
     await drv.start()
     t_boot = time.perf_counter() - t0
@@ -168,6 +199,9 @@ async def run_soak(args) -> dict:
         "payload_ring": bool(args.payload_ring),
         "request_spans": bool(args.request_spans),
         "migrate_hot": int(args.migrate_hot),
+        "leases": bool(args.leases),
+        "read_mode": args.read_mode,
+        "timeout_min": int(args.timeout_min),
         "route_stats": s["route_stats"],
         "window": args.window,
         "bootstrap_s": round(t_boot, 3),
@@ -181,6 +215,7 @@ async def run_soak(args) -> dict:
         "path_stats": s["path_stats"],
         "backpressure": s["backpressure"],
         "trace_sha256": s["trace_sha256"],
+        "write_trace_sha256": _write_plane_sha(drv.trace),
         "extra": {
             "engine_latency_device_ticks": s["engine_latency_device_ticks"],
             "latency_by_tenant_top": s["latency_by_tenant_top"],
@@ -192,6 +227,20 @@ async def run_soak(args) -> dict:
             "spec": s["spec"],
         },
     }
+    if args.leases:
+        # Lease epilogue: the lane summary plus the read-path counters
+        # the broker gate incremented this run (one process per soak, so
+        # the registry totals ARE this run's totals). leased > 0 with
+        # fallbacks ~= election warm-up is the fast path actually
+        # serving; mode "consensus" deliberately keeps leased at 0.
+        from josefine_tpu.raft.lease import m_reads_fallback, m_reads_leased
+        row["extra"]["lease"] = {
+            "lane": s["lease"],
+            "reads_leased": sum(m_reads_leased.values.values()),
+            "reads_fallback": {
+                dict(k).get("reason", "?"): v
+                for k, v in m_reads_fallback.values.items()},
+        }
     if args.migrate_hot:
         migs = s["migrations"]
         pauses = [m["pause_ticks"] for m in migs if "pause_ticks" in m]
@@ -290,6 +339,24 @@ def main() -> int:
                          "to a spare row under traffic, and the row "
                          "records the migration pause (dual-ownership "
                          "ticks) plus refused-and-rerouted produce counts")
+    ap.add_argument("--leases", action="store_true",
+                    help="arm tick-denominated leader leases on the "
+                         "engine (raft/lease.py): observation-only until "
+                         "--read-mode consults them; requires "
+                         "--timeout-min > hb_ticks + 2")
+    ap.add_argument("--read-mode", default="local",
+                    choices=("local", "lease", "consensus"),
+                    help="broker read-path mode (needs --leases for the "
+                         "non-local modes): 'lease' serves Fetch/Metadata "
+                         "leader-local on an unexpired lease and falls "
+                         "back to a quorum read barrier; 'consensus' "
+                         "always pays the barrier — the measured "
+                         "round-trip baseline the lease row collapses")
+    ap.add_argument("--timeout-min", type=int, default=3,
+                    help="election timeout_min in ticks (default 3, the "
+                         "classic bench params; lease rows need >= 4 — "
+                         "run the leases-OFF twin at the SAME value so "
+                         "the pair's consensus planes are byte-identical)")
     ap.add_argument("--request-spans", action="store_true",
                     help="record request-scoped phase spans (admission/"
                          "queue/consensus/apply/serve on the engine tick "
