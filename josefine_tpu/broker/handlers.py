@@ -28,6 +28,11 @@ import uuid
 
 from josefine_tpu.broker import records
 from josefine_tpu.broker import partition_fsm
+from josefine_tpu.broker.fetch_frame import (
+    RecordsSpan,
+    materialize,
+    max_bytes_bucket,
+)
 from josefine_tpu.broker.fsm import Transition, decode_result as fsm_decode_result
 from josefine_tpu.broker.groups import GroupCoordinator
 from josefine_tpu.broker.replica import ReplicaRegistry
@@ -122,6 +127,13 @@ class Broker:
         # surface (RaftClient / the workload driver's in-proc client — the
         # test shims don't, and degrade to "local").
         self._read_mode = getattr(config, "read_mode", "local")
+        # Fetch serve path (config.broker.fetch_path): "zerocopy" threads
+        # record spans as chunk lists straight to the socket writer;
+        # "legacy" keeps the seed's join + native re-encode. Both read the
+        # SAME blobs (identical budgets, shared span cache) — the
+        # differential suite in tests/test_wire_fetch.py pins the frames
+        # byte-identical.
+        self._zero_copy = getattr(config, "fetch_path", "zerocopy") != "legacy"
 
     def signal_append(self) -> None:
         """Called by the data-plane PartitionFsm after each applied batch."""
@@ -172,7 +184,8 @@ class Broker:
             if api_key == ApiKey.PRODUCE:
                 return await self.produce(api_version, body)
             if api_key == ApiKey.FETCH:
-                return await self.fetch(api_version, body)
+                return await self.fetch(api_version, body,
+                                        zero_copy=self._zero_copy)
             if api_key == ApiKey.LIST_OFFSETS:
                 return self.list_offsets(api_version, body)
             if api_key == ApiKey.JOIN_GROUP:
@@ -790,7 +803,8 @@ class Broker:
 
     # ---------------------------------------------------------------- Fetch
 
-    async def fetch(self, version: int, body: dict) -> dict:
+    async def fetch(self, version: int, body: dict, *,
+                    zero_copy: bool = False) -> dict:
         """Serve record batches from partition logs (no reference analog:
         its reader is a stub, ``src/broker/log/reader.rs:3-8``). An empty
         fetch long-polls the FULL max_wait_ms on an append-signaled event —
@@ -799,7 +813,13 @@ class Broker:
         "lease"/"consensus" every serve — including each long-poll
         re-check — first passes the per-group read gate, so a lease that
         expires mid-poll stops being served the moment it lapses (the
-        bounded-staleness contract; tests/test_lease_safety.py)."""
+        bounded-staleness contract; tests/test_lease_safety.py).
+
+        ``zero_copy=True`` (the broker server path under
+        broker.fetch_path="zerocopy") leaves each partition's records as a
+        :class:`RecordsSpan` chunk list for writev-style frame assembly;
+        the default materializes to the legacy joined ``bytes`` for
+        in-process callers (tests, the workload driver)."""
         refused = await self._refused_reads(body)
         responses = self._fetch_once(body, refused)
         max_wait_ms = body.get("max_wait_ms") or 0
@@ -821,6 +841,8 @@ class Broker:
                     refused = await self._refused_reads(body)
                     responses = self._fetch_once(body, refused)  # final re-check
                     break
+        if not zero_copy:
+            materialize(responses)
         return {"throttle_time_ms": 0, "responses": responses}
 
     def _fetch_once(self, body: dict,
@@ -844,13 +866,23 @@ class Broker:
                     parts_out.append(_fetch_err(idx, ErrorCode.OFFSET_OUT_OF_RANGE,
                                                 high_watermark=end))
                     continue
-                blobs = rep.log.read_from(offset, p.get("partition_max_bytes") or (1 << 20))
-                data = b"".join(b for _, _, b in blobs)
+                # Hot-tail span cache: N consumers at the same (offset,
+                # budget) of one hot partition share ONE log walk. The
+                # budget is the pow2 bucket (fetch_frame.max_bytes_bucket)
+                # on every path, so a cached span is exact for each
+                # request that lands in its bucket.
+                bucket = max_bytes_bucket(
+                    p.get("partition_max_bytes") or (1 << 20))
+                span = rep.fetch_cache.get(rep.log, offset, bucket)
+                if span is None:
+                    blobs = rep.log.read_from(offset, bucket)
+                    span = RecordsSpan([b for _, _, b in blobs])
+                    rep.fetch_cache.put(rep.log, offset, bucket, span)
                 parts_out.append({
                     "partition": idx, "error_code": ErrorCode.NONE,
                     "high_watermark": end, "last_stable_offset": end,
                     "log_start_offset": 0, "aborted_transactions": None,
-                    "records": data if data else None,
+                    "records": span if span else None,
                 })
             out.append({"topic": t["topic"], "partitions": parts_out})
         return out
@@ -1065,6 +1097,48 @@ class Broker:
                 topics_out.append({"name": t["name"], "partitions": parts_out})
         return {"throttle_time_ms": 0, "topics": topics_out,
                 "error_code": ErrorCode.NONE}
+
+
+def quota_refusal_body(api_key: int, body: dict | None) -> dict | None:
+    """Response body carrying the retryable THROTTLING_QUOTA_EXCEEDED code
+    for a first request refused by per-tenant accept admission
+    (broker.max_connections_per_tenant). The refused connection still gets
+    ONE well-formed response before the close, so a client with retry
+    machinery backs off and retries instead of diagnosing a dead broker.
+    Returns None for APIs with no error surface (acks=0 produce, metadata,
+    ...) — those connections close silently and reconnect logic retries."""
+    code = ErrorCode.THROTTLING_QUOTA_EXCEEDED
+    if body is None:
+        return None
+    if api_key == ApiKey.PRODUCE:
+        if not body.get("acks"):
+            return None  # acks=0: the protocol has no response slot
+        return {"throttle_time_ms": 0, "responses": [
+            {"name": t.get("name") or "", "partitions": [
+                {"index": p.get("index", 0), "error_code": code,
+                 "base_offset": -1, "log_append_time_ms": -1,
+                 "log_start_offset": -1}
+                for p in t.get("partitions") or []]}
+            for t in body.get("topics") or []]}
+    if api_key == ApiKey.FETCH:
+        return {"throttle_time_ms": 0, "responses": [
+            {"topic": t.get("topic") or "", "partitions": [
+                _fetch_err(p.get("partition", 0), code)
+                for p in t.get("partitions") or []]}
+            for t in body.get("topics") or []]}
+    if api_key == ApiKey.FIND_COORDINATOR:
+        return {"throttle_time_ms": 0, "error_code": code,
+                "error_message": "tenant connection quota exceeded",
+                "node_id": -1, "host": "", "port": -1}
+    if api_key == ApiKey.JOIN_GROUP:
+        return {"throttle_time_ms": 0, "error_code": code,
+                "generation_id": -1, "protocol_name": "", "leader": "",
+                "member_id": "", "members": []}
+    if api_key == ApiKey.SYNC_GROUP:
+        return {"throttle_time_ms": 0, "error_code": code, "assignment": b""}
+    if api_key in (ApiKey.HEARTBEAT, ApiKey.LEAVE_GROUP):
+        return {"throttle_time_ms": 0, "error_code": code}
+    return None
 
 
 def _fetch_err(idx: int, err: int, high_watermark: int = -1) -> dict:

@@ -43,6 +43,11 @@ class Log:
         self._max_segment_bytes = max_segment_bytes
         self._index_bytes = index_bytes
         self._io_hook = io_hook
+        #: Bumped on every destructive reset (wipe / snapshot restore):
+        #: half of the fetch span-cache key, so cached hot-tail spans can
+        #: never survive a log whose history was rewritten underneath them
+        #: (append-only growth is covered by the next_offset check).
+        self.incarnation = 0
         self._open()
 
     def _open(self) -> None:
@@ -69,7 +74,11 @@ class Log:
         return self._log.read(offset)
 
     def read_from(self, offset: int, max_bytes: int = 1 << 20):
-        """Blobs from ``offset`` onward, up to ``max_bytes`` of payload."""
+        """Blobs from ``offset`` onward, up to ``max_bytes`` of payload.
+        The first blob is ALWAYS returned even when it alone exceeds
+        ``max_bytes`` (Kafka KIP-74: an oversized batch must not wedge the
+        consumer); subsequent blobs stop before crossing the budget —
+        identical semantics to :meth:`MemLog.read_from`."""
         return self._log.read_from(offset, max_bytes)
 
     def next_offset(self) -> int:
@@ -86,6 +95,7 @@ class Log:
         for f in os.listdir(self._dir):
             if f.endswith(".log") or f.endswith(".index"):
                 os.remove(os.path.join(self._dir, f))
+        self.incarnation += 1
         self._open()
 
     def flush(self) -> None:
@@ -115,6 +125,8 @@ class MemLog:
         self._blobs: list[tuple[int, int, bytes]] = []
         self._bases: list[int] = []
         self._next = 0
+        #: See Log.incarnation — same span-cache invalidation contract.
+        self.incarnation = 0
 
     def append(self, data: bytes, count: int = 1) -> int:
         if count < 1:
@@ -158,6 +170,7 @@ class MemLog:
         self._blobs = []
         self._bases = []
         self._next = 0
+        self.incarnation += 1
 
     def flush(self) -> None:
         pass

@@ -13,6 +13,7 @@ import os
 import shutil
 import uuid
 
+from josefine_tpu.broker.fetch_frame import FetchSpanCache
 from josefine_tpu.broker.log import Log, MemLog
 from josefine_tpu.broker.state import Partition
 
@@ -21,6 +22,11 @@ class Replica:
     def __init__(self, data_dir: str | os.PathLike, partition: Partition,
                  in_memory: bool = False):
         self.partition = partition
+        # Hot-tail fetch span cache: lives on the Replica so recycle and
+        # migration (which re-create the Replica) drop it wholesale; within
+        # one Replica lifetime, entries self-invalidate on append (the
+        # next_offset check) and wipe/truncate (the log incarnation).
+        self.fetch_cache = FetchSpanCache()
         if in_memory:
             # Workload scale driver: 10k+ partitions in one process — no
             # per-partition directory or index mmap (see log.MemLog).

@@ -32,7 +32,8 @@ from __future__ import annotations
 
 import asyncio
 
-from josefine_tpu.broker.handlers import Broker
+from josefine_tpu.broker import fetch_frame
+from josefine_tpu.broker.handlers import Broker, quota_refusal_body
 from josefine_tpu.broker.state import Store
 from josefine_tpu.config import BrokerConfig
 from josefine_tpu.kafka import codec
@@ -66,6 +67,12 @@ _EOF = object()
 _CONCURRENT_APIS = frozenset((
     int(codec.ApiKey.JOIN_GROUP), int(codec.ApiKey.SYNC_GROUP),
 ))
+
+
+def _tenant_of(client_id: str) -> str:
+    """Tenant key for per-tenant admission: the client_id prefix up to the
+    first ':' (ids without one are their own tenant)."""
+    return client_id.split(":", 1)[0]
 
 
 def _api_kind(api_key: int) -> str:
@@ -122,6 +129,7 @@ class JosefineBroker:
         self._conn_tasks: set[asyncio.Task] = set()
         self._active = 0
         self._by_client: dict[str, int] = {}
+        self._by_tenant: dict[str, int] = {}
         self.bound_addr: tuple[str, int] | None = None
 
     async def start(self, sock=None) -> None:
@@ -233,10 +241,21 @@ class JosefineBroker:
                     rec.finish(span, status="no_response")
                 return None  # acks=0 produce
             api_version = req["api_version"] if req["body"] is not None else 0
-            resp = codec.encode_response(
-                req["api_key"], api_version, req["correlation_id"], body
-            )
-            frame = codec.frame(resp)
+            if (req["api_key"] == int(codec.ApiKey.FETCH)
+                    and fetch_frame.body_has_spans(body)):
+                # Zero-copy serve path (broker.fetch_path="zerocopy"): the
+                # response frame is a chunk list — header scratch buffers
+                # plus the log's record spans by reference — handed to the
+                # writer for writev-style output. Joined, it is
+                # byte-identical to the legacy encode below
+                # (tests/test_wire_fetch.py pins this differentially).
+                frame = fetch_frame.encode_fetch_frame(
+                    api_version, req["correlation_id"], body)
+            else:
+                resp = codec.encode_response(
+                    req["api_key"], api_version, req["correlation_id"], body
+                )
+                frame = codec.frame(resp)
             if span is not None:
                 # Serve closes here — the frame is handed to the ordered
                 # writer. Failure/cancellation paths close through the
@@ -259,7 +278,16 @@ class JosefineBroker:
                         continue
                     if payload is _EOF:
                         raise _CloseConn()
-                    writer.write(payload)
+                    if type(payload) is list:
+                        # Zero-copy fetch frame: chunks written back to
+                        # back (asyncio buffers them without copying),
+                        # ONE drain — the wire bytes and the chaos
+                        # plane's tear/fate draw (which keys on drained
+                        # writes) are identical to a single joined write.
+                        for chunk in payload:
+                            writer.write(chunk)
+                    else:
+                        writer.write(payload)
                     if cfg.conn_write_timeout_s:
                         try:
                             await asyncio.wait_for(writer.drain(),
@@ -319,8 +347,39 @@ class JosefineBroker:
                             "holds %d connections", peer, client_key, per)
                         client_key = None
                         break
+                    tper = cfg.max_connections_per_tenant
+                    tenant = _tenant_of(client_key)
+                    if tper and self._by_tenant.get(tenant, 0) >= tper:
+                        # Per-tenant token budget exhausted: answer the
+                        # first request with the retryable
+                        # THROTTLING_QUOTA_EXCEEDED code (when its API has
+                        # an error surface), then close. One hot tenant
+                        # burns only its own tokens — the global accept
+                        # path and every other tenant's budget are
+                        # untouched.
+                        _m_refused.inc(reason="tenant_quota")
+                        log.warning(
+                            "refusing connection from %s: tenant %r already "
+                            "holds %d connections", peer, tenant, tper)
+                        rbody = quota_refusal_body(req["api_key"],
+                                                   req["body"])
+                        if rbody is not None:
+                            ver = (req["api_version"]
+                                   if req["body"] is not None else 0)
+                            writer.write(codec.frame(codec.encode_response(
+                                req["api_key"], ver,
+                                req["correlation_id"], rbody)))
+                            try:
+                                await writer.drain()
+                            except (ConnectionError, OSError):
+                                pass
+                        client_key = None
+                        break
                     self._by_client[client_key] = \
                         self._by_client.get(client_key, 0) + 1
+                    if tper:
+                        self._by_tenant[tenant] = \
+                            self._by_tenant.get(tenant, 0) + 1
                 span = None
                 if rec is not None:
                     # Wire-path trace context: minted at FRAME DECODE, so
@@ -392,6 +451,13 @@ class JosefineBroker:
                     self._by_client.pop(client_key, None)
                 else:
                     self._by_client[client_key] = n
+                if cfg.max_connections_per_tenant:
+                    tenant = _tenant_of(client_key)
+                    n = self._by_tenant.get(tenant, 1) - 1
+                    if n <= 0:
+                        self._by_tenant.pop(tenant, None)
+                    else:
+                        self._by_tenant[tenant] = n
             self._set_active(-1)
             writer.close()
             try:

@@ -115,7 +115,8 @@ class WireCluster:
 
     def __init__(self, n_nodes: int, partitions: int, tmpdir: str,
                  plane: FaultPlane, pacer, tick_ms: int = 20,
-                 request_spans: bool = False):
+                 request_spans: bool = False, leases: bool = False,
+                 broker_overrides: dict | None = None):
         from josefine_tpu.config import (
             BrokerConfig,
             EngineConfig,
@@ -129,6 +130,15 @@ class WireCluster:
         broker_socks, self.broker_ports = bound_sockets(n_nodes)
         self.plane = plane
         self.nodes = []
+        # The lease lane requires election_timeout_min > heartbeat + 2
+        # ticks (RaftConfig.validate's non-overlap arithmetic); the soak's
+        # seed timing (3 ticks min over a 1-tick heartbeat) sits exactly
+        # on that boundary, so lease-enabled clusters (the wire load rig's
+        # read_mode axis) stretch the election window. Non-lease clusters
+        # keep the seed timing — the wire chaos smoke's fate sequences are
+        # functions of it.
+        et_min = 6 * tick_ms if leases else 3 * tick_ms
+        et_max = 12 * tick_ms if leases else 8 * tick_ms
         for i in range(n_nodes):
             node_id = i + 1
             peers = [NodeAddr(id=j + 1, ip="127.0.0.1", port=raft_ports[j])
@@ -138,8 +148,9 @@ class WireCluster:
                                 port=raft_ports[i], nodes=peers,
                                 tick_ms=tick_ms,
                                 heartbeat_timeout_ms=tick_ms,
-                                election_timeout_min_ms=3 * tick_ms,
-                                election_timeout_max_ms=8 * tick_ms,
+                                election_timeout_min_ms=et_min,
+                                election_timeout_max_ms=et_max,
+                                leases=leases,
                                 # Wire-path request spans: each broker
                                 # mints a trace context per decoded frame
                                 # (utils/spans.py, Node wiring).
@@ -151,7 +162,8 @@ class WireCluster:
                                     state_file=os.path.join(
                                         tmpdir, f"node-{node_id}/state.db"),
                                     data_directory=os.path.join(
-                                        tmpdir, f"node-{node_id}/data")),
+                                        tmpdir, f"node-{node_id}/data"),
+                                    **(broker_overrides or {})),
                 engine=EngineConfig(partitions=partitions),
             )
             self.nodes.append(Node(

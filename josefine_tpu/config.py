@@ -279,6 +279,17 @@ class BrokerConfig:
     # wire soaks exercise the mechanism per connection, not per tenant.
     # None/0 = uncapped.
     max_connections_per_client: int | None = None
+    # Per-TENANT accept-time token budget (the ROADMAP's per-tenant accept
+    # admission). The tenant is the client_id prefix up to the first ':'
+    # (the rig and production clients present "tenant:conn" ids; an id
+    # with no ':' is its own tenant — which makes this a strict
+    # generalization of the per-client cap). Each live connection holds
+    # one token; a connection arriving with the budget exhausted gets ONE
+    # response carrying the retryable THROTTLING_QUOTA_EXCEEDED code
+    # (where its first request's API has an error surface), then a close,
+    # and broker_conn_refused_total{reason="tenant_quota"} increments.
+    # None/0 = uncapped.
+    max_connections_per_tenant: int | None = None
     # Frame-body read deadline (seconds): once a frame HEADER arrived, the
     # body must follow within this bound or the connection is closed — a
     # torn frame whose tail never comes must not pin buffers forever.
@@ -314,6 +325,13 @@ class BrokerConfig:
     # round-trip) — the baseline the lease row in BENCH_traffic.json is
     # measured against.
     read_mode: str = "local"
+    # Fetch serve path (ARCHITECTURE.md "The wire serving plane"):
+    # "zerocopy" (default) assembles fetch response frames as chunk lists
+    # spliced straight from the log's stable buffers — no join, no native
+    # re-encode, no frame copy — plus the per-partition hot-tail span
+    # cache; "legacy" keeps the seed's join + full re-encode path (the
+    # before-row in BENCH_wire.json and the differential-test reference).
+    fetch_path: str = "zerocopy"
 
     def validate(self) -> None:
         if self.id == 0:
@@ -332,6 +350,10 @@ class BrokerConfig:
             raise ValueError(
                 f"broker.read_mode must be 'local', 'lease' or "
                 f"'consensus', got {self.read_mode!r}")
+        if self.fetch_path not in ("zerocopy", "legacy"):
+            raise ValueError(
+                f"broker.fetch_path must be 'zerocopy' or 'legacy', "
+                f"got {self.fetch_path!r}")
 
 
 @dataclass

@@ -498,7 +498,10 @@ PyObject* Log_read(PyLog* self, PyObject* args) {
 }
 
 // List of (base_offset, count, payload) blobs from `offset`, up to max_bytes
-// of payload.
+// of payload. Kafka max_bytes contract (KIP-74), matching MemLog.read_from:
+// a blob that would push the running total PAST max_bytes is excluded —
+// unless it is the FIRST blob, which is always returned so an oversized
+// batch can never wedge a consumer at a fixed offset.
 PyObject* Log_read_from(PyLog* self, PyObject* args) {
   unsigned long long off;
   unsigned long long max_bytes = 1 << 20;
@@ -513,6 +516,10 @@ PyObject* Log_read_from(PyLog* self, PyObject* args) {
     int rc = read_blob(self->impl, cur, &base, &count, &payload);
     if (rc < 0) { Py_DECREF(out); return nullptr; }
     if (rc == 0) break;
+    if (total && total + (uint64_t)PyBytes_GET_SIZE(payload) > max_bytes) {
+      Py_DECREF(payload);
+      break;
+    }
     total += PyBytes_GET_SIZE(payload);
     PyObject* one = Py_BuildValue("(KIN)", (unsigned long long)base, count, payload);
     if (!one || PyList_Append(out, one) < 0) {

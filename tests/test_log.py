@@ -44,11 +44,26 @@ def test_segment_roll_and_read_across_segments(tmp_path):
 
 
 def test_read_from_respects_max_bytes(tmp_path):
+    # Kafka max_bytes semantics (KIP-74), identical to MemLog: stop BEFORE
+    # a blob would cross the budget (100 + 100 = 200 fits, +100 = 300 does
+    # not), never return a truncated or over-budget multi-blob span.
     lg = Log(tmp_path)
     for i in range(10):
         lg.append(b"x" * 100)
     rows = lg.read_from(0, max_bytes=250)
-    assert len(rows) == 3  # stops once the budget is crossed
+    assert len(rows) == 2
+
+
+def test_read_from_returns_oversized_first_blob(tmp_path):
+    # ... except the FIRST blob, which is always served even when it alone
+    # exceeds max_bytes — an oversized batch must not wedge the consumer
+    # at a fixed offset (the server-side half of the PR 10 client fix).
+    lg = Log(tmp_path)
+    lg.append(b"y" * 400)
+    lg.append(b"z" * 400)
+    rows = lg.read_from(0, max_bytes=100)
+    assert [r[0] for r in rows] == [0]
+    assert rows[0][2] == b"y" * 400
 
 
 def test_recovery_after_reopen(tmp_path):

@@ -1,0 +1,506 @@
+"""The wire serving plane: zero-copy fetch differential + regression suite.
+
+Pins the zero-copy serve path (broker/fetch_frame.py, the PR's tentpole)
+against the legacy encoder byte for byte:
+
+* :func:`encode_fetch_frame` chunk lists joined == native
+  ``codec.frame(codec.encode_response(FETCH, ...))`` across versions,
+  shapes (errors, null records, aborted txns), and records
+  representations (bytes vs multi-chunk spans);
+* broker-level differential over BOTH log backends (native seglog and
+  MemLog), including spans crossing segment boundaries and a mid-fetch
+  append (the snapshot a span captured stays self-consistent);
+* hot-tail span cache semantics: shared across consumers, invalidated by
+  append (next_offset), wipe/truncate (log incarnation), and
+  recycle/migration (Replica replacement);
+* Kafka max_bytes contract on both backends: at least one batch always,
+  never a partial budget overrun past the first;
+* torn-frame wire fates: a chunked (writev-style) frame drains to the
+  SAME bytes, tear pieces, and fate journal as the legacy single write;
+* per-tenant accept admission: over-budget connections get the retryable
+  THROTTLING_QUOTA_EXCEEDED response, other tenants are unaffected.
+"""
+
+import asyncio
+import copy
+
+import pytest
+
+from josefine_tpu.broker import records
+from josefine_tpu.broker.fetch_frame import (
+    FetchSpanCache,
+    RecordsSpan,
+    body_has_spans,
+    encode_fetch_frame,
+    materialize,
+    max_bytes_bucket,
+)
+from josefine_tpu.broker.fsm import JosefineFsm
+from josefine_tpu.broker.handlers import Broker, quota_refusal_body
+from josefine_tpu.broker.log import Log, MemLog
+from josefine_tpu.broker.replica import ReplicaRegistry
+from josefine_tpu.broker.state import Broker as BrokerInfo
+from josefine_tpu.broker.state import Store
+from josefine_tpu.config import BrokerConfig
+from josefine_tpu.kafka import codec
+from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+from josefine_tpu.utils.kv import MemKV
+
+# ------------------------------------------------------------ helpers
+
+
+def legacy_frame(version: int, corr: int, body: dict) -> bytes:
+    """The seed serve path: native re-encode + frame copy."""
+    return codec.frame(
+        codec.encode_response(int(ApiKey.FETCH), version, corr, body))
+
+
+def chunked_frame(version: int, corr: int, body: dict) -> bytes:
+    chunks = encode_fetch_frame(version, corr, body)
+    assert all(isinstance(c, (bytes, bytearray, memoryview)) for c in chunks)
+    return b"".join(bytes(c) for c in chunks)
+
+
+def fetch_body(*topic_parts) -> dict:
+    return {"throttle_time_ms": 0, "responses": list(topic_parts)}
+
+
+def part(idx, err=ErrorCode.NONE, hwm=0, records_=None, txns=None):
+    return {"partition": idx, "error_code": err, "high_watermark": hwm,
+            "last_stable_offset": hwm, "log_start_offset": 0,
+            "aborted_transactions": txns, "records": records_}
+
+
+class InstantRaftClient:
+    """Proposals commit immediately through the FSM (single-node script —
+    the test_broker_handlers pattern)."""
+
+    def __init__(self, store: Store):
+        self.fsm = JosefineFsm(store)
+
+    async def propose(self, payload: bytes, group: int = 0,
+                      timeout: float = 5.0) -> bytes:
+        return self.fsm.transition(payload)
+
+    def in_sync_ids_map(self, groups) -> dict:
+        return {}
+
+
+def make_broker(tmp_path, in_memory=False, **cfg_kw) -> Broker:
+    store = Store(MemKV())
+    cfg = BrokerConfig(id=1, ip="127.0.0.1", port=8844,
+                       data_directory=str(tmp_path), **cfg_kw)
+    b = Broker(cfg, store, InstantRaftClient(store))
+    if in_memory:
+        b.replicas = ReplicaRegistry(str(tmp_path), in_memory=True)
+    store.ensure_broker(BrokerInfo(id=1, ip="127.0.0.1", port=8844))
+    return b
+
+
+async def create_topic(broker, name="events", partitions=1):
+    resp = await broker.create_topics(1, {
+        "topics": [{"name": name, "num_partitions": partitions,
+                    "replication_factor": 1, "assignments": [],
+                    "configs": []}],
+        "timeout_ms": 5000, "validate_only": False,
+    })
+    assert resp["topics"][0]["error_code"] == ErrorCode.NONE
+
+
+async def produce(broker, payload: bytes, n=2, topic="events", idx=0):
+    resp = await broker.produce(3, {
+        "acks": -1, "timeout_ms": 1000,
+        "topics": [{"name": topic, "partitions": [
+            {"index": idx, "records": records.build_batch(payload, n)}]}],
+    })
+    p0 = resp["responses"][0]["partitions"][0]
+    assert p0["error_code"] == ErrorCode.NONE
+    return p0["base_offset"]
+
+
+def fetch_req(offset=0, topic="events", idx=0, max_bytes=1 << 20):
+    return {"replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+            "topics": [{"topic": topic, "partitions": [
+                {"partition": idx, "fetch_offset": offset,
+                 "partition_max_bytes": max_bytes}]}]}
+
+
+# ------------------------------------------- chunked encoder differential
+
+
+@pytest.mark.parametrize("version", [4, 5, 6])
+def test_encoder_differential_shapes(version):
+    """Joined chunk lists byte-identical to the native encoder across the
+    response shapes the handler emits (and a few it could)."""
+    span = RecordsSpan([b"alpha-", b"beta--", b"g" * 100])
+    bodies = [
+        fetch_body(),  # no topics
+        fetch_body({"topic": "t", "partitions": [part(0)]}),  # null records
+        fetch_body({"topic": "t", "partitions": [
+            part(0, hwm=5, records_=b"rawbatchbytes")]}),
+        fetch_body({"topic": "t", "partitions": [
+            part(0, hwm=7, records_=span)]}),
+        fetch_body(  # error partition, the _fetch_err shape
+            {"topic": "t", "partitions": [
+                {"partition": 3, "error_code": ErrorCode.OFFSET_OUT_OF_RANGE,
+                 "high_watermark": -1, "last_stable_offset": -1,
+                 "log_start_offset": -1, "aborted_transactions": None,
+                 "records": None}]}),
+        fetch_body(  # multi-topic, multi-partition, mixed
+            {"topic": "aa", "partitions": [
+                part(0, hwm=2, records_=b"x" * 7),
+                part(1, hwm=9, records_=RecordsSpan([b"pq", b"r"]))]},
+            {"topic": "bb", "partitions": [part(2)]}),
+        fetch_body({"topic": "t", "partitions": [  # aborted txns present
+            part(0, hwm=4, records_=b"zz",
+                 txns=[{"producer_id": 9, "first_offset": 1}])]}),
+    ]
+    for body in bodies:
+        mat = materialize(copy.deepcopy(body)["responses"])
+        legacy = legacy_frame(version, 77, {"throttle_time_ms": 0,
+                                            "responses": mat})
+        assert chunked_frame(version, 77, body) == legacy, body
+
+
+def test_records_span_surface():
+    span = RecordsSpan([b"ab", b"", b"cde"])
+    assert len(span) == 5 and bool(span)
+    assert span.join() == b"abcde"
+    single = RecordsSpan([b"only"])
+    assert single.join() is single.chunks[0]  # no-copy materialize
+    assert not RecordsSpan([])
+    assert body_has_spans(fetch_body(
+        {"topic": "t", "partitions": [part(0, records_=span)]}))
+    assert not body_has_spans(fetch_body(
+        {"topic": "t", "partitions": [part(0, records_=b"bytes")]}))
+
+
+def test_max_bytes_bucket():
+    assert max_bytes_bucket(1 << 20) == 1 << 20  # pow2 fixed points
+    assert max_bytes_bucket(1024) == 1024
+    assert max_bytes_bucket(1000) == 1024
+    assert max_bytes_bucket(1025) == 2048
+    assert max_bytes_bucket(0) == 1 << 20  # degenerate → default
+
+
+# -------------------------------------------- broker-level differential
+
+
+@pytest.mark.parametrize("in_memory", [False, True],
+                         ids=["seglog", "memlog"])
+@pytest.mark.asyncio
+async def test_zero_copy_serve_differential(tmp_path, in_memory):
+    """End to end over the real handler, both log backends: the zero-copy
+    body encodes byte-identically to the legacy body, and materialized
+    records equal the spans joined."""
+    b = make_broker(tmp_path, in_memory=in_memory)
+    await create_topic(b)
+    for i in range(6):
+        await produce(b, b"payload-%d" % i, n=2)
+
+    zc = await b.fetch(4, fetch_req(), zero_copy=True)
+    legacy = await b.fetch(4, fetch_req(), zero_copy=False)
+    span = zc["responses"][0]["partitions"][0]["records"]
+    assert isinstance(span, RecordsSpan) and len(span.chunks) == 6
+    data = legacy["responses"][0]["partitions"][0]["records"]
+    assert isinstance(data, bytes) and data == span.join()
+    assert chunked_frame(4, 1, zc) == legacy_frame(4, 1, legacy)
+
+
+@pytest.mark.asyncio
+async def test_differential_across_segment_boundary(tmp_path):
+    """Spans whose blobs straddle native segment rolls still splice into a
+    byte-identical frame (each blob is one chunk; segment boundaries are
+    invisible in the output)."""
+    lg = Log(tmp_path / "seg", max_segment_bytes=256, index_bytes=16 + 16 * 4)
+    payloads = [bytes([i]) * (40 + i * 7) for i in range(12)]
+    for p in payloads:
+        lg.append(p, count=1)
+    assert lg.segment_count() > 1
+    blobs = lg.read_from(0, 1 << 20)
+    span = RecordsSpan([b for _, _, b in blobs])
+    assert span.join() == b"".join(payloads)
+    body = fetch_body({"topic": "t", "partitions": [
+        part(0, hwm=12, records_=span)]})
+    mat = materialize(copy.deepcopy(body)["responses"])
+    assert chunked_frame(6, 5, body) == legacy_frame(
+        6, 5, {"throttle_time_ms": 0, "responses": mat})
+    lg.close()
+
+
+@pytest.mark.asyncio
+async def test_mid_fetch_append_snapshot(tmp_path):
+    """A span captured before an append stays a consistent snapshot — the
+    appended batch never leaks into it — and the next fetch sees the new
+    tail (the cache's next_offset check invalidated the entry)."""
+    b = make_broker(tmp_path)
+    await create_topic(b)
+    await produce(b, b"before", n=2)
+    zc = await b.fetch(4, fetch_req(), zero_copy=True)
+    old_span = zc["responses"][0]["partitions"][0]["records"]
+    old_bytes = old_span.join()
+
+    await produce(b, b"after-the-read", n=2)
+    assert old_span.join() == old_bytes  # snapshot unperturbed
+    assert b"after-the-read" not in old_bytes
+
+    zc2 = await b.fetch(4, fetch_req(), zero_copy=True)
+    new_span = zc2["responses"][0]["partitions"][0]["records"]
+    assert b"after-the-read" in new_span.join()
+    assert zc2["responses"][0]["partitions"][0]["high_watermark"] == 4
+
+
+# ------------------------------------------------- span cache semantics
+
+
+@pytest.mark.asyncio
+async def test_cache_shared_across_consumers(tmp_path):
+    """N fetches at the same (offset, bucket) share ONE log walk: the
+    second serve returns the SAME span object from the cache."""
+    b = make_broker(tmp_path)
+    await create_topic(b)
+    await produce(b, b"hot", n=2)
+    rep = b.replicas.get("events", 0)
+    s1 = (await b.fetch(4, fetch_req(), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    hits0 = rep.fetch_cache.hits
+    s2 = (await b.fetch(4, fetch_req(), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    assert s2 is s1
+    assert rep.fetch_cache.hits == hits0 + 1
+    # A different max_bytes BUCKET is a different entry...
+    s3 = (await b.fetch(4, fetch_req(max_bytes=512), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    assert s3 is not s1
+    # ...but same-bucket values collapse (1000 and 512 → bucket 1024/512).
+    s4 = (await b.fetch(4, fetch_req(max_bytes=500), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    assert s4 is s3
+
+
+@pytest.mark.asyncio
+async def test_cache_invalidation_matrix(tmp_path):
+    """Append, wipe (truncate/restore), and recycle/migration (Replica
+    replacement) each invalidate cached spans."""
+    b = make_broker(tmp_path)
+    await create_topic(b)
+    await produce(b, b"one", n=1)
+    rep = b.replicas.get("events", 0)
+
+    s1 = (await b.fetch(4, fetch_req(), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    # Append: next_offset moved → stale entry dropped, fresh span served.
+    await produce(b, b"two", n=1)
+    s2 = (await b.fetch(4, fetch_req(), zero_copy=True)
+          )["responses"][0]["partitions"][0]["records"]
+    assert s2 is not s1 and b"two" in s2.join()
+
+    # Wipe (snapshot restore / truncation): incarnation bump → old keys
+    # unreachable even though next_offset may collide after re-appends.
+    inc0 = rep.log.incarnation
+    rep.log.wipe()
+    assert rep.log.incarnation == inc0 + 1
+    empty = (await b.fetch(4, fetch_req(), zero_copy=True)
+             )["responses"][0]["partitions"][0]
+    assert empty["records"] is None and empty["high_watermark"] == 0
+
+    # Recycle/migration replace the Replica — and with it the cache.
+    cache_before = rep.fetch_cache
+    b.replicas.release_topic("events")
+    rep2 = b.replicas.ensure(rep.partition)
+    assert rep2.fetch_cache is not cache_before
+    assert len(rep2.fetch_cache._entries) == 0
+
+
+def test_cache_lru_bound():
+    cache = FetchSpanCache(cap=2)
+    log = MemLog()
+    log.append(b"x" * 10)
+    for off in range(3):
+        cache.put(log, off, 1024, RecordsSpan([b"s%d" % off]))
+    assert len(cache._entries) == 2  # oldest evicted
+    assert cache.get(log, 0, 1024) is None
+
+
+# ------------------------------------------------ max_bytes Kafka audit
+
+
+def test_memlog_seglog_max_bytes_parity(tmp_path):
+    """Same appends, same budgets → identical blob lists from MemLog and
+    the native seglog, including the oversized-first-blob case (the
+    server half of the Kafka KIP-74 contract; the seglog-only pins live
+    in test_log.py)."""
+    mem, nat = MemLog(), Log(tmp_path / "p")
+    sizes = [100, 100, 100, 400, 30]
+    for i, n in enumerate(sizes):
+        blob = bytes([i]) * n
+        mem.append(blob, count=2)
+        nat.append(blob, count=2)
+    for off, budget in [(0, 250), (0, 100), (0, 1 << 20), (6, 64),
+                        (6, 500), (8, 10), (4, 130)]:
+        assert mem.read_from(off, budget) == nat.read_from(off, budget), \
+            (off, budget)
+    # At least one batch, always — even when the first blob alone busts
+    # the budget; and never a second blob past it.
+    rows = nat.read_from(6, 64)  # offset 6 → the 400-byte blob
+    assert len(rows) == 1 and len(rows[0][2]) == 400
+    nat.close()
+
+
+@pytest.mark.asyncio
+async def test_fetch_serves_oversized_first_batch(tmp_path):
+    """Server-side pin: a fetch whose partition_max_bytes is smaller than
+    the first batch still gets that batch (not an empty long-poll)."""
+    b = make_broker(tmp_path)
+    await create_topic(b)
+    await produce(b, b"Z" * 2048, n=1)
+    resp = await b.fetch(4, fetch_req(max_bytes=64))
+    p0 = resp["responses"][0]["partitions"][0]
+    assert p0["error_code"] == ErrorCode.NONE
+    assert p0["records"] is not None and len(p0["records"]) > 2048
+
+
+# ------------------------------------------------- torn-frame wire fates
+
+
+@pytest.mark.asyncio
+async def test_chunked_writes_tear_identically():
+    """The chaos plane tears DRAINED buffers keyed on the per-drain write
+    index, so a frame written as N chunks + one drain must produce the
+    same wire bytes, tear pieces, and fate journal as one joined write —
+    zero-copy output is invisible to the fault model."""
+    from josefine_tpu.chaos.wire import WirePlane
+
+    class SinkWriter:
+        def __init__(self):
+            self.pieces = []
+
+        def write(self, data):
+            self.pieces.append(bytes(data))
+
+        async def drain(self):
+            pass
+
+    frame_chunks = encode_fetch_frame(4, 9, fetch_body(
+        {"topic": "t", "partitions": [
+            part(0, hwm=3, records_=RecordsSpan([b"r1" * 40, b"r2" * 33]))]}))
+    joined = b"".join(bytes(c) for c in frame_chunks)
+
+    outs = []
+    for mode in ("joined", "chunked"):
+        plane = WirePlane(seed=1234)
+        plane.arm("torn_frames", role="any", p=1.0, until=10)
+        sink = SinkWriter()
+        _, fw = plane.client_wrap("diff")( None, sink)
+        if mode == "joined":
+            fw.write(joined)
+        else:
+            for c in frame_chunks:
+                fw.write(c)
+        await fw.drain()
+        outs.append((sink.pieces, plane.event_log_jsonl()))
+    assert outs[0] == outs[1]
+    assert b"".join(outs[0][0]) == joined
+    assert len(outs[0][0]) > 1  # the tear actually fired
+
+
+# --------------------------------------------- per-tenant accept admission
+
+
+def test_quota_refusal_bodies_encode():
+    """Every refusal body the admission path can emit must survive the
+    native encoder for its API (a refusal that cannot encode would crash
+    the connection task instead of answering the client)."""
+    cases = [
+        (ApiKey.PRODUCE, 3, {"acks": -1, "topics": [
+            {"name": "t", "partitions": [{"index": 0, "records": b"x"}]}]}),
+        (ApiKey.FETCH, 4, fetch_req()),
+        (ApiKey.FIND_COORDINATOR, 1, {"key": "g", "key_type": 0}),
+        (ApiKey.JOIN_GROUP, 2, {"group_id": "g"}),
+        (ApiKey.SYNC_GROUP, 1, {"group_id": "g"}),
+        (ApiKey.HEARTBEAT, 1, {"group_id": "g"}),
+        (ApiKey.LEAVE_GROUP, 1, {"group_id": "g"}),
+    ]
+    for api, ver, req in cases:
+        body = quota_refusal_body(int(api), req)
+        assert body is not None, api
+        assert codec.encode_response(int(api), ver, 1, body), api
+    # No error surface → silent close paths.
+    assert quota_refusal_body(int(ApiKey.PRODUCE),
+                              {"acks": 0, "topics": []}) is None
+    assert quota_refusal_body(int(ApiKey.METADATA), {"topics": None}) is None
+    assert quota_refusal_body(int(ApiKey.PRODUCE), None) is None
+
+
+@pytest.mark.asyncio
+async def test_tenant_quota_over_wire(tmp_path):
+    """Real sockets: tenant A's second connection is refused with the
+    retryable THROTTLING_QUOTA_EXCEEDED code and closed; tenant B still
+    connects and round-trips. One hot tenant burns only its own tokens."""
+    from josefine_tpu.broker.server import JosefineBroker
+    from josefine_tpu.kafka import client as kafka_client
+    from josefine_tpu.utils.net import bound_sockets
+
+    store = Store(MemKV())
+    socks, ports = bound_sockets(1)
+    cfg = BrokerConfig(id=1, ip="127.0.0.1", port=ports[0],
+                       data_directory=str(tmp_path),
+                       max_connections_per_tenant=1)
+    srv = JosefineBroker(cfg, store, InstantRaftClient(store))
+    store.ensure_broker(BrokerInfo(id=1, ip="127.0.0.1", port=ports[0]))
+    await srv.start(sock=socks[0])
+    clients = []
+
+    async def conn(client_id):
+        cl = await kafka_client.connect("127.0.0.1", ports[0],
+                                        client_id=client_id)
+        clients.append(cl)
+        return cl
+
+    try:
+        a1 = await conn("tA:c1")
+        await asyncio.wait_for(a1.send(ApiKey.CREATE_TOPICS, 1, {
+            "topics": [{"name": "q", "num_partitions": 1,
+                        "replication_factor": 1, "assignments": [],
+                        "configs": []}],
+            "timeout_ms": 5000, "validate_only": False}), 10)
+
+        # Tenant A's budget (1 token) is held by a1: a2's first request is
+        # answered with the retryable code, then the connection closes.
+        a2 = await conn("tA:c2")
+        resp = await asyncio.wait_for(a2.send(ApiKey.PRODUCE, 3, {
+            "acks": -1, "timeout_ms": 1000, "topics": [
+                {"name": "q", "partitions": [
+                    {"index": 0, "records": records.build_batch(b"x", 1)}]}],
+        }), 10)
+        assert resp["responses"][0]["partitions"][0]["error_code"] \
+            == ErrorCode.THROTTLING_QUOTA_EXCEEDED
+
+        # Tenant B is untouched by A's exhaustion.
+        b1 = await conn("tB:c1")
+        ok = await asyncio.wait_for(b1.send(ApiKey.PRODUCE, 3, {
+            "acks": -1, "timeout_ms": 1000, "topics": [
+                {"name": "q", "partitions": [
+                    {"index": 0, "records": records.build_batch(b"y", 1)}]}],
+        }), 10)
+        assert ok["responses"][0]["partitions"][0]["error_code"] \
+            == ErrorCode.NONE
+
+        # a1 closing releases the token: tenant A admits again.
+        await a1.close()
+        await asyncio.sleep(0.05)
+        a3 = await conn("tA:c3")
+        ok = await asyncio.wait_for(a3.send(ApiKey.PRODUCE, 3, {
+            "acks": -1, "timeout_ms": 1000, "topics": [
+                {"name": "q", "partitions": [
+                    {"index": 0, "records": records.build_batch(b"z", 1)}]}],
+        }), 10)
+        assert ok["responses"][0]["partitions"][0]["error_code"] \
+            == ErrorCode.NONE
+    finally:
+        for cl in clients:
+            try:
+                await cl.close()
+            except (ConnectionError, OSError):
+                pass
+        await srv.stop()

@@ -350,6 +350,47 @@ print("traffic chaos ok:", ws["acked"], "acked across",
 PYEOF
 }
 
+wire_load_smoke() {
+    # The wire serving plane's load rig (tools/wire_load.py) in lockstep
+    # mode: 64 real connections against a 3-broker lease-enabled cluster
+    # on the shared virtual clock. The --smoke contract asserts zero
+    # terminal errors, zero broker_request_errors_total, bounded
+    # retries, and recorded serve-phase spans; two same-seed runs must
+    # produce cmp-byte-identical op-journal + wire-event artifacts (the
+    # rig joins the chaos-determinism contract), and a --chaos run
+    # (torn_frames + conn_reset mid-window) must ALSO replay
+    # byte-identically — torn zero-copy chunked frames included.
+    echo "== wire load smoke =="
+    rm -f /tmp/ci_wl_a.txt /tmp/ci_wl_b.txt /tmp/ci_wl_ca.txt \
+        /tmp/ci_wl_cb.txt
+    python tools/wire_load.py --connections 64 --tenants 8 --partitions 4 \
+        --mode lockstep --ticks 40 --load 2 --seed 7 --smoke \
+        --artifact /tmp/ci_wl_a.txt --no-merge > /tmp/ci_wl_a.json
+    python tools/wire_load.py --connections 64 --tenants 8 --partitions 4 \
+        --mode lockstep --ticks 40 --load 2 --seed 7 \
+        --artifact /tmp/ci_wl_b.txt --no-merge > /dev/null
+    cmp /tmp/ci_wl_a.txt /tmp/ci_wl_b.txt
+    python tools/wire_load.py --connections 16 --tenants 4 --partitions 4 \
+        --mode lockstep --ticks 30 --load 2 --seed 7 --chaos \
+        --artifact /tmp/ci_wl_ca.txt --no-merge > /dev/null
+    python tools/wire_load.py --connections 16 --tenants 4 --partitions 4 \
+        --mode lockstep --ticks 30 --load 2 --seed 7 --chaos \
+        --artifact /tmp/ci_wl_cb.txt --no-merge > /dev/null
+    cmp /tmp/ci_wl_ca.txt /tmp/ci_wl_cb.txt
+    python - <<'PYEOF'
+import json
+head = open("/tmp/ci_wl_a.json").read()
+row = json.loads(head[head.find("{"):head.rfind("}") + 1])
+assert row["ops"] == 64 * 2, row["ops"]  # every drawn op executed
+assert row["errors"] == 0, row
+assert row["p99_ticks"] >= row["p50_ticks"] > 0, row
+assert row["bytes_total"] > 0, row
+print("wire load ok:", row["ops"], "ops,", row["retries"], "retries,",
+      f"p50 {row['p50_ticks']} / p99 {row['p99_ticks']} ticks,",
+      "artifact", row["artifact_sha256"][:16])
+PYEOF
+}
+
 podsim_smoke() {
     # The sharded engine path's quick parity gate (PR 14): twin 3-node
     # clusters — 8-virtual-device 'p' mesh vs unsharded, both active-set +
@@ -391,6 +432,7 @@ if [[ "${1:-}" == "quick" ]]; then
     lease_chaos_smoke
     chaos_search_smoke
     wire_chaos_smoke
+    wire_load_smoke
     traffic_smoke
     traffic_smoke_spans
     podsim_smoke
@@ -453,6 +495,7 @@ else
     chaos_search_smoke
     chaos_search_repros
     wire_chaos_smoke
+    wire_load_smoke
     traffic_smoke
     traffic_smoke_spans
     traffic_chaos_smoke

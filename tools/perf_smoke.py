@@ -70,6 +70,8 @@ FLOOR_ROWS = [
     {"podsim": True, "per_device": 2048, "devices": 8, "ticks": 10,
      "warmup": 5, "tenants": 50, "offered": 64, "hb_ticks": 64,
      "max_regression": 3.0},
+    {"wire": True, "connections": 64, "tenants": 8, "partitions": 4,
+     "load": 2, "window_s": 5.0, "max_regression": 3.0},
 ]
 
 
@@ -147,11 +149,58 @@ def run_podsim(floor: dict) -> dict:
     return row
 
 
+def run_wire(floor: dict) -> dict:
+    """Wire serving-plane row: tools/wire_load.py (real sockets against
+    a 3-broker lease cluster, zero-copy fetch path) — the floor metric
+    is the per-request p50 ms, reported through the shared ms_per_tick
+    slot so the ratio check and regression naming work unchanged. A
+    regression here means the serve path re-grew a copy (or the accept /
+    dispatch plane started queueing) that the in-process traffic row
+    can never see."""
+    out = os.path.join(tempfile.gettempdir(),
+                       "josefine_perf_smoke_wire_%d.json" % os.getpid())
+    try:
+        os.unlink(out)  # merge semantics: stale rows must not survive
+    except OSError:
+        pass
+    cmd = [
+        sys.executable, os.path.join(ROOT, "tools", "wire_load.py"),
+        "--platform", "cpu", "--mode", "wall",
+        "--connections", str(floor["connections"]),
+        "--tenants", str(floor.get("tenants", 8)),
+        "--partitions", str(floor.get("partitions", 4)),
+        "--load", str(floor.get("load", 2)),
+        "--window-s", str(floor.get("window_s", 5.0)),
+        "--seed", "7",
+        "--out", out,
+    ]
+    env = dict(os.environ, JOSEFINE_BENCH_PLATFORM="cpu")
+    subprocess.run(cmd, check=True, cwd=ROOT, env=env,
+                   stdout=subprocess.DEVNULL,
+                   timeout=floor.get("timeout_s", 600))
+    try:
+        with open(out) as f:
+            row = json.load(f)["results"][0]
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+    if row["errors"]:
+        raise RuntimeError(
+            f"wire perf row saw {row['errors']} terminal errors — the "
+            f"floor would be measuring a broken serve path")
+    return {"ms_per_tick": row["p50_ms"],
+            "extra": {"profile_phases": {}, "wire_row": row}}
+
+
 def run_bench(floor: dict) -> dict:
     if floor.get("traffic"):
         return run_traffic(floor)
     if floor.get("podsim"):
         return run_podsim(floor)
+    if floor.get("wire"):
+        return run_wire(floor)
     out = os.path.join(tempfile.gettempdir(),
                        "josefine_perf_smoke_%d.json" % os.getpid())
     cmd = [
@@ -194,6 +243,9 @@ def _row_name(floor: dict) -> str:
     if floor.get("podsim"):
         return (f"podsim sharded P={floor['per_device'] * floor['devices']} "
                 f"({floor['devices']}-device mesh, active-set)")
+    if floor.get("wire"):
+        return (f"wire-fetch {floor['connections']} conns "
+                f"(zero-copy serve, p50 ms as ms/tick)")
     if floor.get("active_set"):
         return (f"P={floor['P']} active-set "
                 f"(active-frac {floor.get('active_frac')})")

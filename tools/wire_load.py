@@ -1,0 +1,825 @@
+#!/usr/bin/env python
+"""The 10k-connection wire load rig: real sockets against real brokers.
+
+Boots a 3-broker deployment (raft + broker + Kafka TCP surface, leases
+on for the lease read mode) and drives N concurrent producer/consumer
+CONNECTIONS — not the in-process handler calls BENCH_traffic.json
+measures — each with its own socket, tenant-prefixed client id
+(``t<k>:c<i>``), and seeded open-loop request schedule. Two modes:
+
+* ``--mode wall`` (the bench): connections run concurrently on the wall
+  clock; the row records per-request p50/p99 ms, bytes/s, retry and
+  reconnect counters, and broker-side serve-phase span attribution.
+  Rows merge into BENCH_wire.json keyed (connections, load, read_mode,
+  fetch_path, mode, chaos, hot_tenant) so a zero-copy row sits beside
+  its ``--fetch-path legacy`` twin. The row's ``serving_tax`` extra
+  quotes the wire-vs-in-process delta against the matching
+  BENCH_traffic.json replication-3 row: what the TCP serving plane
+  costs over the in-process handler call.
+* ``--mode lockstep`` (the smoke): one virtual clock runs the fault
+  plane, every node's consensus tick, and the drivers' deadlines
+  (LockstepRequestClock); per-tick arrivals execute sequentially, so
+  the op journal + wire event log artifact (``--artifact``) is
+  byte-identical across same-seed runs — ``cmp`` is the CI assert.
+  ``--chaos`` arms a torn_frames/conn_reset window mid-run (fates must
+  tear the zero-copy chunked frames exactly like joined writes).
+
+``--hot-tenant`` turns on per-tenant accept admission
+(max_connections_per_tenant = fair share) and runs the starvation
+experiment: the hot tenant opens 2x its budget FIRST, then the other
+tenants connect — every over-budget probe must be refused with the
+retryable THROTTLING_QUOTA_EXCEEDED code and every other tenant must
+still be admitted and served.
+
+Usage:
+    python tools/wire_load.py --connections 128 --mode wall
+    python tools/wire_load.py --connections 8192 --load 1 --window-s 30
+    python tools/wire_load.py --connections 64 --mode lockstep --smoke \
+        --artifact /tmp/wire_rig.json --no-merge
+    python tools/wire_load.py --connections 64 --mode wall --hot-tenant
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import hashlib
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_pre = argparse.ArgumentParser(add_help=False)
+_pre.add_argument("--platform", default=None)
+_platform = _pre.parse_known_args()[0].platform
+_target = os.environ.get("JOSEFINE_BENCH_PLATFORM") or _platform
+if _target:
+    import jax
+
+    jax.config.update("jax_platforms", _target)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_OUT = os.path.join(ROOT, "BENCH_wire.json")
+TOPIC = "wl"
+
+
+def _row_key(r: dict) -> tuple:
+    return (int(r["connections"]), float(r["load"]), str(r["read_mode"]),
+            str(r["fetch_path"]), str(r["mode"]), bool(r.get("chaos")),
+            bool(r.get("hot_tenant")))
+
+
+def merge_rows(out_path: str, rows: list[dict], device: str) -> None:
+    merged = {_row_key(r): r for r in rows}
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        if prev.get("device") == device:
+            for r in prev.get("results", []):
+                if "connections" in r:
+                    merged.setdefault(_row_key(r), r)
+    except (OSError, ValueError, AttributeError, KeyError, TypeError):
+        pass
+    with open(out_path, "w") as f:
+        json.dump({"bench": "wire_serving", "device": device,
+                   "results": [merged[k] for k in sorted(merged)]},
+                  f, indent=1)
+        f.write("\n")
+
+
+def _pct(xs: list[float], q: float) -> float | None:
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+def serving_tax(read_mode: str, wire_p50_ms: float | None,
+                tick_ms: int = 20) -> dict | None:
+    """The in-process replication-3 row for this read mode, and the wire
+    delta: everything the TCP plane adds (framing, socket scheduling,
+    per-connection tasks) over the bare handler call. Two bases, because
+    the workloads differ in scale: ``tax_vs_protocol_ms`` prices the
+    in-process row's p50 PROTOCOL ticks at this rig's tick length (the
+    consensus work is the same protocol time; the rest is serving), and
+    ``tax_vs_inproc_wall_ms`` uses that bench's own wall tick cost."""
+    try:
+        with open(os.path.join(ROOT, "BENCH_traffic.json")) as f:
+            rows = json.load(f)["results"]
+    except (OSError, ValueError, KeyError):
+        return None
+    ref = None
+    for r in rows:
+        if (int(r.get("replication", 1)) == 3
+                and str(r.get("read_mode", "local")) == read_mode
+                and "p50_ticks" in r):
+            if ref is None or r["tenants"] > ref["tenants"]:
+                ref = r
+    if ref is None:
+        return None
+    out = {"inproc_ref": {"tenants": ref["tenants"],
+                          "partitions": ref["partitions"],
+                          "read_mode": read_mode,
+                          "p50_ticks": ref["p50_ticks"],
+                          "ms_per_tick": ref["ms_per_tick"]}}
+    if wire_p50_ms is not None:
+        out["wire_p50_ms"] = wire_p50_ms
+        out["tax_vs_protocol_ms"] = round(
+            wire_p50_ms - ref["p50_ticks"] * tick_ms, 3)
+        out["tax_vs_inproc_wall_ms"] = round(
+            wire_p50_ms - ref["p50_ticks"] * ref["ms_per_tick"], 3)
+    return out
+
+
+# ------------------------------------------------------------- cluster
+
+
+class RigCluster:
+    """3 full nodes over real sockets WITHOUT chaos seams: the bench
+    path must not wrap connections in the wire plane's buffering shims
+    (FaultyWriter copies every write — it would erase the zero-copy
+    story this rig measures). The lockstep smoke uses
+    chaos.wire_soak.WireCluster instead, seams and all."""
+
+    def __init__(self, n_nodes: int, groups: int, tmpdir: str,
+                 tick_ms: int, read_mode: str, request_spans: bool,
+                 broker_overrides: dict | None = None):
+        from josefine_tpu.config import (
+            BrokerConfig,
+            EngineConfig,
+            JosefineConfig,
+            NodeAddr,
+            RaftConfig,
+        )
+        from josefine_tpu.node import Node
+        from josefine_tpu.utils.net import bound_sockets
+
+        leases = read_mode == "lease"
+        raft_socks, raft_ports = bound_sockets(n_nodes)
+        broker_socks, self.broker_ports = bound_sockets(n_nodes)
+        # Same election arithmetic as chaos.wire_soak.WireCluster: the
+        # lease lane needs election_timeout_min > heartbeat + 2 ticks.
+        et_min = 6 * tick_ms if leases else 3 * tick_ms
+        et_max = 12 * tick_ms if leases else 8 * tick_ms
+        self.nodes = []
+        for i in range(n_nodes):
+            node_id = i + 1
+            peers = [NodeAddr(id=j + 1, ip="127.0.0.1", port=raft_ports[j])
+                     for j in range(n_nodes) if j != i]
+            cfg = JosefineConfig(
+                raft=RaftConfig(id=node_id, ip="127.0.0.1",
+                                port=raft_ports[i], nodes=peers,
+                                tick_ms=tick_ms,
+                                heartbeat_timeout_ms=tick_ms,
+                                election_timeout_min_ms=et_min,
+                                election_timeout_max_ms=et_max,
+                                leases=leases,
+                                request_spans=request_spans,
+                                data_directory=os.path.join(
+                                    tmpdir, f"node-{node_id}/raft")),
+                broker=BrokerConfig(id=node_id, ip="127.0.0.1",
+                                    port=self.broker_ports[i],
+                                    read_mode=read_mode,
+                                    state_file=os.path.join(
+                                        tmpdir, f"node-{node_id}/state.db"),
+                                    data_directory=os.path.join(
+                                        tmpdir, f"node-{node_id}/data"),
+                                    **(broker_overrides or {})),
+                engine=EngineConfig(partitions=groups),
+            )
+            self.nodes.append(Node(cfg, in_memory=True,
+                                   raft_sock=raft_socks[i],
+                                   broker_sock=broker_socks[i]))
+
+    async def start(self) -> None:
+        for n in self.nodes:
+            await n.start()
+        deadline = time.monotonic() + 20.0
+        want = len(self.nodes)
+        while time.monotonic() < deadline:
+            if all(len(n.store.get_brokers()) >= want for n in self.nodes):
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError("rig brokers never registered")
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(n.stop() for n in self.nodes),
+                             return_exceptions=True)
+
+
+async def _create_topic(cl, partitions: int, replication: int) -> None:
+    from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+
+    resp = await cl.send(ApiKey.CREATE_TOPICS, 1, {
+        "topics": [{"name": TOPIC, "num_partitions": partitions,
+                    "replication_factor": replication,
+                    "assignments": [], "configs": []}],
+        "timeout_ms": 30000, "validate_only": False}, timeout=60.0)
+    code = resp["topics"][0]["error_code"]
+    if code not in (int(ErrorCode.NONE),
+                    int(ErrorCode.TOPIC_ALREADY_EXISTS)):
+        raise RuntimeError(f"create_topics failed: code {code}")
+
+
+async def _await_leaders(cl, partitions: int,
+                         sleep=None) -> dict[int, tuple[str, int]]:
+    """Poll metadata until every partition has a live leader; returns
+    partition -> (host, port)."""
+    from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+
+    for _ in range(600):
+        md = await cl.send(ApiKey.METADATA, 1,
+                           {"topics": [{"name": TOPIC}]}, timeout=30.0)
+        brokers = {b["node_id"]: (b["host"], b["port"])
+                   for b in md["brokers"]}
+        leaders: dict[int, tuple[str, int]] = {}
+        for t in md["topics"]:
+            if t["error_code"] != ErrorCode.NONE:
+                continue
+            for p in t["partitions"]:
+                addr = brokers.get(p["leader_id"])
+                if addr is not None:
+                    leaders[p["partition_index"]] = addr
+        if len(leaders) >= partitions:
+            return leaders
+        if sleep is not None:
+            await sleep()
+        else:
+            await asyncio.sleep(0.05)
+    raise TimeoutError("rig partitions never elected leaders")
+
+
+# ------------------------------------------------------------ sessions
+
+
+class Session:
+    """One connection's worth of state: identity, route, seeded streams,
+    and its slice of the harvest."""
+
+    __slots__ = ("idx", "tenant", "role", "partition", "addr", "client_id",
+                 "rng", "client", "offset", "lat", "bytes", "retries",
+                 "reconnects", "errors", "ops", "refused", "seq", "wrap")
+
+    def __init__(self, idx: int, tenants: int, partitions: int,
+                 leaders: dict, seed: int):
+        self.idx = idx
+        self.tenant = idx % tenants
+        self.role = "producer" if idx % 2 == 0 else "consumer"
+        self.partition = idx % partitions
+        self.addr = leaders[self.partition]
+        self.client_id = f"t{self.tenant}:c{idx}"
+        self.rng = random.Random(f"{seed}|conn|{idx}")
+        self.client = None
+        self.offset = 0
+        self.lat: list[float] = []
+        self.bytes = 0
+        self.retries = 0
+        self.reconnects = 0
+        self.errors = 0
+        self.ops = 0
+        self.refused = False
+        self.seq = 0
+        self.wrap = None
+
+
+async def _connect(sess: Session, clock=None):
+    from josefine_tpu.kafka import client as kafka_client
+
+    wrap = None
+    if sess.wrap is not None:
+        wrap = sess.wrap(f"{sess.client_id}.r{sess.reconnects}")
+    coro = kafka_client.connect(sess.addr[0], sess.addr[1],
+                                client_id=sess.client_id, wrap=wrap)
+    if clock is not None:
+        sess.client = await clock.call(coro, 120)
+    else:
+        sess.client = await asyncio.wait_for(coro, 30.0)
+    return sess.client
+
+
+def _payload(sess: Session, payload_bytes: int) -> bytes:
+    head = f"L:{sess.tenant}:{sess.idx}:{sess.seq}:".encode()
+    sess.seq += 1
+    return head + b"x" * max(0, payload_bytes - len(head))
+
+
+async def _one_op(sess: Session, args, clock=None) -> bool:
+    """One produce or fetch with seeded bounded retries; returns True on
+    success. Latency covers the WHOLE op including retries — the client
+    experience, not the happy path."""
+    from josefine_tpu.broker import records
+    from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+
+    retryable = (int(ErrorCode.NOT_LEADER_OR_FOLLOWER),
+                 int(ErrorCode.LEADER_NOT_AVAILABLE),
+                 int(ErrorCode.UNKNOWN_TOPIC_OR_PARTITION),
+                 int(ErrorCode.THROTTLING_QUOTA_EXCEEDED),
+                 int(ErrorCode.REQUEST_TIMED_OUT))
+    mb = args.max_bytes
+    t0 = time.perf_counter()
+    tick0 = None if clock is None else args._plane.tick
+    for attempt in range(args.max_attempts):
+        try:
+            cl = sess.client
+            if cl is None or (cl._read_task is not None
+                              and cl._read_task.done()):
+                if cl is not None:
+                    await cl.close()
+                    sess.reconnects += 1
+                cl = await _connect(sess, clock)
+            if sess.role == "producer":
+                body = {"transactional_id": None, "acks": -1,
+                        "timeout_ms": 5000,
+                        "topics": [{"name": TOPIC, "partitions": [
+                            {"index": sess.partition,
+                             "records": records.build_batch(
+                                 _payload(sess, args.payload),
+                                 args.records)}]}]}
+                coro = cl.send(ApiKey.PRODUCE, 3, body, timeout=600.0)
+                resp = (await clock.call(coro, args.request_ticks)
+                        if clock is not None
+                        else await asyncio.wait_for(coro, 30.0))
+                pr = resp["responses"][0]["partitions"][0]
+                nbytes = args.payload
+            else:
+                body = {"replica_id": -1, "max_wait_ms": 0, "min_bytes": 0,
+                        "max_bytes": mb, "isolation_level": 0,
+                        "topics": [{"topic": TOPIC, "partitions": [
+                            {"partition": sess.partition,
+                             "fetch_offset": sess.offset,
+                             "partition_max_bytes": mb}]}]}
+                coro = cl.send(ApiKey.FETCH, 4, body, timeout=600.0)
+                resp = (await clock.call(coro, args.request_ticks)
+                        if clock is not None
+                        else await asyncio.wait_for(coro, 30.0))
+                pr = resp["responses"][0]["partitions"][0]
+                nbytes = len(pr.get("records") or b"")
+            code = int(pr["error_code"])
+            if code == int(ErrorCode.NONE):
+                if sess.role == "consumer":
+                    # Tail the partition: next op reads the fresh suffix.
+                    sess.offset = max(sess.offset, pr["high_watermark"])
+                sess.bytes += nbytes
+                sess.ops += 1
+                if clock is None:
+                    sess.lat.append((time.perf_counter() - t0) * 1000.0)
+                else:
+                    sess.lat.append(float(args._plane.tick - tick0))
+                return True
+            if code not in retryable:
+                sess.errors += 1
+                return False
+        except (ConnectionError, OSError, TimeoutError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError):
+            if sess.client is not None:
+                try:
+                    await sess.client.close()
+                except (ConnectionError, OSError):
+                    pass
+                sess.client = None
+                sess.reconnects += 1
+        sess.retries += 1
+        backoff = (2 ** min(attempt, 5)) * sess.rng.uniform(0.5, 1.5)
+        if clock is None:
+            await asyncio.sleep(0.01 * backoff)
+        else:
+            await clock.sleep_ticks(int(backoff))
+    sess.errors += 1
+    return False
+
+
+# ------------------------------------------------------------ wall mode
+
+
+async def run_wall(args) -> dict:
+    groups = args.partitions + 1
+    tmpdir = tempfile.mkdtemp(prefix="wire_load_")
+    overrides: dict = {"fetch_path": args.fetch_path}
+    fair = None
+    if args.hot_tenant:
+        args.tenants = 4
+        fair = max(1, args.connections // args.tenants)
+        overrides["max_connections_per_tenant"] = fair
+    cluster = RigCluster(3, groups, tmpdir, args.tick_ms, args.read_mode,
+                         request_spans=True, broker_overrides=overrides)
+    from josefine_tpu.kafka import client as kafka_client
+
+    t_boot0 = time.perf_counter()
+    row: dict = {}
+    try:
+        await cluster.start()
+        admin = await kafka_client.connect(
+            "127.0.0.1", cluster.broker_ports[0], client_id="admin:rig")
+        await _create_topic(admin, args.partitions, 3)
+        leaders = await _await_leaders(admin, args.partitions)
+        boot_s = time.perf_counter() - t_boot0
+
+        sessions = [Session(i, args.tenants, args.partitions, leaders,
+                            args.seed) for i in range(args.connections)]
+        hot = None
+        if args.hot_tenant:
+            hot = await _hot_tenant_phase(sessions, args, fair)
+            sessions = [s for s in sessions if not s.refused]
+
+        # Staggered open: chunks keep the accept queues and the single
+        # event loop from a 10k-dial thundering herd.
+        t_open0 = time.perf_counter()
+        chunk = 256
+        todo = [s for s in sessions if s.client is None]
+        for i in range(0, len(todo), chunk):
+            await asyncio.gather(*(_connect(s) for s in todo[i:i + chunk]))
+        open_s = time.perf_counter() - t_open0
+
+        # Measured phase: every session draws its own open-loop arrival
+        # times over the window and fires on schedule regardless of
+        # completions (ops are tasks, not serialized awaits).
+        async def drive(sess: Session, start: float):
+            times = sorted(sess.rng.uniform(0.0, args.window_s)
+                           for _ in range(args.load))
+            ops = []
+            for at in times:
+                delay = start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                ops.append(asyncio.ensure_future(_one_op(sess, args)))
+            await asyncio.gather(*ops)
+
+        t_run0 = time.perf_counter()
+        start = t_run0 + 0.05
+        await asyncio.gather(*(drive(s, start) for s in sessions))
+        wall = time.perf_counter() - t_run0
+
+        lat = [v for s in sessions for v in s.lat]
+        nbytes = sum(s.bytes for s in sessions)
+        spans = _harvest_spans(cluster.nodes)
+        row = {
+            "driver": "wire",
+            "mode": "wall",
+            "connections": args.connections,
+            "tenants": args.tenants,
+            "partitions": args.partitions,
+            "load": args.load,
+            "read_mode": args.read_mode,
+            "fetch_path": args.fetch_path,
+            "leases": args.read_mode == "lease",
+            "chaos": False,
+            "hot_tenant": bool(args.hot_tenant),
+            "seed": args.seed,
+            "window_s": args.window_s,
+            "bootstrap_s": round(boot_s, 3),
+            "open_s": round(open_s, 3),
+            "wall_s": round(wall, 3),
+            "ops": sum(s.ops for s in sessions),
+            "errors": sum(s.errors for s in sessions),
+            "retries": sum(s.retries for s in sessions),
+            "reconnects": sum(s.reconnects for s in sessions),
+            "p50_ms": round(_pct(lat, 0.50) or 0.0, 3),
+            "p99_ms": round(_pct(lat, 0.99) or 0.0, 3),
+            "bytes_total": nbytes,
+            "bytes_per_s": round(nbytes / max(wall, 1e-9), 1),
+            "ops_per_s": round(sum(s.ops for s in sessions)
+                               / max(wall, 1e-9), 1),
+            "extra": {
+                "span_phase_totals": spans,
+                "serving_tax": serving_tax(
+                    args.read_mode, round(_pct(lat, 0.50) or 0.0, 3),
+                    args.tick_ms),
+            },
+        }
+        if hot is not None:
+            row["extra"]["hot_tenant"] = hot
+        for s in sessions:
+            if s.client is not None:
+                try:
+                    await s.client.close()
+                except (ConnectionError, OSError):
+                    pass
+        await admin.close()
+    finally:
+        await cluster.stop()
+        await asyncio.to_thread(shutil.rmtree, tmpdir, ignore_errors=True)
+    return row
+
+
+async def _hot_tenant_phase(sessions, args, fair: int) -> dict:
+    """The starvation experiment (see module doc): hot tenant 0 probes
+    2x its budget first; every other tenant must still be admitted."""
+    from josefine_tpu.broker import records
+    from josefine_tpu.kafka.codec import ApiKey, ErrorCode
+
+    hot_sessions = [s for s in sessions if s.tenant == 0]
+    extra = []
+    base_idx = len(sessions)
+    leaders = {s.partition: s.addr for s in sessions}
+    for j in range(fair):
+        s = Session(base_idx + j, args.tenants, args.partitions, leaders,
+                    args.seed)
+        s.tenant = 0
+        s.client_id = f"t0:c{base_idx + j}"
+        extra.append(s)
+    probe_order = hot_sessions + extra
+
+    async def probe(sess: Session) -> None:
+        try:
+            cl = await _connect(sess)
+            resp = await asyncio.wait_for(cl.send(ApiKey.PRODUCE, 3, {
+                "transactional_id": None, "acks": -1, "timeout_ms": 5000,
+                "topics": [{"name": TOPIC, "partitions": [
+                    {"index": sess.partition,
+                     "records": records.build_batch(b"probe", 1)}]}],
+            }, timeout=600.0), 30.0)
+            code = int(resp["responses"][0]["partitions"][0]["error_code"])
+            if code == int(ErrorCode.THROTTLING_QUOTA_EXCEEDED):
+                sess.refused = True
+                await cl.close()
+                sess.client = None
+        except (ConnectionError, OSError, TimeoutError,
+                asyncio.TimeoutError, asyncio.IncompleteReadError):
+            # Refusals for request kinds with no response surface close
+            # the socket silently: same verdict.
+            sess.refused = True
+            sess.client = None
+
+    # Hot tenant first — it burns through its whole budget...
+    for i in range(0, len(probe_order), 64):
+        await asyncio.gather(*(probe(s) for s in probe_order[i:i + 64]))
+    hot_refused = sum(1 for s in probe_order if s.refused)
+    # ...then everyone else, who must be untouched by tenant 0's greed.
+    others = [s for s in sessions if s.tenant != 0]
+    for i in range(0, len(others), 64):
+        await asyncio.gather(*(probe(s) for s in others[i:i + 64]))
+    others_refused = sum(1 for s in others if s.refused)
+    return {
+        "budget_per_tenant": fair,
+        "hot_attempted": len(probe_order),
+        "hot_admitted": len(probe_order) - hot_refused,
+        "hot_refused": hot_refused,
+        "others_attempted": len(others),
+        "others_refused": others_refused,
+    }
+
+
+def _harvest_spans(nodes) -> dict | None:
+    """Aggregate serve-phase attribution across the brokers: where each
+    served request's ticks went (admission/queue/consensus/apply/serve)."""
+    tot: dict | None = None
+    for n in nodes:
+        if n.spans is None:
+            continue
+        n.spans.seal()
+        pt = n.spans.summary()["phase_totals"]
+        if tot is None:
+            tot = dict(pt)
+        else:
+            for k, v in pt.items():
+                tot[k] = tot.get(k, 0) + v
+    return tot
+
+
+# -------------------------------------------------------- lockstep mode
+
+
+async def run_lockstep(args) -> dict:
+    """Deterministic smoke: WireCluster (chaos seams in), LockstepPacer,
+    sequential per-tick op execution. Artifact = op journal + wire event
+    log, byte-identical across same-seed runs."""
+    from josefine_tpu.chaos.faults import FaultPlane, NetFaults
+    from josefine_tpu.chaos.wire import WirePlane
+    from josefine_tpu.chaos.wire_soak import (
+        LockstepRequestClock,
+        WireCluster,
+    )
+    from josefine_tpu.kafka import client as kafka_client
+    from josefine_tpu.raft.pacer import LockstepPacer
+
+    plane = FaultPlane(args.seed, 3, net=NetFaults.quiet())
+    plane.wire = WirePlane(args.seed)
+    args._plane = plane
+    pacer = LockstepPacer(settle_s=0.01)
+    groups = args.partitions + 1
+    tmpdir = tempfile.mkdtemp(prefix="wire_load_")
+    overrides: dict = {"fetch_path": args.fetch_path,
+                       "read_mode": args.read_mode}
+    cluster = WireCluster(3, groups, tmpdir, plane, pacer,
+                          tick_ms=args.tick_ms, request_spans=True,
+                          leases=args.read_mode == "lease",
+                          broker_overrides=overrides)
+
+    async def advance() -> None:
+        plane.advance(1)
+        await pacer.advance(1)
+
+    async def setup_advance() -> None:
+        await pacer.advance(1)
+
+    clock = LockstepRequestClock(setup_advance)
+    journal: list[str] = []
+    row: dict = {}
+    try:
+        await cluster.start()
+        for _ in range(600):
+            if cluster.registered():
+                break
+            await pacer.advance(1)
+        else:
+            raise TimeoutError("rig brokers never registered")
+        admin = await clock.call(
+            kafka_client.connect("127.0.0.1", cluster.broker_ports[0],
+                                 client_id="admin:rig",
+                                 wrap=plane.wire.client_wrap("admin")), 120)
+        await clock.call(_create_topic(admin, args.partitions, 3), 600)
+
+        async def md_sleep():
+            await pacer.advance(1)
+
+        leaders = await _await_leaders(admin, args.partitions,
+                                       sleep=md_sleep)
+
+        sessions = [Session(i, args.tenants, args.partitions, leaders,
+                            args.seed) for i in range(args.connections)]
+        for s in sessions:
+            s.wrap = plane.wire.client_wrap
+            await _connect(s, clock)
+        # Per-connection open-loop arrival ticks, drawn up front from the
+        # seeded stream: arrivals are a function of (seed, idx) alone.
+        arrivals: dict[int, list[Session]] = {}
+        for s in sessions:
+            ticks = sorted(s.rng.randrange(0, args.ticks)
+                           for _ in range(args.load))
+            for t in ticks:
+                arrivals.setdefault(t, []).append(s)
+
+        clock._advance = advance
+        # The loop walks ARRIVAL ticks, not plane ticks: an op in flight
+        # advances the shared plane/pacer clock (that is how its leader
+        # election or retry backoff makes progress), so the plane tick
+        # can jump several steps per arrival tick. Iterating the arrival
+        # axis directly guarantees every drawn op executes exactly once,
+        # in (tick, conn) order — the determinism contract the artifact
+        # cmp rests on.
+        for t in range(args.ticks):
+            await advance()
+            if args.chaos and t == args.ticks // 3:
+                span_ticks = max(1, args.ticks // 3)
+                plane.wire.arm("torn_frames", role="any", p=0.4,
+                               until=plane.tick + span_ticks)
+                plane.wire.arm("conn_reset", role="client", p=0.05,
+                               until=plane.tick + span_ticks)
+            for s in arrivals.get(t, ()):
+                ok = await _one_op(s, args, clock)
+                journal.append(json.dumps(
+                    {"tick": t, "conn": s.idx, "role": s.role,
+                     "ok": ok, "lat_ticks": s.lat[-1] if ok else None,
+                     "retries": s.retries, "bytes": s.bytes},
+                    sort_keys=True, separators=(",", ":")))
+
+        plane.heal_all()
+        lat = [v for s in sessions for v in s.lat]
+        spans = _harvest_spans(cluster.nodes)
+        artifact_text = (
+            "\n".join(journal) + "\n--wire-events--\n"
+            + plane.wire.event_log_jsonl())
+        sha = hashlib.sha256(artifact_text.encode()).hexdigest()
+        if args.artifact:
+            with open(args.artifact, "w") as f:
+                f.write(artifact_text)
+        row = {
+            "driver": "wire",
+            "mode": "lockstep",
+            "connections": args.connections,
+            "tenants": args.tenants,
+            "partitions": args.partitions,
+            "load": args.load,
+            "read_mode": args.read_mode,
+            "fetch_path": args.fetch_path,
+            "leases": args.read_mode == "lease",
+            "chaos": bool(args.chaos),
+            "hot_tenant": False,
+            "seed": args.seed,
+            "ticks": args.ticks,
+            "ops": sum(s.ops for s in sessions),
+            "errors": sum(s.errors for s in sessions),
+            "retries": sum(s.retries for s in sessions),
+            "reconnects": sum(s.reconnects for s in sessions),
+            "p50_ticks": _pct(lat, 0.50),
+            "p99_ticks": _pct(lat, 0.99),
+            "bytes_total": sum(s.bytes for s in sessions),
+            "artifact_sha256": sha,
+            "extra": {
+                "span_phase_totals": spans,
+                "fates": plane.wire.fate_log() if args.chaos else [],
+            },
+        }
+        for s in sessions:
+            if s.client is not None:
+                try:
+                    await s.client.close()
+                except (ConnectionError, OSError):
+                    pass
+        await admin.close()
+    finally:
+        await cluster.stop()
+        await asyncio.to_thread(shutil.rmtree, tmpdir, ignore_errors=True)
+    return row
+
+
+# ---------------------------------------------------------------- main
+
+
+def _smoke_asserts(row: dict, args) -> None:
+    from josefine_tpu.utils.metrics import REGISTRY
+
+    assert row["ops"] > 0, "smoke: no op completed"
+    assert row["errors"] == 0, f"smoke: {row['errors']} terminal errors"
+    budget = args.connections * args.load * args.max_attempts
+    assert row["retries"] <= budget, \
+        f"smoke: retries {row['retries']} blew the budget {budget}"
+    spans = row["extra"]["span_phase_totals"]
+    assert spans and spans.get("count", 0) > 0, \
+        "smoke: no serve spans recorded"
+    dump = REGISTRY.dump()
+    errs = dump.get("broker_request_errors_total", 0)
+    if isinstance(errs, dict):  # labeled series; scalar when unlabeled
+        errs = sum(errs.values())
+    assert errs == 0, f"smoke: broker_request_errors_total = {errs}"
+    ht = row["extra"].get("hot_tenant")
+    if ht is not None:
+        assert ht["hot_admitted"] == ht["budget_per_tenant"], \
+            f"smoke: hot tenant admitted {ht['hot_admitted']} != budget"
+        assert ht["hot_refused"] > 0, "smoke: no over-budget refusal fired"
+        assert ht["others_refused"] == 0, \
+            f"smoke: {ht['others_refused']} innocent tenants starved"
+        refused = dump.get("broker_conn_refused_total", 0)
+        if isinstance(refused, dict):
+            refused = sum(v for k, v in refused.items()
+                          if "tenant_quota" in k)
+        assert refused >= ht["hot_refused"], \
+            "smoke: tenant_quota refusal metric did not move"
+    print(f"SMOKE PASS: ops={row['ops']} retries={row['retries']} "
+          f"span_requests={spans['count']}")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--connections", type=int, default=128)
+    ap.add_argument("--tenants", type=int, default=8)
+    ap.add_argument("--partitions", type=int, default=8)
+    ap.add_argument("--mode", choices=("wall", "lockstep"), default="wall")
+    ap.add_argument("--load", type=int, default=4,
+                    help="requests per connection (open-loop draws)")
+    ap.add_argument("--window-s", type=float, default=10.0,
+                    help="wall mode: arrival window seconds")
+    ap.add_argument("--ticks", type=int, default=60,
+                    help="lockstep mode: horizon in virtual ticks")
+    ap.add_argument("--read-mode", choices=("local", "lease", "consensus"),
+                    default="lease")
+    ap.add_argument("--fetch-path", choices=("zerocopy", "legacy"),
+                    default="zerocopy")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--tick-ms", type=int, default=20)
+    ap.add_argument("--payload", type=int, default=512)
+    ap.add_argument("--records", type=int, default=4)
+    ap.add_argument("--max-bytes", type=int, default=1 << 20)
+    ap.add_argument("--max-attempts", type=int, default=8)
+    ap.add_argument("--request-ticks", type=int, default=40,
+                    help="lockstep per-request deadline in ticks")
+    ap.add_argument("--chaos", action="store_true",
+                    help="lockstep: arm torn_frames/conn_reset mid-run")
+    ap.add_argument("--hot-tenant", action="store_true",
+                    help="wall: per-tenant admission starvation experiment")
+    ap.add_argument("--artifact", default=None,
+                    help="lockstep: deterministic artifact path (cmp-able)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="assert the smoke contract and print PASS")
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-merge", action="store_true",
+                    help="print the row, skip BENCH merge")
+    args = ap.parse_args()
+    if args.chaos and args.mode != "lockstep":
+        ap.error("--chaos requires --mode lockstep")
+    if args.hot_tenant and args.mode != "wall":
+        ap.error("--hot-tenant requires --mode wall")
+    args._plane = None
+
+    import jax
+
+    device = str(jax.devices()[0])
+    row = asyncio.run(run_wall(args) if args.mode == "wall"
+                      else run_lockstep(args))
+    print(json.dumps(row, indent=1))
+    if args.smoke:
+        _smoke_asserts(row, args)
+    if not args.no_merge:
+        merge_rows(args.out, [row], device)
+        print(f"merged into {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
