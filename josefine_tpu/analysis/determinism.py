@@ -111,6 +111,12 @@ class DeterminismChecker(Checker):
         # byte-identity contract as the flight journal; its emit sites
         # (raft/, broker/, workload/) are already in scope above.
         "josefine_tpu/utils/spans.py",
+        # The health plane's detectors and FSM transitions journal
+        # health_* events under the same same-seed byte-identity
+        # contract (tests/test_health.py pins it); a wall-clock or
+        # set-order leak here would desynchronize every doctor
+        # scorecard run.
+        "josefine_tpu/utils/health.py",
     )
     rules = {
         "det-wallclock":

@@ -131,6 +131,12 @@ class JosefineBroker:
         self._by_client: dict[str, int] = {}
         self._by_tenant: dict[str, int] = {}
         self.bound_addr: tuple[str, int] | None = None
+        # Run-local backpressure tally for the health plane (see
+        # health_counters): the _m_refused/_m_evicted registry counters
+        # are process-global and would bleed across brokers sharing a
+        # process, so the monitor reads these instead.
+        self.n_refused = 0
+        self.n_evicted = 0
 
     async def start(self, sock=None) -> None:
         if sock is not None:
@@ -165,6 +171,15 @@ class JosefineBroker:
 
     # ------------------------------------------------------------ internals
 
+    def health_counters(self) -> dict:
+        """Produce-backpressure inputs for the health plane: cumulative
+        connection refusals (accept gate, global/per-client/per-tenant
+        caps) plus slow-client evictions — the saturation symptoms the
+        broker already counts. Wired as ``engine.health.extra_fn`` by
+        node.py; merged into the per-tick sample the monitor's
+        backpressure_sat detector windows."""
+        return {"backpressure": self.n_refused + self.n_evicted}
+
     def _set_active(self, delta: int) -> None:
         self._active += delta
         _m_active.set(self._active, node=self.config.id)
@@ -176,10 +191,12 @@ class JosefineBroker:
         shim = self.conn_shim
         if shim is not None and not shim.accept_allowed():
             _m_refused.inc(reason="accept_refuse")
+            self.n_refused += 1
             return False
         cap = self.config.max_connections
         if cap and self._active >= cap:
             _m_refused.inc(reason="max_connections")
+            self.n_refused += 1
             return False
         return True
 
@@ -342,6 +359,7 @@ class JosefineBroker:
                     per = cfg.max_connections_per_client
                     if per and self._by_client.get(client_key, 0) >= per:
                         _m_refused.inc(reason="per_client")
+                        self.n_refused += 1
                         log.warning(
                             "refusing connection from %s: client %r already "
                             "holds %d connections", peer, client_key, per)
@@ -358,6 +376,7 @@ class JosefineBroker:
                         # path and every other tenant's budget are
                         # untouched.
                         _m_refused.inc(reason="tenant_quota")
+                        self.n_refused += 1
                         log.warning(
                             "refusing connection from %s: tenant %r already "
                             "holds %d connections", peer, tenant, tper)
@@ -436,6 +455,7 @@ class JosefineBroker:
                           peer, results[0])
             if evicted:
                 _m_evicted.inc()
+                self.n_evicted += 1
                 if self.flight_hook is not None:
                     self.flight_hook("conn_evicted",
                                      {"client": client_key or "",

@@ -212,7 +212,8 @@ class ChaosCluster(_PlaneDrivenCluster):
                  payload_ring: bool = False,
                  flight_wire: bool = False, workload=None,
                  flight_ring: int = 4096, request_spans: bool = False,
-                 migration: bool = False, leases: bool = False):
+                 migration: bool = False, leases: bool = False,
+                 health: bool = True):
         self.plane = plane or FaultPlane(seed, n_nodes, net=net)
         self.rng = self.plane.rng  # one RNG: the whole run replays from seed
         self.N = n_nodes
@@ -321,6 +322,20 @@ class ChaosCluster(_PlaneDrivenCluster):
         # Stale-read probe tallies (see _check_leases).
         self.leased_reads = 0
         self.lease_refusals = 0
+        # The online health plane (utils.health.HealthMonitor): evaluated
+        # once per tick off state this harness already maintains — acked
+        # counters, pending futures / workload backlog, leader mirrors,
+        # chain head/commit — zero extra fetches, and it writes only to
+        # its OWN flight ring, so a health-on run stays byte-identical to
+        # its health-off twin on every other telemetry plane. Gauges stay
+        # unpublished here: the process-global registry lands in soak
+        # artifacts, and cross-run series bleed would break same-seed
+        # byte-identity when several soaks share one process.
+        self.health = None
+        if health:
+            from josefine_tpu.utils.health import HealthMonitor
+
+            self.health = HealthMonitor(groups=groups, publish=False)
         self.acked: dict[int, list[bytes]] = {g: [] for g in range(groups)}
         self.pending: list[tuple[int, bytes, object]] = []
         self.proposed = 0
@@ -482,6 +497,7 @@ class ChaosCluster(_PlaneDrivenCluster):
             invariants.check_migration_state(self)
         if self.tick_no % 10 == 0:
             self.check_log_matching()
+        self._health_tick()
 
     def drive_traffic(self):
         """One tick's proposal source: the workload schedule when wired,
@@ -627,6 +643,73 @@ class ChaosCluster(_PlaneDrivenCluster):
             "nodes": {str(i): e.lease_summary()
                       for i, e in enumerate(self.engines) if e is not None},
         }
+
+    # ---------------------------------------------------------------- health
+
+    def health_sample(self) -> dict:
+        """One tick's detector inputs, read off state the harness already
+        maintains. Keys for unarmed planes are omitted so their detectors
+        never evaluate (and legacy-shaped runs stay legacy-shaped)."""
+        from josefine_tpu.raft.chain import id_seq
+
+        pending = [0] * self.G
+        if self.workload is not None:
+            # Outstanding INCLUDING queued retries: during a leaderless
+            # window the workload parks work in its retry backlog without
+            # a live future, and that backlog is exactly the "work is
+            # waiting" signal the commit-stall detector gates on.
+            for g, n in enumerate(self.workload.outstanding_by_group(self.G)):
+                pending[g] = n
+        else:
+            for g, _, _ in self.pending:
+                pending[g] += 1
+        leaders = []
+        for g in range(self.G):
+            ln = self.leader_node(g)
+            leaders.append(-1 if ln is None else ln)
+        lag = []
+        live = self.live_nodes()
+        for g in range(self.G):
+            row = self.row_of(g)
+            # Commit SPREAD, not head-commit depth: the gap between the
+            # most- and least-advanced live commit frontier. Pipeline
+            # depth under load is healthy; one replica trailing is not.
+            commits = [id_seq(self.engines[i].chains[row].committed)
+                       for i in live]
+            lag.append((max(commits) - min(commits)) if commits else 0)
+        s = {
+            "progress": [len(self.acked[g]) for g in range(self.G)],
+            "pending": pending,
+            "leaders": leaders,
+            "lag": lag,
+        }
+        if self.leases:
+            s["lease_refused"] = self.lease_refusals
+        if self.migrator is not None:
+            m = self.migrator.mig
+            s["migration"] = (None if m is None else {
+                "active": True, "started": m["started"],
+                "progress": len(m["adopted"])})
+        return s
+
+    def _health_tick(self) -> None:
+        # Called from step() only — the health plane observes the DRIVEN
+        # (chaotic) phase. heal() is the convergence epilogue with the
+        # traffic source disengaged and harvest deferred to its end, so
+        # resolved-but-unharvested futures would read as phantom stalled
+        # work there (measured: clean-seed false positives in the first
+        # heal ticks, from proposals that raced the horizon).
+        if self.health is not None:
+            self.health.observe(self.tick_no, self.health_sample())
+
+    def health_summary(self) -> dict | None:
+        """Detector verdicts + the full ``health_*`` transition stream for
+        the soak result (None when the health plane is off, keeping the
+        twin's artifact shape explicit)."""
+        if self.health is None:
+            return None
+        return {"verdicts": self.health.verdicts(),
+                "events": self.health.events()}
 
 
 class MembershipChaosCluster(_PlaneDrivenCluster):

@@ -683,6 +683,24 @@ class ChaosSearch:
 
     # ---------------------------------------------------------- logging
 
+    @staticmethod
+    def _health_line(result: dict) -> dict | None:
+        """Compact detector verdicts for a log line, beside the
+        invariants: only detectors that left ok, each with its worst
+        level and first-degraded tick. None when the soak ran with the
+        health plane off. Deterministic by construction (verdicts
+        iterate detectors sorted), so the search log stays byte-stable
+        across same-seed runs."""
+        h = result.get("health")
+        if not h:
+            return None
+        out = {}
+        for det, v in h["verdicts"]["detectors"].items():
+            if v["worst"] != "ok":
+                out[det] = {"worst": v["worst"],
+                            "at": v.get("first_degraded")}
+        return out
+
     def _log(self, line: dict) -> dict:
         self.log_lines.append(line)
         if self.log_path:
@@ -717,7 +735,8 @@ class ChaosSearch:
             self._log({"bootstrap": name, "seed": seed,
                        "signature": cov.signature(),
                        "features": len(cov.counts),
-                       "invariants": result["invariants"]})
+                       "invariants": result["invariants"],
+                       "health": self._health_line(result)})
         return added
 
     @staticmethod
@@ -783,6 +802,11 @@ class ChaosSearch:
             "signature": cov.signature(),
             "novel": novelty,
             "invariants": result["invariants"],
+            # Detector verdicts ride beside the invariants on every
+            # probe: a candidate that trips no invariant but drives a
+            # detector critical is visible in the log even if coverage
+            # novelty rejects it from the corpus.
+            "health": self._health_line(result),
             "nemesis_skipped": result["nemesis_skipped"],
             "max_commitless_window": result["max_commitless_window"],
         })

@@ -69,7 +69,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                          commitless_limit: int | None = None,
                          request_spans: bool = False,
                          migration: bool = False,
-                         leases: bool = False) -> dict:
+                         leases: bool = False,
+                         health: bool = True) -> dict:
     """One soak run. ``auto_faults`` additionally layers the background
     random crash/partition generators over the schedule (hostile mode);
     default is schedule + probabilistic message noise only, which is what
@@ -131,6 +132,15 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
     Election params get timeout_min = hb_ticks + 3 (the lease margin
     constraint); the result gains a ``lease`` block.
 
+    ``health`` (default ON) arms the online health plane
+    (utils/health.py): a HealthMonitor evaluated once per tick off state
+    the harness already maintains, journaling ``health_*`` FSM
+    transitions into its own flight ring. The result gains a ``health``
+    block (detector verdicts + transition events) and the chaos search
+    scores it beside the invariants. Turning it off is the
+    zero-perturbation twin: a health-off run is byte-identical on
+    event_log / journals / state_digest.
+
     On an invariant violation the run auto-dumps a JSON repro artifact —
     the per-node flight-recorder journals, the metrics-registry dump, the
     fault-event log, and the violation — to ``artifact_path`` (default
@@ -187,7 +197,8 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                            flight_wire=flight_wire, workload=traffic,
                            flight_ring=flight_ring or 4096,
                            request_spans=request_spans,
-                           migration=migration, leases=leases)
+                           migration=migration, leases=leases,
+                           health=health)
     nemesis = Nemesis(sched, plane, cluster)
     ticks = sched.horizon if horizon is None else horizon
 
@@ -291,6 +302,10 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
                     # the journals it joins against on (tick, group).
                     "spans": span_dump,
                     "span_summary": span_summary,
+                    # Detector verdicts beside the tripped invariant: the
+                    # doctor diagnoses artifacts, so the health story
+                    # rides in the repro itself.
+                    "health": cluster.health_summary(),
                 }, fh, indent=1)
         except OSError:
             artifact = None
@@ -388,6 +403,12 @@ async def run_soak_async(seed: int, schedule, n_nodes: int = 3,
         "lease": cluster.lease_summary(),
         # Idempotent-produce duplicate scan: acked payloads seen >1x in
         # the owner-row applied logs (expected clean; see above).
+        # Online health plane (None with health off): per-detector
+        # verdicts (worst level, first-degraded/critical ticks) and the
+        # full health_* FSM transition stream — byte-identical across
+        # same-seed runs, scored against the chaos corpus by
+        # tools/doctor.py.
+        "health": cluster.health_summary(),
         "dup_check": {"dup_acked": dup_acked,
                       "verdict": "clean" if dup_acked == 0 else "DUPLICATES"},
         "invariants": "ok" if violation is None else "VIOLATED",

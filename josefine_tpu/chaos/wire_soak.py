@@ -230,7 +230,8 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
                               request_ticks: int = 30,
                               join_ticks: int = 120,
                               artifact_path: str | None = None,
-                              request_spans: bool = False) -> dict:
+                              request_spans: bool = False,
+                              health: bool = True) -> dict:
     """One wire chaos soak (see module docstring). Produces one offered
     batch every ``produce_every`` virtual ticks across the schedule's
     horizon, heals, then runs the full consumer-group verification."""
@@ -274,6 +275,16 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
     max_stall = 0
     span_summaries = None
     span_dumps = None
+    monitor = None
+    if health:
+        from josefine_tpu.utils.health import HealthMonitor, HealthThresholds
+
+        # One scope (the wire rig drives one produce stream); wire-tuned
+        # thresholds — the lockstep rig acks within a produce_every
+        # cadence, so its clean stall ceiling sits far below the chaos
+        # harness's noise-driven one.
+        monitor = HealthMonitor(groups=1, thresholds=HealthThresholds.wire(),
+                                publish=False)
 
     def _set_fault_windows(active: bool) -> None:
         # Broker-side span recorders: the chaotic phase is one armed-fault
@@ -310,6 +321,23 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
             if driver.n_produced > prev_acked:
                 prev_acked = driver.n_produced
                 last_ack_tick = plane.tick
+            if monitor is not None:
+                # The wire health plane observes the driver's own
+                # counters: produce progress against the open-loop
+                # offered stream (pending=1 — the rig is always
+                # offering), and the connection-level fault tally for
+                # the wire-storm detector. Reconnects + group restarts
+                # only: plain retries/reroutes carry the driver's
+                # routine NotLeader re-routing (measured: a steady ~2
+                # per produce round on a clean 3-broker rig), while a
+                # clean rig's reconnect count is exactly zero — any
+                # reconnect is fate-induced. Zero extra wire traffic.
+                monitor.observe(plane.tick, {
+                    "progress": [driver.n_produced],
+                    "pending": [1],
+                    "wire_retries": (driver.n_reconnects
+                                     + driver.n_group_restarts),
+                })
             stall = plane.tick - last_ack_tick
             if stall > max_stall:
                 max_stall = stall
@@ -383,6 +411,9 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
             # violation's request-phase story beside the wire journals.
             "spans": span_dumps,
             "span_summary": span_summaries,
+            "health": (None if monitor is None else
+                       {"verdicts": monitor.verdicts(),
+                        "events": monitor.events()}),
         }
 
         def dump_artifact(path: str) -> bool:
@@ -413,6 +444,12 @@ async def run_wire_soak_async(seed: int, schedule, n_nodes: int = 1,
         "nemesis_skipped_steps": list(nemesis.skipped),
         "max_commitless_window": max_stall,
         "commitless_limit": commitless_limit,
+        # Online health plane over the wire driver's counters (None with
+        # health off): detector verdicts + the health_* transition stream,
+        # byte-identical across same-seed runs like every other plane.
+        "health": (None if monitor is None else
+                   {"verdicts": monitor.verdicts(),
+                    "events": monitor.events()}),
         "invariants": "ok" if violation is None else "VIOLATED",
         "violation": violation,
         "artifact": artifact,

@@ -145,6 +145,16 @@ class RaftConfig:
     # renewals are per-quorum-advance per held group, so chaos soaks want
     # it and the bench hot path does not.
     flight_lease: bool = False
+    # Node-local health plane (utils/health.py): deterministic detectors
+    # (commit-stall, leader-flap, backpressure saturation, ...) evaluated
+    # once per completed tick off the host mirrors the engine already
+    # maintains — zero extra device fetches — driving per-group FSMs
+    # (ok -> degraded -> critical) that journal to a PRIVATE flight ring
+    # and export cluster_health{scope,detector} gauges plus the
+    # MetricsServer /health route. Off by default: observation-only (a
+    # health-on run is byte-identical to a health-off twin), but the
+    # per-tick sampling is real work at very large P.
+    health: bool = False
     # Vestigial in the reference (src/raft/config.rs:108-109); honored here
     # by the host snapshotter.
     snapshot_interval_s: int = 120

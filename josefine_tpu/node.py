@@ -119,6 +119,12 @@ class Node:
 
         self._flight_log_handler = attach_flight_journal(
             self.raft.engine.flight.emit, self.raft.engine._flight_tick)
+        # Health plane (raft.health): feed the engine-owned monitor the
+        # broker's backpressure tally — merged into every per-tick sample
+        # so the backpressure_sat detector sees produce-plane saturation
+        # alongside the consensus-plane signals.
+        if self.raft.engine.health is not None:
+            self.raft.engine.health.extra_fn = self.broker.health_counters
         # Committed DeleteTopic reaches every node through the FSM; each
         # drops its own on-disk replica logs. Deregistration is synchronous
         # (later requests must see the topic gone); the rmtree runs in an
@@ -173,6 +179,13 @@ class Node:
                 # /traces: retained request span trees (empty route when
                 # raft.request_spans is off).
                 traces_fn=(self.spans.traces if self.spans is not None
+                           else None),
+                # /health: current detector levels + verdicts + the
+                # health_* transition journal (null when raft.health is
+                # off — the route says the plane is dark rather than
+                # faking "all ok").
+                health_fn=(self.raft.engine.health.snapshot
+                           if self.raft.engine.health is not None
                            else None),
             )
 

@@ -104,6 +104,7 @@ from josefine_tpu.raft.packed_step import (
 from josefine_tpu.raft.result import NotLeader, TickResult
 from josefine_tpu.raft.snap_transfer import SnapshotTransfer, _SnapStream
 from josefine_tpu.utils.flight import FlightRecorder
+from josefine_tpu.utils.health import HealthMonitor
 from josefine_tpu.utils.kv import KV
 from josefine_tpu.utils.metrics import REGISTRY
 from josefine_tpu.utils.spans import current_span
@@ -231,6 +232,7 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         request_spans: bool = False,
         leases: bool = False,
         flight_lease: bool = False,
+        health: bool = False,
     ):
         self.kv = kv
         if self_id not in node_ids:
@@ -706,6 +708,17 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
         # triggers (commit-hook recycles, parole lifts, snapshot installs)
         # is the COMPLETING tick — self._ticks only increments at the end.
         self._flight_now: int | None = None
+        # Health plane (utils/health.py, raft.health, default off): a
+        # node-local HealthMonitor evaluated once per completed tick off
+        # the host mirrors tick_finish maintains anyway — zero extra
+        # device fetches. It owns a PRIVATE flight ring (health_* events
+        # never enter THIS journal, so a health-on run's engine journal /
+        # state digest stay byte-identical to a health-off twin's) and
+        # publishes cluster_health{scope,detector} gauges labeled with
+        # this node. Broker-side signals (produce backpressure) attach
+        # post-construction via `engine.health.extra_fn` (node.py).
+        self.health = (HealthMonitor(groups=groups, node=self.self_id)
+                       if health else None)
         REGISTRY.add_collect_hook(self, RaftEngine._publish_telemetry)
 
     def _flight_tick(self) -> int:
@@ -2132,9 +2145,35 @@ class RaftEngine(HostIO, GroupAdmin, SnapshotTransfer):
             _m_out.inc(sum(len(m) if isinstance(m, rpc.MsgBatch) else 1
                            for m in res.outbound), node=self.self_id)
         _m_led.set(int((self._h_role == LEADER).sum()), node=self.self_id)
+        if self.health is not None:
+            # Once per completed tick, after the mirrors are adopted. The
+            # sample is pure host-mirror reads and the monitor journals to
+            # its own private ring — nothing here touches res or this
+            # engine's journal (the zero-perturbation contract,
+            # tests/test_health.py twin differential).
+            self.health.observe(self._ticks, self.health_sample())
         return res
 
     # ------------------------------------------------------------ lookups
+
+    def health_sample(self) -> dict:
+        """Zero-fetch detector inputs off the host mirrors: commit seq
+        (progress), open proposal + unobserved-commit ledgers (pending),
+        and the known-leader mirror (flap). Strictly read-only. The
+        cross-node commit-spread signal (replication_lag) needs every
+        node's frontier, which a single engine cannot see — that
+        detector stays dormant on this plane and is fed by the chaos
+        harness's cluster-wide sampler instead."""
+        pend = np.zeros(self.P, np.int64)
+        for g, q in self._proposals.items():
+            pend[g] += len(q)
+        for g, q in self._lat_open.items():
+            pend[g] += len(q)
+        return {
+            "progress": self._h_commit & 0xFFFFFFFF,
+            "pending": pend,
+            "leaders": self._h_leader,
+        }
 
     def has_group(self, group: int) -> bool:
         return 0 <= group < self.P

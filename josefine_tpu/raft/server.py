@@ -98,6 +98,7 @@ class JosefineRaft:
             request_spans=getattr(config, "request_spans", False),
             leases=getattr(config, "leases", False),
             flight_lease=getattr(config, "flight_lease", False),
+            health=getattr(config, "health", False),
         )
         # Peer addresses: configured nodes, plus any members the durable
         # member table knows that config does not (nodes added at runtime

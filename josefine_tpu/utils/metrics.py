@@ -503,7 +503,11 @@ class MetricsServer:
     re-downloading the ring), ``/traces`` (retained request span trees
     from ``traces_fn`` — utils/spans.py, ``raft.request_spans``; supports
     ``?tenant=T``, ``?phase=P`` (dominant phase), ``?limit=N`` and a
-    ``?since=RID`` cursor), ``/healthz``.
+    ``?since=RID`` cursor), ``/health`` (the health plane's current
+    detector levels + verdicts from ``health_fn`` — utils/health.py,
+    ``raft.health`` — with its ``health_*`` transition journal filtered
+    by the SAME parser and cursor semantics as ``/events``),
+    ``/healthz``.
     """
 
     def __init__(self, host: str, port: int,
@@ -511,12 +515,14 @@ class MetricsServer:
                  registry: Registry | None = None,
                  node: int | None = None,
                  events_fn: Callable[[], list] | None = None,
-                 traces_fn: Callable[[], list] | None = None):
+                 traces_fn: Callable[[], list] | None = None,
+                 health_fn: Callable[[], dict | None] | None = None):
         self.host = host
         self.port = port
         self.state_fn = state_fn
         self.events_fn = events_fn
         self.traces_fn = traces_fn
+        self.health_fn = health_fn
         self.registry = registry or REGISTRY
         # Scope the exposition to this node's series (multi-node-per-process
         # deployments share the module-global registry).
@@ -555,20 +561,44 @@ class MetricsServer:
         except (TypeError, ValueError):
             return None
 
-    def _events_body(self, query: str) -> bytes:
+    def _filtered_events(self, events: list, query: str) -> list:
+        """THE filter implementation behind /events and /health: one
+        parser (`_query_params`/`_qint`), one cursor rule (?since=SEQ is
+        strict-after; malformed numeric params ignore the filter). Both
+        routes call this — regression-pinned by tests/test_health.py so
+        a third copy never appears."""
         from josefine_tpu.utils.flight import filter_events
 
-        events = list(self.events_fn()) if self.events_fn else []
         params = self._query_params(query)
         limit = self._qint(params.get("limit"))
-        events = filter_events(
+        return filter_events(
             events,
             kind=params.get("kind") or None,
             group=self._qint(params.get("group")),
             limit=limit if limit is not None and limit >= 0 else None,
             since=self._qint(params.get("since")),
         )
-        return json.dumps({"node": self.node, "events": events}).encode()
+
+    def _events_body(self, query: str) -> bytes:
+        events = list(self.events_fn()) if self.events_fn else []
+        return json.dumps({"node": self.node,
+                           "events": self._filtered_events(events, query)
+                           }).encode()
+
+    def _health_body(self, query: str) -> bytes:
+        snap = self.health_fn() if self.health_fn else None
+        if not snap:
+            # Health plane off (raft.health = false): explicit null, so a
+            # doctor pointed at a plain node learns the plane is dark
+            # instead of mistaking it for "all ok, no events yet".
+            return json.dumps({"node": self.node, "health": None}).encode()
+        return json.dumps({
+            "node": self.node,
+            "health": {"status": snap.get("status"),
+                       "verdicts": snap.get("verdicts")},
+            "events": self._filtered_events(list(snap.get("events") or []),
+                                            query),
+        }).encode()
 
     def _traces_body(self, query: str) -> bytes:
         from josefine_tpu.utils.spans import filter_traces
@@ -610,6 +640,10 @@ class MetricsServer:
                 status = "200 OK"
             elif path == "/traces":
                 body = self._traces_body(query)
+                ctype = "application/json"
+                status = "200 OK"
+            elif path == "/health":
+                body = self._health_body(query)
                 ctype = "application/json"
                 status = "200 OK"
             elif path == "/healthz":

@@ -69,6 +69,14 @@ class ChaosTraffic:
         self._adm = AdmissionState(spec)
         # (arr, attempt, first_tick, group, payload, fut)
         self.pending: list[tuple] = []
+        # Per-group outstanding REQUESTS (not attempts): incremented at
+        # first successful enqueue, decremented on ack/shed/gave_up. This
+        # deliberately includes work parked in the retry backlog — during
+        # a leaderless window no future exists (``_admit`` re-queues
+        # without submitting), yet the work is still waiting, which is
+        # exactly the signal the health plane's commit-stall detector
+        # gates on (see ChaosCluster.health_sample).
+        self.outstanding = [0] * groups
         self.latencies: list[tuple[int, int]] = []  # (tenant, lat_ticks)
         self.n_offered = 0
         self.n_admitted = 0
@@ -113,6 +121,13 @@ class ChaosTraffic:
         if not self._adm.enqueue(arr, attempt, first):
             self.n_shed += 1
             self._ledger.finish((arr.tenant, arr.seq), "shed")
+            if attempt > 0:
+                # A matured retry shed at the queue is terminal for a
+                # request counted outstanding at its first enqueue.
+                self.outstanding[self.group_of(arr)] -= 1
+            return
+        if attempt == 0:
+            self.outstanding[self.group_of(arr)] += 1
 
     def _admit(self, cluster, t: int, arr: ProduceArrival, attempt: int,
                first: int) -> None:
@@ -155,6 +170,7 @@ class ChaosTraffic:
                                         self.sched.retry_delay):
             self.n_gave_up += 1
             self._ledger.finish((arr.tenant, arr.seq), "gave_up")
+            self.outstanding[self.group_of(arr)] -= 1
             return
         self.n_retries += 1
         _m_retries.inc()
@@ -177,12 +193,20 @@ class ChaosTraffic:
             cluster.acked[g].append(payload)
             cluster.ack_tick[payload] = t
             self.n_acked += 1
+            self.outstanding[g] -= 1
             lat = t - first
             self.latencies.append((arr.tenant, lat))
             self._ledger.finish((arr.tenant, arr.seq), "ok")
             _m_lat.observe(lat,
                            tenant=TenantModel.tenant_label(arr.tenant))
         self.pending = still
+
+    def outstanding_by_group(self, groups: int) -> list[int]:
+        """Outstanding request counts, padded/clipped to `groups` entries
+        (the health plane's per-group pending signal)."""
+        out = list(self.outstanding[:groups])
+        out.extend(0 for _ in range(groups - len(out)))
+        return out
 
     def close_spans(self, status: str = "aborted") -> None:
         """End-of-soak epilogue: finish every span still open — requests

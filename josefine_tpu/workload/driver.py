@@ -229,7 +229,8 @@ class TrafficEngine:
                  request_spans: bool = False,
                  span_capacity: int = 4096,
                  leases: bool = False, flight_lease: bool = False,
-                 read_mode: str = "local", timeout_min: int = 3):
+                 read_mode: str = "local", timeout_min: int = 3,
+                 health: bool = False):
         self.spec = spec.validate()
         self.seed = seed
         self.model = TenantModel(spec)
@@ -383,6 +384,21 @@ class TrafficEngine:
         self.n_fetched_bytes = 0
         self.n_offset_commits = 0
         self.n_recycle_acks = 0
+        # Health plane (opt-in): a cluster-scope monitor fed once per
+        # virtual tick with the workload's own aggregates — committed
+        # progress vs open work (commit_stall), the cumulative
+        # backpressure tally (backpressure_sat), and, when request spans
+        # are on, the phase attribution totals (phase_regime: which
+        # ladder rung dominates shifts under a fault). publish=False for
+        # the same reason as _run_registry above: the process-global
+        # gauge would accumulate across runs sharing a process.
+        if health:
+            from josefine_tpu.utils.health import HealthMonitor
+
+            self.health: HealthMonitor | None = HealthMonitor(
+                groups=1, publish=False)
+        else:
+            self.health = None
 
     # ------------------------------------------------------------ wiring
 
@@ -599,6 +615,16 @@ class TrafficEngine:
         await self._settle()
         self._harvest(t)
         _m_inflight.set(len(self._inflight))
+        if self.health is not None:
+            sample = {
+                "progress": [self.n_committed],
+                "pending": [len(self._inflight) + self._adm.pending()],
+                "backpressure": (self.n_backpressured + self.n_rejected
+                                 + self.n_shed),
+            }
+            if self.spans is not None:
+                sample["phases"] = self.spans.phase_totals()
+            self.health.observe(t, sample)
         self.tick += 1
 
     # --------------------------------------------------------- produce
@@ -1251,4 +1277,10 @@ class TrafficEngine:
             # artifact (tools/traffic_soak.py), not every bench row.
             "span_summary": (self.spans.summary()
                              if self.spans is not None else None),
+            # Health-plane epilogue (health=True): worst level + first
+            # degraded/critical tick per detector, and the transition
+            # journal — None when the plane is off.
+            "health": ({"verdicts": self.health.verdicts(),
+                        "events": self.health.events()}
+                       if self.health is not None else None),
         }

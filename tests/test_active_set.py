@@ -251,14 +251,14 @@ def _assert_engines_equal(ea: RaftEngine, er: RaftEngine, tag: str):
             err_msg=f"timeout mirror {tag}")
 
 
-# The three heaviest matrix cases are `slow` (outside the tier-1 time
-# budget; `tools/ci.sh` full runs this file unfiltered): tier-1 keeps one
-# case per mode axis — sparse split-phase, both pipelined drivers, and the
-# dense fallback-flip case, which exercises the dense window path too.
+# The heaviest matrix cases are `slow` (outside the tier-1 time budget;
+# `tools/ci.sh` full runs this file unfiltered): tier-1 keeps one case
+# per mode axis — both pipelined drivers plus the fallback-flip trio,
+# which covers the split-phase and dense window paths mid-run too.
 @pytest.mark.parametrize("sparse,window,pipeline,fallback_frac", [
     pytest.param(False, 1, False, 1.0, marks=pytest.mark.slow),
     pytest.param(False, 8, False, 1.0, marks=pytest.mark.slow),
-    (True, 1, False, 1.0),
+    pytest.param(True, 1, False, 1.0, marks=pytest.mark.slow),
     pytest.param(True, 8, False, 1.0, marks=pytest.mark.slow),
     (False, 1, True, 1.0),
     (True, 1, True, 1.0),

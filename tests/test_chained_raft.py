@@ -414,6 +414,7 @@ def test_partial_membership_quorum():
     assert (np.array(st.commit.s).max(axis=1) > 10).all()
 
 
+@pytest.mark.slow
 def test_churn_round_harness_converges():
     """bench_churn's jitted round: crash all leaders -> every partition
     re-elects within the tick budget and crashed nodes rejoin."""

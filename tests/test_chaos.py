@@ -29,7 +29,11 @@ import pytest
 from josefine_tpu.chaos.harness import ChaosCluster, MembershipChaosCluster
 
 
-@pytest.mark.parametrize("seed", [3, 11, 23])
+@pytest.mark.parametrize("seed", [
+    pytest.param(3, marks=pytest.mark.slow),
+    11,
+    23,
+])
 def test_chaos_with_membership_churn(seed):
     """Faults + membership changes + snapshot installs, all at once; then
     heal and assert the classic invariants across whatever membership the
@@ -59,7 +63,11 @@ def test_chaos_with_membership_churn(seed):
     asyncio.run(main())
 
 
-@pytest.mark.parametrize("seed", [1, 7, 42])
+@pytest.mark.parametrize("seed", [
+    pytest.param(1, marks=pytest.mark.slow),
+    7,
+    42,
+])
 def test_chaos_safety_and_convergence(seed):
     async def main():
         c = ChaosCluster(seed)
@@ -81,7 +89,10 @@ def test_chaos_safety_and_convergence(seed):
     asyncio.run(main())
 
 
-@pytest.mark.parametrize("seed", [3, 19])
+@pytest.mark.parametrize("seed", [
+    pytest.param(3, marks=pytest.mark.slow),
+    19,
+])
 def test_sparse_bridge_chaos(seed):
     """The sparse packed-IO bridge under the full fault model. 96 groups
     with a deliberately tiny compaction capacity (k_out=8): election

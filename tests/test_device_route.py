@@ -130,7 +130,7 @@ def _assert_engines_equal(ea: RaftEngine, er: RaftEngine, tag: str):
 # suite rides inside the 870 s tier-1 cap, which the seed tree already
 # hits, so every extra in-cap second here crowds out dots elsewhere.
 @pytest.mark.parametrize("sparse,window,pipeline,active", [
-    (False, 1, False, False),
+    pytest.param(False, 1, False, False, marks=pytest.mark.slow),
     pytest.param(True, 1, False, False, marks=pytest.mark.slow),
     pytest.param(False, 8, False, False, marks=pytest.mark.slow),
     pytest.param(True, 8, False, False, marks=pytest.mark.slow),

@@ -399,6 +399,7 @@ def test_pending_proposal_set_tracks_queue_dict():
     asyncio.run(main())
 
 
+@pytest.mark.slow
 def test_five_node_cluster_quorum_and_minority_crash():
     """N=5 engine cluster (the kernel benches' node count, which the
     engine suites otherwise never drive): quorum is 3, so TWO nodes can

@@ -51,8 +51,10 @@ def _route(cluster, window=1):
 @pytest.mark.asyncio
 @pytest.mark.parametrize("shards,sparse,window", [
     (2, False, 1),
-    (8, True, 1),
-    (4, True, 4),   # multi-tick windows over the sharded mesh
+    pytest.param(8, True, 1, marks=pytest.mark.slow),
+    # multi-tick windows over the sharded mesh — the heaviest cell of the
+    # matrix; full tier only (window>1 on-mesh is its distinguishing axis)
+    pytest.param(4, True, 4, marks=pytest.mark.slow),
 ])
 async def test_mesh_engine_matches_single_device(shards, sparse, window):
     """Engine clusters on a sharded mesh must be bit-identical to the

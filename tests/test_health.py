@@ -1,0 +1,405 @@
+"""Health plane (utils/health.py + tools/doctor.py): detector/FSM
+semantics, same-seed byte-identity of the ``health_*`` journal, the
+zero-perturbation twin (health-on == health-off on every other plane),
+and the /health route sharing /events' query parser + cursor rule."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+import pytest
+
+from josefine_tpu.utils.flight import FlightRecorder
+from josefine_tpu.utils.health import (
+    DETECTORS,
+    HealthMonitor,
+    HealthThresholds,
+)
+from josefine_tpu.utils.metrics import REGISTRY, MetricsServer, Registry
+
+#: Compact thresholds for unit tests: same FSM, short clocks.
+TH = HealthThresholds(warmup=5, recover_ticks=3, stall_degraded=4,
+                      stall_critical=8, flap_window=30, bp_window=10,
+                      bp_degraded=6, bp_critical=20, lease_window=10,
+                      lease_degraded=6, lease_critical=20,
+                      regime_window=10, regime_floor=4, regime_confirm=3,
+                      regime_hold=40)
+
+
+# ------------------------------------------------------------- detectors
+
+
+def test_commit_stall_fsm_full_cycle():
+    """Stalled group with pending work: ok -> degraded -> critical at the
+    tick-denominated thresholds, then recovery only after recover_ticks
+    consecutive healthy ticks (no single-tick flap back to ok)."""
+    mon = HealthMonitor(groups=1, thresholds=TH)
+    prog = 0
+    for t in range(20):
+        if t < TH.warmup:
+            prog += 1            # boot progress
+        mon.observe(t, {"progress": [prog], "pending": [3]})
+    # Stall clock starts at the last warmup tick (4): degraded at
+    # 4 + stall_degraded, critical at 4 + stall_critical.
+    assert mon.first_fire("commit_stall", "degraded") == 8
+    assert mon.first_fire("commit_stall", "critical") == 12
+    assert mon.status()["overall"] == "critical"
+    # Recovery: progress resumes; level holds until the streak matures.
+    for t in range(20, 20 + TH.recover_ticks):
+        prog += 1
+        mon.observe(t, {"progress": [prog], "pending": [3]})
+        if t < 20 + TH.recover_ticks - 1:
+            assert mon.status()["overall"] == "critical"
+    assert mon.status()["overall"] == "ok"
+    v = mon.verdicts()["detectors"]["commit_stall"]
+    assert v == {"level": "ok", "worst": "critical",
+                 "first_degraded": 8, "first_degraded_scope": "g0",
+                 "first_critical": 12, "first_critical_scope": "g0"}
+
+
+def test_idle_group_never_stalls():
+    """No pending work => no stall, however long progress is frozen."""
+    mon = HealthMonitor(groups=1, thresholds=TH)
+    for t in range(40):
+        mon.observe(t, {"progress": [7], "pending": [0]})
+    assert mon.verdicts()["overall"] == "ok"
+    assert mon.events() == []
+
+
+def test_warmup_grace_suppresses_boot_stall():
+    """A frozen boot (no progress, work pending from tick 0) cannot fire
+    before warmup + stall_degraded: elections are not incidents."""
+    mon = HealthMonitor(groups=1, thresholds=TH)
+    for t in range(TH.warmup + TH.stall_degraded - 1):
+        mon.observe(t, {"progress": [0], "pending": [1]})
+    assert mon.verdicts()["overall"] == "ok"
+    mon.observe(TH.warmup + TH.stall_degraded - 1,
+                {"progress": [0], "pending": [1]})
+    assert mon.first_fire("commit_stall") == TH.warmup + TH.stall_degraded - 1
+
+
+def test_leader_flap_counts_only_known_transitions():
+    mon = HealthMonitor(groups=1, thresholds=TH)
+    # Boot: unknown -> node 0 (not a flap), then churn 0 -> 1 -> 0.
+    leaders = [-1, -1, 0, 0, 0, 0, 0, 1, 0, 0, 0]
+    for t, l in enumerate(leaders):
+        mon.observe(t, {"leaders": [l]})
+    # Two known-leader changes (ticks 7, 8) >= flap_degraded=2.
+    assert mon.first_fire("leader_flap") == 8
+    ev = mon.events(kind="health_degraded")
+    assert ev and ev[0]["detail"]["detector"] == "leader_flap"
+    assert ev[0]["detail"]["scope"] == "g0"
+    assert ev[0]["detail"]["value"] == 2
+
+
+def test_backpressure_saturation_windowed_rate():
+    """The detector reads a windowed RATE off the cumulative counter —
+    a historical total accrued before the window never fires it."""
+    mon = HealthMonitor(thresholds=TH)
+    for t in range(12):
+        mon.observe(t, {"backpressure": 1000})     # flat: rate 0
+    assert mon.verdicts()["overall"] == "ok"
+    cum = 1000
+    for t in range(12, 20):
+        cum += 2                                    # 2/tick -> rate 16 > 6
+        mon.observe(t, {"backpressure": cum})
+    assert mon.verdicts()["detectors"]["backpressure_sat"]["worst"] == \
+        "degraded"
+    assert mon.first_fire("backpressure_sat") == 14  # rate hits 6 at +3
+
+
+def test_lease_storm_and_wire_thresholds():
+    """lease_storm windows refusals+expiries; the wire() preset treats a
+    single post-warmup reconnect as anomalous (clean wire runs measure
+    exactly zero)."""
+    mon = HealthMonitor(thresholds=TH)
+    cum = 0
+    for t in range(20):
+        cum += 1 if t >= 10 else 0
+        mon.observe(t, {"lease_refused": cum, "lease_expired": 0})
+    assert mon.first_fire("lease_storm") == 15      # rate reaches 6
+
+    wire = HealthMonitor(thresholds=HealthThresholds.wire())
+    for t in range(14):
+        wire.observe(t, {"wire_retries": 0})
+    wire.observe(14, {"wire_retries": 1})
+    assert wire.first_fire("wire_retry_storm") == 14
+    wire.observe(15, {"wire_retries": 5})
+    assert wire.verdicts()["detectors"]["wire_retry_storm"]["worst"] == \
+        "critical"
+
+
+def test_migration_wedge_armed_fence_no_progress():
+    mon = HealthMonitor(thresholds=TH)
+    for t in range(40):
+        m = {"active": True, "started": 10, "progress": 0} if t >= 10 \
+            else None
+        mon.observe(t, {"migration": m})
+    # Wedge clock runs from arming (10): degraded at 10+wedge_degraded.
+    assert mon.first_fire("migration_wedge") == 10 + TH.wedge_degraded
+    # Progress resets the clock and the FSM recovers.
+    for t in range(40, 40 + TH.recover_ticks):
+        mon.observe(t, {"migration": {"active": True, "started": 10,
+                                      "progress": t}})
+    assert mon.status()["overall"] == "ok"
+
+
+def test_phase_regime_shift_detection():
+    """Baseline regime (consensus-dominant) establishes silently; a
+    sustained flip to serve-dominant fires with from/to in the event."""
+    mon = HealthMonitor(thresholds=TH)
+    cons = serve = count = 0
+    for t in range(15):                 # establish consensus baseline
+        count += 2
+        cons += 5
+        mon.observe(t, {"phases": {"count": count, "consensus": cons,
+                                   "serve": serve}})
+    assert mon.verdicts()["overall"] == "ok"
+    first = None
+    for t in range(15, 40):             # regime flips to serve
+        count += 2
+        serve += 9
+        mon.observe(t, {"phases": {"count": count, "consensus": cons,
+                                   "serve": serve}})
+        first = first or mon.first_fire("phase_regime")
+    assert first is not None
+    ev = mon.events(kind="health_degraded")
+    assert ev[0]["detail"]["detector"] == "phase_regime"
+    assert ev[0]["detail"]["from"] == "consensus"
+    assert ev[0]["detail"]["to"] == "serve"
+
+
+def test_absent_inputs_keep_detectors_dormant():
+    """A sample carrying only some keys evaluates only those detectors —
+    the engine plane (no cluster-wide lag view) must never trip
+    replication_lag, and an empty sample is a no-op."""
+    mon = HealthMonitor(groups=2, thresholds=TH)
+    for t in range(30):
+        mon.observe(t, {"progress": [t, t], "pending": [1, 1]})
+    mon.observe(30, {})
+    assert set(mon.verdicts()["detectors"]) == {"commit_stall"}
+    assert mon.verdicts()["overall"] == "ok"
+
+
+def test_gauge_export_and_detector_catalog():
+    mon = HealthMonitor(groups=1, thresholds=TH, node=3)
+    for t in range(20):
+        mon.observe(t, {"progress": [0], "pending": [1]})
+    vals = REGISTRY._metrics["cluster_health"].values
+    assert vals[(("detector", "commit_stall"), ("node", 3),
+                 ("scope", "g0"))] == 2
+    # publish=False monitors never touch the process-global registry.
+    quiet = HealthMonitor(groups=1, thresholds=TH, node=99, publish=False)
+    for t in range(20):
+        quiet.observe(t, {"progress": [0], "pending": [1]})
+    assert not any("99" in str(k) for k in vals)
+    # Every journaled detector is in the catalog the doctor renders.
+    assert set(DETECTORS) >= {e["detail"]["detector"]
+                              for e in mon.events()}
+
+
+def test_extra_fn_merges_into_sample():
+    mon = HealthMonitor(thresholds=TH)
+    cum = {"v": 0}
+    mon.extra_fn = lambda: {"backpressure": cum["v"]}
+    for t in range(20):
+        cum["v"] += 3
+        mon.observe(t, {})
+    assert mon.verdicts()["detectors"]["backpressure_sat"]["worst"] != "ok"
+
+
+# ---------------------------------------------------------- determinism
+
+
+def _drive(mon: HealthMonitor) -> HealthMonitor:
+    prog = 0
+    for t in range(60):
+        prog += 1 if (t < 20 or t > 40) else 0
+        mon.observe(t, {"progress": [prog, t], "pending": [2, 1],
+                        "leaders": [t // 15 % 3, 0],
+                        "backpressure": t * 3})
+    return mon
+
+
+def test_same_inputs_byte_identical_journal():
+    a = _drive(HealthMonitor(groups=2, thresholds=TH, publish=False))
+    b = _drive(HealthMonitor(groups=2, thresholds=TH, publish=False))
+    assert a.dump_jsonl() == b.dump_jsonl() != ""
+    assert a.verdicts() == b.verdicts()
+    for line in a.dump_jsonl().splitlines():
+        assert json.loads(line)["kind"].startswith("health_")
+
+
+@pytest.mark.slow
+def test_chaos_soak_health_deterministic_and_nonperturbing():
+    """The tentpole contract end-to-end (mirror of the span plane's
+    gating test): same-seed soak twice => byte-identical health_* event
+    stream AND verdicts; health-off twin => byte-identical event log,
+    state digest, and journals — the monitor observes, never perturbs."""
+    from josefine_tpu.chaos.soak import run_soak
+
+    kw = dict(horizon=120, workload={"tenants": 3, "produce_per_tick": 2.0})
+    a = run_soak(9, "leader-partition", health=True, **kw)
+    b = run_soak(9, "leader-partition", health=True, **kw)
+    off = run_soak(9, "leader-partition", health=False, **kw)
+    assert a["health"]["events"] == b["health"]["events"] != []
+    assert a["health"]["verdicts"] == b["health"]["verdicts"]
+    assert json.dumps(a["health"], sort_keys=True) == \
+        json.dumps(b["health"], sort_keys=True)
+    assert off["health"] is None
+    assert a["event_log"] == off["event_log"]
+    assert a["state_digest"] == off["state_digest"]
+    assert a["journals"] == off["journals"]
+    assert a["coverage_signature"] == off["coverage_signature"]
+
+
+# ------------------------------------------------- /health route sharing
+
+
+async def _get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return head.decode("latin1").split("\r\n")[0], body
+
+
+def _health_server():
+    mon = HealthMonitor(groups=1, thresholds=TH, publish=False)
+    for t in range(20):
+        mon.observe(t, {"progress": [0], "pending": [1],
+                        "leaders": [t % 3]})
+    return MetricsServer("127.0.0.1", 0, registry=Registry(), node=2,
+                         events_fn=mon.flight.events,
+                         health_fn=mon.snapshot), mon
+
+
+def test_health_endpoint_serves_status_and_filtered_events():
+    async def main():
+        srv, mon = _health_server()
+        port = await srv.start()
+        try:
+            status, body = await _get(port, "/health")
+            assert status.endswith("200 OK")
+            payload = json.loads(body)
+            assert payload["node"] == 2
+            assert payload["health"]["status"]["overall"] == "critical"
+            assert payload["health"]["verdicts"]["detectors"]
+            assert [e["seq"] for e in payload["events"]] == \
+                [e["seq"] for e in mon.events()]
+
+            # The /events filter grammar applies verbatim on /health:
+            # same parser, same semantics (the shared-implementation
+            # satellite) — kind, group, limit, and the strict-after
+            # since cursor, malformed values ignoring the filter.
+            for q in ("?kind=health_degraded", "?group=0", "?limit=2",
+                      "?since=1", "?since=--3", "?limit=x&since=1"):
+                _, hb = await _get(port, "/health" + q)
+                _, eb = await _get(port, "/events" + q)
+                assert json.loads(hb)["events"] == \
+                    json.loads(eb)["events"], q
+            _, hb = await _get(port, "/health?since=1")
+            assert all(e["seq"] > 1 for e in json.loads(hb)["events"])
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_health_and_events_share_one_filter_implementation():
+    """Regression pin for the no-third-copy rule: both routes go through
+    MetricsServer._filtered_events — swap it on the instance and BOTH
+    endpoints reflect the swap."""
+    async def main():
+        srv, _ = _health_server()
+        sentinel = [{"seq": 0, "tick": 0, "kind": "sentinel"}]
+        srv._filtered_events = lambda events, query: sentinel
+        port = await srv.start()
+        try:
+            for path in ("/health", "/events"):
+                _, body = await _get(port, path)
+                assert json.loads(body)["events"] == sentinel, path
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+def test_health_route_dark_without_monitor():
+    """No health_fn => the route reports the plane dark (null), never a
+    fabricated 'ok' — absence of monitoring is not health."""
+    async def main():
+        srv = MetricsServer("127.0.0.1", 0, registry=Registry(), node=4,
+                            events_fn=FlightRecorder().events)
+        port = await srv.start()
+        try:
+            status, body = await _get(port, "/health")
+            assert status.endswith("200 OK")
+            assert json.loads(body) == {"node": 4, "health": None}
+        finally:
+            await srv.stop()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------ the doctor
+
+
+def _doctor():
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    import doctor
+    return doctor
+
+
+def test_doctor_ranks_findings_deterministically():
+    doctor = _doctor()
+    verdicts = {"detectors": {
+        "leader_flap": {"level": "ok", "worst": "degraded",
+                        "first_degraded": 90,
+                        "first_degraded_scope": "g1"},
+        "commit_stall": {"level": "critical", "worst": "critical",
+                         "first_degraded": 70,
+                         "first_degraded_scope": "g0",
+                         "first_critical": 95},
+        "lease_storm": {"level": "ok", "worst": "degraded",
+                        "first_degraded": 80,
+                        "first_degraded_scope": "cluster"},
+        "phase_regime": {"level": "ok", "worst": "ok"},
+    }}
+    ranked = doctor.rank_findings(verdicts)
+    # Severity first, then first-fire tick; ok detectors dropped.
+    assert [f["detector"] for f in ranked] == \
+        ["commit_stall", "lease_storm", "leader_flap"]
+    assert all(f["cause"] for f in ranked)
+    assert doctor.rank_findings(verdicts) == ranked
+
+
+def test_doctor_diagnose_doc_shapes():
+    doctor = _doctor()
+    rep = doctor.diagnose_doc({"health": None})
+    assert rep["overall"] == "unknown" and rep["findings"] == []
+    rep = doctor.diagnose_doc({
+        "invariants": "ok",
+        "health": {"verdicts": {"overall": "degraded", "transitions": 2,
+                                "detectors": {"commit_stall": {
+                                    "level": "ok", "worst": "degraded",
+                                    "first_degraded": 33,
+                                    "first_degraded_scope": "g0"}}},
+                   "events": [{"seq": 0, "tick": 33,
+                               "kind": "health_degraded"}]}})
+    assert rep["overall"] == "degraded"
+    assert rep["findings"][0]["detector"] == "commit_stall"
+    text = doctor.render_text(rep)
+    assert "commit_stall" in text and "@tick 33" in text
+    # Benign silence renders as a clean bill, not an empty string.
+    assert "every detector stayed ok" in doctor.render_text(
+        doctor.diagnose_doc({"health": {"verdicts": {
+            "overall": "ok", "detectors": {}}, "events": []}}))

@@ -96,13 +96,16 @@ async def _produce_one(mgr, part: int, payload: bytes, down: set[int]) -> bool:
 
 @pytest.mark.asyncio
 @pytest.mark.parametrize("seed,compact,stagger", [
-    (5, False, False), (17, False, False),
+    (5, False, False),
+    pytest.param(17, False, False, marks=pytest.mark.slow),
     # Seeds 11/23 were xfail through round 2 (the KNOWN ISSUE: acked-record
     # loss under compaction+crash). Root-caused and fixed in round 3 — a
     # reset replica kept its voting rights and an empty quorum could elect
     # over committed history; see tests/test_reset_safety.py for the
     # deterministic reproducer and the vote-parole fix.
-    (11, True, False), (23, True, False),
+    (11, True, False),
+    # Same compact/stagger shape as seed 11 — second seed rides in full only.
+    pytest.param(23, True, False, marks=pytest.mark.slow),
     # Staggered heartbeats (interval >> election timeout, liveness carried
     # by the transport keepalive) under the same crash/compaction chaos:
     # the ack contract must hold when leader silence is the NORM between

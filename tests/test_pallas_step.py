@@ -32,7 +32,11 @@ def _assert_tree_equal(a, b, what):
             np.asarray(x), np.asarray(y), err_msg=f"{what} leaf {i}")
 
 
-@pytest.mark.parametrize("P,N,tile", [(6, 3, 2), (7, 3, 4), (5, 5, 8)])
+@pytest.mark.parametrize("P,N,tile", [
+    (6, 3, 2),
+    pytest.param(7, 3, 4, marks=pytest.mark.slow),
+    pytest.param(5, 5, 8, marks=pytest.mark.slow),
+])
 def test_fused_matches_xla_exactly(P, N, tile):
     params = step_params(timeout_min=3, timeout_max=8, hb_ticks=1, auto_proposals=1)
     state, member = cr.init_state(P, N, base_seed=42, params=params)
@@ -81,6 +85,7 @@ def test_fused_window_chaining():
         assert t1[k] + t2[k] == t3[k], k
 
 
+@pytest.mark.slow
 def test_fused_partial_membership_and_crash():
     """Dead/absent nodes stay frozen through the fused path too."""
     P, N = 3, 5
@@ -104,7 +109,10 @@ def test_fused_partial_membership_and_crash():
     assert (((roles == LEADER) & alive).sum(axis=1) == 1).all()
 
 
-@pytest.mark.parametrize("pf_vec", [(1, 1, 1), (1, 0, 1)])
+@pytest.mark.parametrize("pf_vec", [
+    pytest.param((1, 1, 1), marks=pytest.mark.slow),
+    (1, 0, 1),
+])
 def test_fused_matches_xla_with_peer_fresh(pf_vec):
     """Aggregate-keepalive twin (ADVICE r3): ``peer_fresh`` must behave
     identically in the fused kernel and the XLA path, in the exact config

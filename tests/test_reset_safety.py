@@ -79,6 +79,11 @@ def _read_fold(node, part=0):
     return b"".join(b for _, _, b in rep.log.read_from(0, 1 << 26))
 
 
+# The full e2e reproducer is the heaviest single test in the suite
+# (~40 s): full tier only; tier-1 keeps the deterministic parole unit
+# (test_parole_blocks_empty_quorum_and_lifts_on_catchup) on the same
+# empty-quorum scenario.
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_reset_node_cannot_elect_empty_quorum(tmp_path):
     """Scripted loss interleaving (deterministic form of the chaos seeds):

@@ -119,6 +119,7 @@ def test_sharded_equals_unsharded(p_shards, n_shards, N):
     assert ref[-1][0].commit.s.max() > 0, "nothing committed"
 
 
+@pytest.mark.slow
 def test_sharded_live_proposals_equal():
     """Same equivalence under an active proposal load lane (every node offers
     proposals each tick; only leaders mint)."""

@@ -117,7 +117,7 @@ def _mk_cluster(mesh, sparse, route, ring, groups=P):
 # unfiltered; podsim_smoke covers the routed mesh path in quick CI):
 # tier-1 keeps the plain sharded twin and the routed+ring one.
 @pytest.mark.parametrize("sparse,window,routed,ring,pipeline", [
-    (False, 1, False, False, False),
+    pytest.param(False, 1, False, False, False, marks=pytest.mark.slow),
     (False, 1, True, True, False),
     pytest.param(True, 1, True, False, False, marks=pytest.mark.slow),
     pytest.param(False, 8, True, True, False, marks=pytest.mark.slow),

@@ -83,6 +83,7 @@ async def test_sparse_matches_dense(k_out, split):
     _assert_equal(dense, sparse)
 
 
+@pytest.mark.slow
 @pytest.mark.asyncio
 async def test_product_staggered_heartbeats_over_real_sockets(tmp_path):
     """Full-stack twin of the engine-level keepalive test: a 3-node cluster

@@ -62,7 +62,10 @@ def wait_leader_pipelined(engines, max_ticks=120, down=()):
     raise AssertionError("no leader elected under pipelined ticks")
 
 
-@pytest.mark.parametrize("sparse", [False, True])
+@pytest.mark.parametrize("sparse", [
+    False,
+    pytest.param(True, marks=pytest.mark.slow),
+])
 def test_pipelined_election_and_commit(sparse):
     async def main():
         engines, fsms = make_cluster(sparse=sparse)

@@ -67,7 +67,10 @@ def mirror_snapshot(e):
             e._h_leader.copy(), e._h_head.copy(), e._h_commit.copy())
 
 
-@pytest.mark.parametrize("sparse", [False, True])
+@pytest.mark.parametrize("sparse", [
+    False,
+    pytest.param(True, marks=pytest.mark.slow),
+])
 def test_windowed_differential_jax_vs_python(sparse):
     """jax windows == python windows, every mirror integer, every window —
     the same exact-equality bar the single-tick differential suite sets."""
@@ -199,6 +202,7 @@ def test_windowed_chaos_crash_restart_safety():
     asyncio.run(main())
 
 
+@pytest.mark.slow
 def test_windowed_sparse_chaos_all_features():
     """Every round-4 mechanism at once: adaptive multi-tick windows x the
     sparse packed-IO bridge x a tiny compaction capacity (overflow growth,

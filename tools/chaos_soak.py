@@ -163,6 +163,11 @@ def main() -> int:
                          ".json in the working directory)")
     ap.add_argument("--dump-schedule", default=None,
                     help="write the resolved schedule DSL (JSON) here")
+    ap.add_argument("--result-out", default=None,
+                    help="write the FULL soak result (JSON: journals, "
+                         "event log, coverage, health verdicts + "
+                         "health_* transition journal, ...) here — the "
+                         "artifact tools/doctor.py diagnose ingests")
     ap.add_argument("--platform", default="cpu",
                     help="jax platform for the engines (default cpu)")
     ap.add_argument("--list", action="store_true",
@@ -255,6 +260,11 @@ def main() -> int:
         summary["coverage_classes"] = result["coverage"]["class_counts"]
         if result.get("span_summary"):
             summary["span_summary"] = result["span_summary"]
+        if result.get("health"):
+            summary["health"] = result["health"]["verdicts"]
+        if args.result_out:
+            with open(args.result_out, "w") as fh:
+                json.dump(result, fh, indent=1, sort_keys=True)
         print(json.dumps(summary))
         return 0 if result["invariants"] == "ok" else 1
 
@@ -330,6 +340,13 @@ def main() -> int:
         summary["migration"] = result["migration"]
     if result.get("lease") is not None:
         summary["lease"] = result["lease"]
+    # Health-plane epilogue: whole-run detector verdicts (worst level +
+    # first-fire ticks). The full transition journal rides --result-out.
+    if result.get("health"):
+        summary["health"] = result["health"]["verdicts"]
+    if args.result_out:
+        with open(args.result_out, "w") as fh:
+            json.dump(result, fh, indent=1, sort_keys=True)
     # Observability epilogue: the full registry dump (counters, gauges,
     # histograms — includes the commit-latency axis) and the tail of each
     # node's flight journal, so a soak's summary line says what the

@@ -391,6 +391,53 @@ print("wire load ok:", row["ops"], "ops,", row["retries"], "retries,",
 PYEOF
 }
 
+doctor_smoke() {
+    # The health plane + cluster doctor (PR 20): the canonical nemesis
+    # pair under real workload must reach `degraded` via the
+    # commit_stall detector inside the fault window, TWICE with one
+    # seed producing cmp-byte-identical health blocks (the health
+    # journal joins the chaos-determinism contract), a clean soak must
+    # stay `ok` with zero transitions (the zero-false-positive floor
+    # BENCH_doctor.json states over the full seed sweep), and
+    # tools/doctor.py diagnose must rank the stall finding first.
+    echo "== doctor smoke =="
+    rm -f /tmp/ci_doc_a.json /tmp/ci_doc_b.json \
+        /tmp/ci_doc_a.health /tmp/ci_doc_b.health
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200 --workload-tenants 6 --workload-load 2 \
+        --quiet-net --result-out /tmp/ci_doc_a.json > /dev/null
+    python tools/chaos_soak.py --seed 7 --schedule leader-partition \
+        --horizon 200 --workload-tenants 6 --workload-load 2 \
+        --quiet-net --result-out /tmp/ci_doc_b.json > /dev/null
+    python - <<'PYEOF'
+import json
+for side in ("a", "b"):
+    doc = json.load(open(f"/tmp/ci_doc_{side}.json"))
+    with open(f"/tmp/ci_doc_{side}.health", "w") as fh:
+        json.dump(doc["health"], fh, sort_keys=True)
+v = json.load(open("/tmp/ci_doc_a.json"))["health"]["verdicts"]
+cs = v["detectors"]["commit_stall"]
+assert cs["worst"] != "ok", v
+assert 60 <= cs["first_degraded"] <= 110, cs  # inside the fault window
+print("doctor detect ok: commit_stall", cs["worst"],
+      "@tick", cs["first_degraded"])
+PYEOF
+    cmp /tmp/ci_doc_a.health /tmp/ci_doc_b.health
+    python tools/doctor.py diagnose /tmp/ci_doc_a.json > /tmp/ci_doc_rep.txt
+    grep -q "commit_stall" /tmp/ci_doc_rep.txt
+    python - <<'PYEOF'
+from josefine_tpu.chaos.faults import NetFaults
+from josefine_tpu.chaos.nemesis import Schedule
+from josefine_tpu.chaos.soak import run_soak
+res = run_soak(11, Schedule("clean", [], horizon=200, heal_ticks=60),
+               net=NetFaults.quiet(),
+               workload={"tenants": 6, "produce_per_tick": 2})
+v = res["health"]["verdicts"]
+assert v["overall"] == "ok" and v["transitions"] == 0, v
+print("doctor clean ok: zero transitions over", res["ticks"], "ticks")
+PYEOF
+}
+
 podsim_smoke() {
     # The sharded engine path's quick parity gate (PR 14): twin 3-node
     # clusters — 8-virtual-device 'p' mesh vs unsharded, both active-set +
@@ -433,6 +480,7 @@ if [[ "${1:-}" == "quick" ]]; then
     chaos_search_smoke
     wire_chaos_smoke
     wire_load_smoke
+    doctor_smoke
     traffic_smoke
     traffic_smoke_spans
     podsim_smoke
@@ -461,7 +509,8 @@ else
         tests/test_log.py tests/test_durability.py \
         tests/test_idempotent_produce.py tests/test_metrics.py \
         tests/test_histogram.py tests/test_events_endpoint.py \
-        tests/test_workload.py tests/test_spans.py -q
+        tests/test_workload.py tests/test_spans.py \
+        tests/test_health.py -q
     python -m pytest tests/test_integration.py tests/test_partition_groups.py \
         tests/test_partition_compaction.py tests/test_entrypoint.py -q
     # The active-set differential suite in its own chunk: the twin-cluster
@@ -496,6 +545,7 @@ else
     chaos_search_repros
     wire_chaos_smoke
     wire_load_smoke
+    doctor_smoke
     traffic_smoke
     traffic_smoke_spans
     traffic_chaos_smoke
